@@ -1,0 +1,29 @@
+"""isoforest_tpu — a TPU-native isolation-forest framework.
+
+Capability parity with linkedin/isolation-forest (standard + extended
+isolation forests, Estimator/Model API, reference-layout persistence, ONNX
+export), re-designed for TPU: fixed-shape heap-tensor forests, jit/vmap
+level-synchronous tree growth, batched gather traversal, and tree/row
+sharding over a `jax.sharding.Mesh`.
+"""
+
+__version__ = "0.1.0"
+
+from . import ops, parallel, utils  # noqa: F401
+from .models import (
+    ExtendedIsolationForest,
+    ExtendedIsolationForestModel,
+    IsolationForest,
+    IsolationForestModel,
+)
+
+__all__ = [
+    "ops",
+    "parallel",
+    "utils",
+    "__version__",
+    "ExtendedIsolationForest",
+    "ExtendedIsolationForestModel",
+    "IsolationForest",
+    "IsolationForestModel",
+]

@@ -1,0 +1,98 @@
+"""Sampling engine — per-tree bagged sample selection and feature subsets.
+
+TPU-native redesign of the reference's bagging pipeline
+(``core/BaggedPoint.scala:114-217`` + ``core/SharedTrainLogic.scala:99-153``):
+the reference draws a per-(datum, tree) membership weight — Poisson(rate) when
+``bootstrap`` (with replacement) else Binomial(1, rate) (without replacement)
+— flattens duplicates, shuffles each tree's partition and slices the first
+``numSamples`` points. The net effect is: **every tree independently receives
+``numSamples`` rows, uniformly at random, with replacement iff bootstrap.**
+
+Here no data moves at all (SURVEY.md §5.8): the feature matrix stays resident
+in HBM and each tree materialises only an ``int32[num_samples]`` index buffer.
+The Spark shuffle becomes a gather; per-partition reseeding
+(``seed + partitionIndex``, BaggedPoint.scala:169-177) becomes
+``jax.random.fold_in(key, tree_id)`` — a documented RNG-scheme deviation
+(bitwise parity with the JVM RNG chain is impossible and not required; the
+acceptance gates are statistical, SURVEY.md §7.4.3).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Above this row count, exact without-replacement sampling (a full permutation
+# per tree) is replaced by uniform draws with replacement: for S samples out of
+# N rows the collision probability per tree is ~S^2/(2N) < 0.4% at S=256,
+# N=10M — statistically negligible, and it keeps bagging O(T*S) instead of
+# O(T*N).
+_EXACT_WITHOUT_REPLACEMENT_MAX_ROWS = 1 << 20
+
+
+def per_tree_keys(key: jax.Array, num_trees: int) -> jax.Array:
+    """Independent PRNG keys per tree: ``fold_in(key, tree_id)`` over global
+    tree ids — the TPU analogue of the reference's per-partition reseeding
+    (``seed + partitionIndex``, BaggedPoint.scala:169-177). Computed over the
+    full tree axis so sharding trees across devices keeps streams disjoint."""
+    return jax.vmap(lambda t: jax.random.fold_in(key, t))(
+        jnp.arange(num_trees, dtype=jnp.uint32)
+    )
+
+
+def bagged_indices(
+    key: jax.Array,
+    num_rows: int,
+    num_samples: int,
+    num_trees: int,
+    bootstrap: bool,
+) -> jax.Array:
+    """Return ``int32[num_trees, num_samples]`` row indices, one bag per tree.
+
+    ``bootstrap=True`` samples with replacement (Poisson branch,
+    BaggedPoint.scala:122-129); ``bootstrap=False`` without replacement
+    (Binomial(1, rate) branch + shuffle/slice, BaggedPoint.scala:130-139 and
+    SharedTrainLogic.scala:283-287).
+    """
+    tree_keys = per_tree_keys(key, num_trees)
+    if bootstrap or num_rows > _EXACT_WITHOUT_REPLACEMENT_MAX_ROWS:
+        sample = lambda k: jax.random.randint(
+            k, (num_samples,), 0, num_rows, dtype=jnp.int32
+        )
+    else:
+        sample = lambda k: jax.random.permutation(k, num_rows)[:num_samples].astype(
+            jnp.int32
+        )
+    return jax.vmap(sample)(tree_keys)
+
+
+def feature_subsets(
+    key: jax.Array,
+    total_num_features: int,
+    num_features: int,
+    num_trees: int,
+) -> jax.Array:
+    """Per-tree sorted random feature subsets, ``int32[num_trees, num_features]``.
+
+    Mirrors ``shuffle(0..F-1).take(numFeatures).sorted``
+    (SharedTrainLogic.scala:300-304). Sorted ascending so persisted
+    ``splitAttribute`` ids are canonical.
+    """
+    tree_keys = per_tree_keys(key, num_trees)
+
+    def subset(k):
+        perm = jax.random.permutation(k, total_num_features)[:num_features]
+        return jnp.sort(perm).astype(jnp.int32)
+
+    return jax.vmap(subset)(tree_keys)
+
+
+def gather_tree_data(X: jax.Array, bag_idx: jax.Array, feat_idx: jax.Array) -> jax.Array:
+    """Materialise per-tree training slabs ``f32[T, S, num_features]``.
+
+    ``X`` is the full ``[N, F]`` matrix (replicated or all-gathered in HBM);
+    the double gather replaces the reference's shuffle-to-partition data
+    movement (SharedTrainLogic.scala:140-145).
+    """
+    rows = X[bag_idx]  # [T, S, F]
+    return jnp.take_along_axis(rows, feat_idx[:, None, :], axis=2)

@@ -1,0 +1,190 @@
+"""Extended isolation-tree growth (random hyperplane splits, Hariri et al. 2018).
+
+Level-synchronous fixed-shape redesign of ``ExtendedIsolationTree.scala:112-260``,
+sharing the implicit-heap layout of :mod:`.tree_growth`. Per split node:
+
+  * ``k = min(extensionLevel + 1, dim)`` non-zero coordinates
+    (ExtendedIsolationTree.scala:157), chosen as a random distinct subset,
+    canonicalised sorted ascending (:220-226);
+  * Gaussian weights on those coordinates, L2-normalised in float32
+    (:169-195); an exactly-zero norm turns the node into a leaf (:183-184);
+  * intercept point drawn per-coordinate uniform in the node's ``[min, max]``
+    (``min == max`` degenerates to the constant), ``offset = sum(w_i * p_i)``
+    (:201-217);
+  * routing ``dot(x, w) < offset`` -> left (:230-232); **no retry on
+    degenerate splits** — an empty side becomes a ``numInstances = 0`` leaf
+    (ExtendedNodes.scala:32-35), which is exactly why ExtendedIF_0 differs
+    statistically from StandardIF (reference README benchmark note).
+
+Storage is the reference's sparse hyperplane form (``ExtendedUtils.scala:21-34``):
+``indices`` int32[T, M, k] (sorted, ``-1`` marks leaves/non-existent slots) and
+``weights`` float32[T, M, k], with float32 dots matching the reference's
+float-cast dot (ExtendedUtils.scala:46-55).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .bagging import gather_tree_data
+
+
+class ExtendedForest(NamedTuple):
+    """Struct-of-arrays EIF forest over ``[num_trees, max_nodes]`` heap slots."""
+
+    indices: jax.Array  # i32 [T, M, k]; indices[..., 0] == -1 at leaves
+    weights: jax.Array  # f32 [T, M, k]
+    offset: jax.Array  # f32 [T, M]
+    num_instances: jax.Array  # i32 [T, M]; leaf size, -1 internal/non-existent
+
+    @property
+    def num_trees(self) -> int:
+        return self.indices.shape[0]
+
+    @property
+    def max_nodes(self) -> int:
+        return self.indices.shape[1]
+
+    @property
+    def k(self) -> int:
+        return self.indices.shape[2]
+
+    @property
+    def is_internal(self) -> jax.Array:
+        return self.indices[..., 0] >= 0
+
+    @property
+    def is_leaf(self) -> jax.Array:
+        return self.num_instances >= 0
+
+    @property
+    def exists(self) -> jax.Array:
+        return self.is_internal | self.is_leaf
+
+
+def _grow_one_extended_tree(key: jax.Array, x: jax.Array, h: int, k_nonzero: int):
+    S, F = x.shape
+    M = 2 ** (h + 1) - 1
+    slots = jnp.arange(M, dtype=jnp.int32)
+    level_keys = jax.random.split(key, h + 1)
+
+    state = dict(
+        node_id=jnp.zeros((S,), jnp.int32),
+        settled=jnp.zeros((S,), jnp.bool_),
+        indices=jnp.full((M, k_nonzero), -1, jnp.int32),
+        weights=jnp.zeros((M, k_nonzero), jnp.float32),
+        offset=jnp.zeros((M,), jnp.float32),
+        num_instances=jnp.full((M,), -1, jnp.int32),
+        exists=jnp.zeros((M,), jnp.bool_).at[0].set(True),
+    )
+
+    def level_step(l, st):
+        k_sub, k_w, k_p = jax.random.split(level_keys[l], 3)
+
+        idx = jnp.where(st["settled"], M, st["node_id"])
+        cnt = jnp.zeros((M,), jnp.int32).at[idx].add(1, mode="drop")
+        minv = jnp.full((M, F), jnp.inf, jnp.float32).at[idx].min(x, mode="drop")
+        maxv = jnp.full((M, F), -jnp.inf, jnp.float32).at[idx].max(x, mode="drop")
+
+        level_start = (jnp.int32(1) << l) - 1
+        in_level = (slots >= level_start) & (slots < 2 * level_start + 1)
+
+        # --- hyperplane draw per node (ExtendedIsolationTree.scala:155-226) ---
+        node_keys = jax.random.split(k_sub, M)
+        perm = jax.vmap(lambda kk: jax.random.permutation(kk, F))(node_keys)
+        sub = jnp.sort(perm[:, :k_nonzero], axis=1).astype(jnp.int32)  # [M, k]
+
+        w = jax.random.normal(k_w, (M, k_nonzero), jnp.float32)
+        nrm = jnp.sqrt(jnp.sum(w * w, axis=1))
+        zero_norm = nrm == 0.0
+        w = w / jnp.maximum(nrm, jnp.float32(1e-37))[:, None]
+
+        mn = jnp.take_along_axis(minv, sub, axis=1)
+        mx = jnp.take_along_axis(maxv, sub, axis=1)
+        # empty nodes have inf stats; mask so the offset math stays finite
+        finite = cnt > 0
+        mn = jnp.where(finite[:, None], mn, 0.0)
+        mx = jnp.where(finite[:, None], mx, 0.0)
+        u = jax.random.uniform(k_p, (M, k_nonzero), jnp.float32)
+        p = mn + u * (mx - mn)
+        off = jnp.sum(w * p, axis=1)
+
+        can_split = st["exists"] & in_level & (cnt > 1) & (l < h) & ~zero_norm
+        new_leaf = st["exists"] & in_level & ~can_split
+
+        indices = jnp.where(can_split[:, None], sub, st["indices"])
+        weights = jnp.where(can_split[:, None], w, st["weights"])
+        offset = jnp.where(can_split, off, st["offset"])
+        num_instances = jnp.where(new_leaf, cnt, st["num_instances"])
+
+        child_l = jnp.where(can_split, 2 * slots + 1, M)
+        child_r = jnp.where(can_split, 2 * slots + 2, M)
+        exists = (
+            st["exists"]
+            .at[child_l].set(True, mode="drop")
+            .at[child_r].set(True, mode="drop")
+        )
+
+        # --- route: dot(x, w) < offset -> left (:230-232) ---
+        nd = st["node_id"]
+        split_here = can_split[nd] & ~st["settled"]
+        sub_s = jnp.maximum(indices[nd], 0)  # [S, k]
+        xv = jnp.take_along_axis(x, sub_s, axis=1)
+        dot = jnp.sum(xv * weights[nd], axis=1)
+        go_right = dot >= offset[nd]
+        node_id = jnp.where(split_here, 2 * nd + 1 + go_right.astype(jnp.int32), nd)
+        settled = st["settled"] | ~split_here
+
+        return dict(
+            node_id=node_id,
+            settled=settled,
+            indices=indices,
+            weights=weights,
+            offset=offset,
+            num_instances=num_instances,
+            exists=exists,
+        )
+
+    state = lax.fori_loop(0, h + 1, level_step, state)
+    return state["indices"], state["weights"], state["offset"], state["num_instances"]
+
+
+def grow_extended_forest(
+    tree_keys: jax.Array,
+    X: jax.Array,
+    bag_idx: jax.Array,
+    feat_idx: jax.Array,
+    height: int,
+    extension_level: int,
+) -> ExtendedForest:
+    """Grow ``T`` extended isolation trees, ``vmap`` over the tree axis.
+
+    ``tree_keys``: pre-derived per-tree PRNG keys (shardable along the tree
+    axis). ``extension_level`` is the *resolved* level
+    (ExtendedIsolationForest.scala:56-69); the per-split non-zero count is
+    ``min(extension_level + 1, F_sub)``. Local subset coordinates are mapped
+    back to global feature ids.
+    """
+    x_trees = gather_tree_data(X, bag_idx, feat_idx)  # [T, S, F_sub]
+    num_trees, _, f_sub = x_trees.shape
+    k_nonzero = min(extension_level + 1, f_sub)
+    indices_local, weights, offset, num_instances = jax.vmap(
+        lambda k, x: _grow_one_extended_tree(k, x, height, k_nonzero)
+    )(tree_keys, x_trees)
+
+    # map local subset coords -> global feature ids; keep -1 sentinels
+    flat_local = jnp.maximum(indices_local, 0).reshape(num_trees, -1)
+    flat_global = jnp.take_along_axis(feat_idx, flat_local, axis=1).reshape(
+        indices_local.shape
+    )
+    indices_global = jnp.where(indices_local >= 0, flat_global, -1).astype(jnp.int32)
+    return ExtendedForest(
+        indices=indices_global,
+        weights=weights,
+        offset=offset,
+        num_instances=num_instances,
+    )

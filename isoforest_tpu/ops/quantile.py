@@ -1,0 +1,92 @@
+"""Quantile / contamination-threshold computation.
+
+The reference sets the model threshold as
+``approxQuantile(scores, 1 - contamination, contaminationError)`` — Spark's
+Greenwald-Khanna sketch, which returns an *actual element* of the score column
+whose rank error is at most ``contaminationError * N``; ``error = 0`` means
+exact (``core/SharedTrainLogic.scala:187-197``). Two TPU-native paths:
+
+  * exact: full device sort (XLA sort is a single fused program) and a rank
+    pick — used whenever the scores fit on device, regardless of
+    ``contaminationError`` (an exact answer always satisfies the approximate
+    contract);
+  * sketched: a psum-able fixed-width histogram honoring the rank-error
+    contract, for row-sharded multi-host scoring where gathering all scores is
+    undesirable (SURVEY.md §5.8 replacement for distributed approxQuantile).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def exact_quantile(scores, q: float) -> float:
+    """Element of ``scores`` at rank ``ceil(q * N) - 1`` (clamped), like an
+    exact Greenwald-Khanna query: returns a sample element, no interpolation."""
+    scores = jnp.asarray(scores)
+    n = scores.shape[0]
+    rank = min(max(int(np.ceil(q * n)) - 1, 0), n - 1)
+    return float(jnp.sort(scores)[rank])
+
+
+def histogram_quantile(
+    scores,
+    q: float,
+    num_bins: int = 1 << 14,
+    lo: float = 0.0,
+    hi: float = 1.0,
+    refine_passes: int = 3,
+) -> float:
+    """Iteratively-refined histogram quantile over a known value range.
+
+    Isolation-forest scores live in ``(0, 1]``. Each pass histograms the
+    scores over the current ``[lo, hi)`` range, locates the bin containing the
+    target rank, and narrows the range to that bin — after ``P`` passes the
+    returned lower edge is within ``(hi - lo) / B**P`` of the true quantile
+    *value* (for the defaults, ~1e-13: below float32 resolution, i.e. exact in
+    value even for heavily tied score distributions). Each pass's ``counts``
+    reduction is a ``psum`` when run under ``shard_map``, so this serves as
+    the multi-host replacement for Spark's distributed approxQuantile
+    (SURVEY.md §5.8) at ``refine_passes`` collective rounds.
+    """
+    scores = jnp.asarray(scores, jnp.float32)
+    n = scores.shape[0]
+    target = max(int(np.ceil(q * n)), 1)
+    for _ in range(refine_passes):
+        width = hi - lo
+        if width <= 0:
+            break
+        rel = jnp.floor((scores - lo) / width * num_bins)
+        bins = jnp.clip(rel, -1, num_bins).astype(jnp.int32)
+        counts = np.asarray(
+            jnp.zeros((num_bins,), jnp.int32)
+            .at[jnp.where(bins < 0, num_bins, bins)]
+            .add(1, mode="drop")
+        )
+        below = int(np.sum(np.asarray(bins) < 0))  # scores strictly below lo
+        cum = below + np.cumsum(counts)
+        idx = min(int(np.searchsorted(cum, target)), num_bins - 1)
+        lo, hi = lo + idx * width / num_bins, lo + (idx + 1) * width / num_bins
+    return float(lo)
+
+
+def contamination_threshold(
+    scores,
+    contamination: float,
+    contamination_error: float,
+) -> float:
+    """Outlier-score threshold for a contamination level; exact when the error
+    budget is 0 (SharedTrainLogic.scala:187-197 semantics)."""
+    q = 1.0 - contamination
+    if contamination_error == 0.0 or np.size(scores) <= (1 << 22):
+        return exact_quantile(scores, q)
+    return histogram_quantile(scores, q)
+
+
+def observed_contamination(scores, threshold: float) -> float:
+    """Fraction of training rows labelled outliers by ``threshold`` — used for
+    the reference's verification warning (SharedTrainLogic.scala:211-232)."""
+    scores = jnp.asarray(scores)
+    return float(jnp.mean((scores >= threshold).astype(jnp.float32)))

@@ -1,0 +1,139 @@
+"""Batched path-length traversal (scoring) over heap-tensor forests.
+
+The reference scores one row at a time inside a Spark UDF — a tail-recursive
+pointer walk per tree (``IsolationTree.scala:196-229``;
+``ExtendedIsolationTree.scala:283-355``), with the forest broadcast to every
+executor. Here the forest is a set of HBM-resident arrays and traversal is a
+``[trees, rows]`` batched gather program: a ``fori_loop`` of ``height`` steps,
+each step gathering every row's current node record and advancing
+``node -> 2*node + 1 + (go_right)``. Rows that reached a leaf stop moving —
+the loop is fixed-trip so the whole thing stays a single fused XLA program
+(and vectorises perfectly on TPU; this is also the Pallas candidate of
+SURVEY.md §7.2.4).
+
+Path length = (depth of final leaf) + ``avg_path_length(leaf.numInstances)``
+(IsolationTree.scala:213-229); score ``2^(-E[h]/c(n))``
+(IsolationForestModel.scala:135-138).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..utils.math import avg_path_length, score_from_path_length
+from .ext_growth import ExtendedForest
+from .tree_growth import StandardForest
+
+
+def _height_of(max_nodes: int) -> int:
+    return int(np.log2(max_nodes + 1)) - 1
+
+
+def standard_path_lengths(forest: StandardForest, X: jax.Array) -> jax.Array:
+    """Per-row mean path length over the forest; ``f32[C]`` for ``X: f32[C, F]``."""
+    h = _height_of(forest.max_nodes)
+    C = X.shape[0]
+
+    def one_tree(feature, threshold, num_instances):
+        def step(_, carry):
+            node, depth = carry
+            f = feature[node]  # [C]
+            leaf = f < 0
+            xv = jnp.take_along_axis(X, jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+            go_right = (xv >= threshold[node]).astype(jnp.int32)
+            nxt = 2 * node + 1 + go_right
+            node = jnp.where(leaf, node, nxt)
+            depth = jnp.where(leaf, depth, depth + 1)
+            return node, depth
+
+        node0 = jnp.zeros((C,), jnp.int32)
+        depth0 = jnp.zeros((C,), jnp.int32)
+        node, depth = lax.fori_loop(0, h, step, (node0, depth0))
+        return depth.astype(jnp.float32) + avg_path_length(num_instances[node])
+
+    per_tree = jax.vmap(one_tree)(
+        forest.feature, forest.threshold, forest.num_instances
+    )  # [T, C]
+    return jnp.mean(per_tree, axis=0)
+
+
+def extended_path_lengths(forest: ExtendedForest, X: jax.Array) -> jax.Array:
+    """EIF variant: hyperplane test ``dot(x, w) < offset`` -> left
+    (ExtendedIsolationTree.scala:333-355, float32 dot per ExtendedUtils.scala:46-55)."""
+    h = _height_of(forest.max_nodes)
+    C = X.shape[0]
+
+    def one_tree(indices, weights, offset, num_instances):
+        def step(_, carry):
+            node, depth = carry
+            sub = indices[node]  # [C, k]
+            leaf = sub[:, 0] < 0
+            xv = jnp.take_along_axis(X, jnp.maximum(sub, 0), axis=1)  # [C, k]
+            dot = jnp.sum(xv * weights[node], axis=1)
+            go_right = (dot >= offset[node]).astype(jnp.int32)
+            nxt = 2 * node + 1 + go_right
+            node = jnp.where(leaf, node, nxt)
+            depth = jnp.where(leaf, depth, depth + 1)
+            return node, depth
+
+        node0 = jnp.zeros((C,), jnp.int32)
+        depth0 = jnp.zeros((C,), jnp.int32)
+        node, depth = lax.fori_loop(0, h, step, (node0, depth0))
+        return depth.astype(jnp.float32) + avg_path_length(num_instances[node])
+
+    per_tree = jax.vmap(one_tree)(
+        forest.indices, forest.weights, forest.offset, forest.num_instances
+    )
+    return jnp.mean(per_tree, axis=0)
+
+
+def path_lengths(forest, X: jax.Array) -> jax.Array:
+    if isinstance(forest, StandardForest):
+        return standard_path_lengths(forest, X)
+    return extended_path_lengths(forest, X)
+
+
+@functools.partial(jax.jit, static_argnames=("num_samples",))
+def _score_chunk(forest, X, num_samples: int) -> jax.Array:
+    return score_from_path_length(path_lengths(forest, X), num_samples)
+
+
+def score_matrix(
+    forest,
+    X,
+    num_samples: int,
+    chunk_size: int = 1 << 18,
+) -> np.ndarray:
+    """Score a full ``[N, F]`` matrix, chunked along rows.
+
+    Chunking bounds the ``[T, C]`` traversal state so forests with many trees
+    never materialise ``[T, N]``. Row counts are always padded up to a
+    power-of-two bucket (min 1024) so varying batch sizes reuse a handful of
+    compiled programs instead of recompiling per distinct ``n``.
+    """
+    X = jnp.asarray(X, jnp.float32)
+    n = X.shape[0]
+    if n == 0:
+        return np.zeros((0,), np.float32)
+    if n <= chunk_size:
+        bucket = max(1024, 1 << int(np.ceil(np.log2(n))))
+        pad = bucket - n
+        if pad:
+            X = jnp.pad(X, ((0, pad), (0, 0)))
+        scores = _score_chunk(forest, X, num_samples)
+        return np.asarray(scores[:n])
+
+    outs = []
+    for start in range(0, n, chunk_size):
+        chunk = X[start : start + chunk_size]
+        pad = chunk_size - chunk.shape[0]
+        if pad:
+            chunk = jnp.pad(chunk, ((0, pad), (0, 0)))
+        scores = _score_chunk(forest, chunk, num_samples)
+        outs.append(np.asarray(scores[: chunk_size - pad] if pad else scores))
+    return np.concatenate(outs)

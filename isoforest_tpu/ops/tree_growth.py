@@ -1,0 +1,194 @@
+"""Standard isolation-tree growth as a fixed-shape, level-synchronous XLA program.
+
+The reference grows pointer-based trees recursively, one tree per Spark
+partition (``IsolationTree.scala:83-183``). That shape-dynamic recursion
+cannot compile to XLA; instead each tree is a **struct-of-arrays implicit
+heap** of ``max_nodes = 2**(h+1)-1`` slots with children of slot ``i`` at
+``2i+1``/``2i+2`` (SURVEY.md §7.1), and growth proceeds level-synchronously:
+at level ``l`` every sample scatters its feature vector into per-node
+min/max/count statistics, every level-``l`` node draws its split, and every
+sample routes one step down. The whole loop is a ``lax.fori_loop`` of
+``h+1`` fixed-shape iterations under ``jit``, ``vmap``-ed over the tree axis.
+
+Reference semantics preserved:
+  * height limit ``ceil(log2(n))`` (IsolationTree.scala:60-61);
+  * split feature drawn uniformly among *non-constant* features — the
+    reference's retry-loop-with-constant-feature-removal
+    (IsolationTree.scala:124-150) is equivalent to a uniform draw over the
+    features with ``min != max``, realised here as a Gumbel-argmax over the
+    non-constant mask;
+  * terminate when no splittable feature remains, the height limit is hit, or
+    ``n <= 1`` (IsolationTree.scala:155-156);
+  * split threshold uniform in ``[min, max)`` of the node's data; routing
+    ``x < t`` left / ``x >= t`` right (IsolationTree.scala:158-159).
+
+Known deviation: thresholds are float32 (the reference keeps Double). In the
+measure-zero event that a threshold rounds onto the node minimum, an empty
+child becomes a ``numInstances = 0`` leaf (``avg_path_length(0) = 0``) rather
+than being impossible — same convention the extended forest already uses
+(ExtendedNodes.scala:32-35).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .bagging import gather_tree_data
+
+
+class StandardForest(NamedTuple):
+    """Struct-of-arrays forest over ``[num_trees, max_nodes]`` heap slots.
+
+    ``feature``: int32 global split-feature id; ``-1`` at leaves and
+    non-existent slots. ``threshold``: float32 split value (reference:
+    ``splitValue`` Double, Nodes.scala:47-66). ``num_instances``: int32 leaf
+    size; ``-1`` at internal and non-existent slots (matching the Avro
+    sentinels, IsolationForestModelReadWrite.scala:36-67).
+    """
+
+    feature: jax.Array  # i32 [T, M]
+    threshold: jax.Array  # f32 [T, M]
+    num_instances: jax.Array  # i32 [T, M]
+
+    @property
+    def num_trees(self) -> int:
+        return self.feature.shape[0]
+
+    @property
+    def max_nodes(self) -> int:
+        return self.feature.shape[1]
+
+    @property
+    def is_internal(self) -> jax.Array:
+        return self.feature >= 0
+
+    @property
+    def is_leaf(self) -> jax.Array:
+        return self.num_instances >= 0
+
+    @property
+    def exists(self) -> jax.Array:
+        return self.is_internal | self.is_leaf
+
+
+def _grow_one_tree(key: jax.Array, x: jax.Array, h: int):
+    """Grow one tree over ``x: f32[S, F]``; returns local-feature-indexed arrays."""
+    S, F = x.shape
+    M = 2 ** (h + 1) - 1
+    slots = jnp.arange(M, dtype=jnp.int32)
+    level_keys = jax.random.split(key, h + 1)
+
+    state = dict(
+        node_id=jnp.zeros((S,), jnp.int32),
+        settled=jnp.zeros((S,), jnp.bool_),
+        feature=jnp.full((M,), -1, jnp.int32),
+        threshold=jnp.zeros((M,), jnp.float32),
+        num_instances=jnp.full((M,), -1, jnp.int32),
+        exists=jnp.zeros((M,), jnp.bool_).at[0].set(True),
+    )
+
+    def level_step(l, st):
+        k_feat, k_thr = jax.random.split(level_keys[l])
+
+        # --- per-node statistics via masked scatter (out-of-bounds dropped) ---
+        idx = jnp.where(st["settled"], M, st["node_id"])
+        cnt = jnp.zeros((M,), jnp.int32).at[idx].add(1, mode="drop")
+        minv = jnp.full((M, F), jnp.inf, jnp.float32).at[idx].min(x, mode="drop")
+        maxv = jnp.full((M, F), -jnp.inf, jnp.float32).at[idx].max(x, mode="drop")
+
+        level_start = (jnp.int32(1) << l) - 1
+        in_level = (slots >= level_start) & (slots < 2 * level_start + 1)
+
+        # --- split decision per level-l node (IsolationTree.scala:124-156) ---
+        nonconst = minv < maxv  # [M, F]
+        has_feature = jnp.any(nonconst, axis=1)
+        can_split = (
+            st["exists"] & in_level & (cnt > 1) & (l < h) & has_feature
+        )
+
+        # uniform choice among non-constant features == reference's retry loop
+        gumbel = jax.random.gumbel(k_feat, (M, F), jnp.float32)
+        choice = jnp.argmax(jnp.where(nonconst, gumbel, -jnp.inf), axis=1).astype(
+            jnp.int32
+        )
+        mn = jnp.take_along_axis(minv, choice[:, None], axis=1)[:, 0]
+        mx = jnp.take_along_axis(maxv, choice[:, None], axis=1)[:, 0]
+        u = jax.random.uniform(k_thr, (M,), jnp.float32)
+        thr = mn + u * (mx - mn)
+
+        new_leaf = st["exists"] & in_level & ~can_split
+
+        feature = jnp.where(can_split, choice, st["feature"])
+        threshold = jnp.where(can_split, thr, st["threshold"])
+        num_instances = jnp.where(new_leaf, cnt, st["num_instances"])
+
+        # children of split nodes materialise at the next level
+        child_l = jnp.where(can_split, 2 * slots + 1, M)
+        child_r = jnp.where(can_split, 2 * slots + 2, M)
+        exists = (
+            st["exists"]
+            .at[child_l].set(True, mode="drop")
+            .at[child_r].set(True, mode="drop")
+        )
+
+        # --- route unsettled samples one level down (x < t left / >= right) ---
+        nd = st["node_id"]
+        split_here = can_split[nd] & ~st["settled"]
+        f_s = feature[nd]
+        go_right = (
+            jnp.take_along_axis(x, jnp.maximum(f_s, 0)[:, None], axis=1)[:, 0]
+            >= threshold[nd]
+        )
+        node_id = jnp.where(split_here, 2 * nd + 1 + go_right.astype(jnp.int32), nd)
+        settled = st["settled"] | ~split_here
+
+        return dict(
+            node_id=node_id,
+            settled=settled,
+            feature=feature,
+            threshold=threshold,
+            num_instances=num_instances,
+            exists=exists,
+        )
+
+    state = lax.fori_loop(0, h + 1, level_step, state)
+    return state["feature"], state["threshold"], state["num_instances"]
+
+
+def grow_forest(
+    tree_keys: jax.Array,
+    X: jax.Array,
+    bag_idx: jax.Array,
+    feat_idx: jax.Array,
+    height: int,
+) -> StandardForest:
+    """Grow ``T`` standard isolation trees; ``vmap`` over the tree axis.
+
+    ``tree_keys``: per-tree PRNG keys ``[T, ...]`` (see
+    :func:`..bagging.per_tree_keys` — passed pre-derived so the tree axis can
+    be sharded across devices with disjoint streams); ``X``: f32[N, F_total];
+    ``bag_idx``: i32[T, S]; ``feat_idx``: i32[T, F_sub] sorted global feature
+    ids; ``height`` static. Local split indices are mapped back to global
+    feature ids so persisted ``splitAttribute`` matches the reference layout.
+    """
+    x_trees = gather_tree_data(X, bag_idx, feat_idx)  # [T, S, F_sub]
+    feature_local, threshold, num_instances = jax.vmap(
+        lambda k, x: _grow_one_tree(k, x, height)
+    )(tree_keys, x_trees)
+
+    feature_global = jnp.where(
+        feature_local >= 0,
+        jnp.take_along_axis(
+            feat_idx, jnp.maximum(feature_local, 0), axis=1
+        ),
+        -1,
+    ).astype(jnp.int32)
+    return StandardForest(
+        feature=feature_global,
+        threshold=threshold,
+        num_instances=num_instances,
+    )

@@ -1,0 +1,79 @@
+"""Test configuration: force an 8-virtual-device CPU mesh.
+
+Must run before any JAX backend initialises. The environment registers an
+experimental TPU-tunnel PJRT plugin ("axon") at interpreter startup and pins
+``jax_platforms="axon,cpu"``; tests always run CPU-only (SURVEY.md §4 — the
+reference exercises multi-node behaviour via local[4] Spark; we use 8 virtual
+CPU devices for mesh/sharding tests), so re-pin the config to cpu here.
+"""
+
+import os
+import pathlib
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# Persistent compilation cache: tree-growth/traversal programs are identical
+# across test runs; this cuts full-suite wall clock dramatically.
+_cache_dir = os.environ.get(
+    "ISOFOREST_TPU_JAX_CACHE", str(pathlib.Path(__file__).parent / ".jax_cache")
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+_REFERENCE_RESOURCES = pathlib.Path(
+    "/root/reference/isolation-forest/src/test/resources"
+)
+
+
+def _load_labeled_csv(path: pathlib.Path):
+    data = np.loadtxt(path, delimiter=",", comments="#").astype(np.float32)
+    return data[:, :-1], data[:, -1]
+
+
+@pytest.fixture(scope="session")
+def mammography():
+    """ODDS mammography (11183 x 6, 260 outliers) — the reference's principal
+    quality fixture (core/TestUtilsTest.scala:9-37)."""
+    path = _REFERENCE_RESOURCES / "mammography.csv"
+    if not path.exists():
+        pytest.skip("reference mammography.csv not available")
+    X, y = _load_labeled_csv(path)
+    assert X.shape == (11183, 6)
+    return X, y
+
+
+@pytest.fixture(scope="session")
+def shuttle():
+    """ODDS shuttle (49097 x 9) quality fixture."""
+    path = _REFERENCE_RESOURCES / "shuttle.csv"
+    if not path.exists():
+        pytest.skip("reference shuttle.csv not available")
+    X, y = _load_labeled_csv(path)
+    assert X.shape == (49097, 9)
+    return X, y
+
+
+def auroc(scores, labels) -> float:
+    """Rank-based AUROC (average ties), self-contained like the reference's
+    converter-test implementation."""
+    import scipy.stats
+
+    ranks = scipy.stats.rankdata(scores)
+    pos = labels == 1
+    n1 = int(pos.sum())
+    n0 = int((~pos).sum())
+    return float((ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0))
+
+
+@pytest.fixture(scope="session")
+def auroc_fn():
+    return auroc

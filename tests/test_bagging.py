@@ -1,0 +1,104 @@
+"""Statistical bagging tests — the reference's BaggedPointTest layer
+(core/BaggedPointTest.scala:73-333): distributional checks, edge cases, and
+exact same-seed reproducibility."""
+
+import jax
+import numpy as np
+import pytest
+
+from isoforest_tpu.ops.bagging import (
+    bagged_indices,
+    feature_subsets,
+    gather_tree_data,
+    per_tree_keys,
+)
+
+
+class TestBaggedIndices:
+    def test_shape_and_range(self):
+        idx = np.asarray(bagged_indices(jax.random.PRNGKey(0), 1000, 256, 10, False))
+        assert idx.shape == (10, 256)
+        assert idx.min() >= 0 and idx.max() < 1000
+
+    def test_without_replacement_unique(self):
+        idx = np.asarray(bagged_indices(jax.random.PRNGKey(0), 1000, 256, 20, False))
+        for t in range(20):
+            assert len(np.unique(idx[t])) == 256
+
+    def test_bootstrap_has_duplicates(self):
+        # with replacement, a 256-of-300 draw has duplicates w.h.p.
+        idx = np.asarray(bagged_indices(jax.random.PRNGKey(0), 300, 256, 20, True))
+        dup_trees = sum(len(np.unique(idx[t])) < 256 for t in range(20))
+        assert dup_trees == 20
+
+    def test_uniform_row_coverage(self):
+        # every row equally likely: chi-square-ish sanity over many trees
+        # (analogue of BaggedPointTest's subsample-distribution checks :73-153)
+        N, S, T = 500, 250, 400
+        idx = np.asarray(bagged_indices(jax.random.PRNGKey(1), N, S, T, False))
+        counts = np.bincount(idx.ravel(), minlength=N)
+        expected = S * T / N
+        assert abs(counts.mean() - expected) < 1e-9
+        # std of hypergeometric-ish counts stays within 5 sigma of binomial
+        sigma = np.sqrt(T * (S / N) * (1 - S / N))
+        assert np.all(np.abs(counts - expected) < 6 * sigma)
+
+    def test_trees_are_independent(self):
+        idx = np.asarray(bagged_indices(jax.random.PRNGKey(2), 10000, 256, 2, False))
+        overlap = len(np.intersect1d(idx[0], idx[1]))
+        # expected overlap 256*256/10000 ~ 6.5
+        assert overlap < 40
+
+    def test_same_seed_reproducible(self):
+        # exact reproducibility (BaggedPointTest.scala:289-333)
+        a = np.asarray(bagged_indices(jax.random.PRNGKey(7), 1000, 128, 8, True))
+        b = np.asarray(bagged_indices(jax.random.PRNGKey(7), 1000, 128, 8, True))
+        np.testing.assert_array_equal(a, b)
+
+    def test_different_seed_differs(self):
+        a = np.asarray(bagged_indices(jax.random.PRNGKey(7), 1000, 128, 8, False))
+        b = np.asarray(bagged_indices(jax.random.PRNGKey(8), 1000, 128, 8, False))
+        assert not np.array_equal(a, b)
+
+    def test_large_n_path(self):
+        # > 2^20 rows switches to the approximate (collision-negligible) path
+        idx = np.asarray(
+            bagged_indices(jax.random.PRNGKey(0), (1 << 20) + 5, 256, 4, False)
+        )
+        assert idx.shape == (4, 256)
+        assert idx.max() < (1 << 20) + 5
+
+
+class TestFeatureSubsets:
+    def test_sorted_distinct(self):
+        fs = np.asarray(feature_subsets(jax.random.PRNGKey(0), 10, 4, 50))
+        assert fs.shape == (50, 4)
+        for t in range(50):
+            assert np.all(np.diff(fs[t]) > 0)  # sorted strictly -> distinct
+
+    def test_full_subset_is_identity(self):
+        fs = np.asarray(feature_subsets(jax.random.PRNGKey(0), 6, 6, 10))
+        for t in range(10):
+            np.testing.assert_array_equal(fs[t], np.arange(6))
+
+    def test_covers_all_features(self):
+        fs = np.asarray(feature_subsets(jax.random.PRNGKey(1), 8, 3, 200))
+        assert set(np.unique(fs)) == set(range(8))
+
+
+class TestGatherTreeData:
+    def test_gather_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(100, 7)).astype(np.float32)
+        bag = np.asarray(bagged_indices(jax.random.PRNGKey(0), 100, 16, 3, False))
+        fidx = np.asarray(feature_subsets(jax.random.PRNGKey(1), 7, 4, 3))
+        out = np.asarray(gather_tree_data(X, bag, fidx))
+        assert out.shape == (3, 16, 4)
+        for t in range(3):
+            np.testing.assert_array_equal(out[t], X[bag[t]][:, fidx[t]])
+
+
+class TestPerTreeKeys:
+    def test_disjoint_streams(self):
+        keys = np.asarray(per_tree_keys(jax.random.PRNGKey(0), 64))
+        assert len(np.unique(keys, axis=0)) == 64

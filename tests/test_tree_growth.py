@@ -1,0 +1,268 @@
+"""Tree-kernel tests — the reference's pure-unit tree layer
+(IsolationTreeTest.scala:11-42, ExtendedIsolationTreeTest.scala:16-293),
+re-targeted at the heap-tensor representation: structural invariants,
+constant-feature semantics, determinism under seed, hand-built golden path
+lengths, and a differential check of the batched traversal against a pure
+numpy pointer-walk."""
+
+import jax
+import numpy as np
+import pytest
+
+from isoforest_tpu.ops.bagging import bagged_indices, feature_subsets, per_tree_keys
+from isoforest_tpu.ops.ext_growth import ExtendedForest, grow_extended_forest
+from isoforest_tpu.ops.traversal import (
+    extended_path_lengths,
+    standard_path_lengths,
+)
+from isoforest_tpu.ops.tree_growth import StandardForest, grow_forest
+from isoforest_tpu.utils import avg_path_length, height_limit
+
+
+def _grow(X, T=10, S=64, seed=0, bootstrap=False):
+    N, F = X.shape
+    S = min(S, N)
+    key = jax.random.PRNGKey(seed)
+    bag = bagged_indices(jax.random.fold_in(key, 0), N, S, T, bootstrap)
+    fidx = feature_subsets(jax.random.fold_in(key, 1), F, F, T)
+    tk = per_tree_keys(jax.random.fold_in(key, 2), T)
+    h = height_limit(S)
+    forest = grow_forest(tk, X, bag, fidx, h)
+    return forest, S, h
+
+
+def _grow_ext(X, T=10, S=64, seed=0, level=None):
+    N, F = X.shape
+    S = min(S, N)
+    level = F - 1 if level is None else level
+    key = jax.random.PRNGKey(seed)
+    bag = bagged_indices(jax.random.fold_in(key, 0), N, S, T, False)
+    fidx = feature_subsets(jax.random.fold_in(key, 1), F, F, T)
+    tk = per_tree_keys(jax.random.fold_in(key, 2), T)
+    h = height_limit(S)
+    forest = grow_extended_forest(tk, X, bag, fidx, h, level)
+    return forest, S, h
+
+
+def _rng_data(n=500, f=5, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(n, f)).astype(np.float32)
+
+
+class TestStandardStructure:
+    def test_heap_invariants(self):
+        forest, S, h = _grow(_rng_data(), T=20, S=64)
+        feat = np.asarray(forest.feature)
+        ni = np.asarray(forest.num_instances)
+        internal = feat >= 0
+        leaf = ni >= 0
+        exists = internal | leaf
+        # disjoint roles; root exists
+        assert not np.any(internal & leaf)
+        assert np.all(exists[:, 0])
+        M = feat.shape[1]
+        for t in range(feat.shape[0]):
+            for i in range(M):
+                li, ri = 2 * i + 1, 2 * i + 2
+                if internal[t, i]:
+                    assert li < M and exists[t, li] and exists[t, ri]
+                else:
+                    if li < M:
+                        assert not exists[t, li] and not exists[t, ri]
+
+    def test_leaf_instances_sum_to_num_samples(self):
+        forest, S, _ = _grow(_rng_data(), T=20, S=64)
+        ni = np.asarray(forest.num_instances)
+        sums = np.where(ni >= 0, ni, 0).sum(axis=1)
+        np.testing.assert_array_equal(sums, np.full(forest.num_trees, S))
+
+    def test_deterministic_under_seed(self):
+        X = _rng_data()
+        f1, _, _ = _grow(X, T=5, S=64, seed=3)
+        f2, _, _ = _grow(X, T=5, S=64, seed=3)
+        for a, b in zip(f1, f2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        f3, _, _ = _grow(X, T=5, S=64, seed=4)
+        assert not np.array_equal(np.asarray(f1.feature), np.asarray(f3.feature))
+
+    def test_all_constant_features_root_is_leaf(self):
+        # standard IF: no splittable feature -> terminate (IsolationTree.scala:155)
+        X = np.full((100, 4), 3.25, np.float32)
+        forest, S, _ = _grow(X, T=5, S=32)
+        feat = np.asarray(forest.feature)
+        ni = np.asarray(forest.num_instances)
+        assert np.all(feat[:, 0] == -1)
+        np.testing.assert_array_equal(ni[:, 0], np.full(5, S))
+
+    def test_constant_feature_never_chosen(self):
+        # the retry loop skips min==max features (IsolationTree.scala:135-148)
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(400, 3)).astype(np.float32)
+        X[:, 1] = 7.0
+        forest, _, _ = _grow(X, T=20, S=64)
+        feat = np.asarray(forest.feature)
+        assert not np.any(feat == 1)
+        assert np.any(feat == 0) and np.any(feat == 2)
+
+    def test_thresholds_within_feature_range(self):
+        X = _rng_data(300, 4)
+        forest, _, _ = _grow(X, T=10, S=64)
+        feat = np.asarray(forest.feature)
+        thr = np.asarray(forest.threshold)
+        for t in range(10):
+            for i in np.nonzero(feat[t] >= 0)[0]:
+                f = feat[t, i]
+                assert X[:, f].min() <= thr[t, i] <= X[:, f].max()
+
+    def test_feature_subset_respected(self):
+        X = _rng_data(300, 8)
+        T, S = 15, 64
+        key = jax.random.PRNGKey(0)
+        bag = bagged_indices(jax.random.fold_in(key, 0), 300, S, T, False)
+        fidx = feature_subsets(jax.random.fold_in(key, 1), 8, 3, T)
+        tk = per_tree_keys(jax.random.fold_in(key, 2), T)
+        forest = grow_forest(tk, X, bag, fidx, height_limit(S))
+        feat = np.asarray(forest.feature)
+        fidx = np.asarray(fidx)
+        for t in range(T):
+            used = set(feat[t][feat[t] >= 0].tolist())
+            assert used <= set(fidx[t].tolist())
+
+
+def _numpy_standard_path(feature, threshold, num_instances, x):
+    """Pure-python pointer walk — the reference's tailrec pathLength
+    (IsolationTree.scala:213-229) as an oracle."""
+    node, depth = 0, 0
+    while feature[node] >= 0:
+        node = 2 * node + 1 + (0 if x[feature[node]] < threshold[node] else 1)
+        depth += 1
+    return depth + float(avg_path_length(num_instances[node]))
+
+
+def _numpy_extended_path(indices, weights, offset, num_instances, x):
+    """ExtendedIsolationTree.scala:333-355 oracle (float32 dot)."""
+    node, depth = 0, 0
+    while indices[node, 0] >= 0:
+        dot = np.float32(
+            np.sum(x[indices[node]].astype(np.float32) * weights[node])
+        )
+        node = 2 * node + 1 + (0 if dot < offset[node] else 1)
+        depth += 1
+    return depth + float(avg_path_length(num_instances[node]))
+
+
+class TestTraversal:
+    def test_differential_vs_numpy_oracle(self):
+        X = _rng_data(200, 5)
+        forest, S, _ = _grow(X, T=8, S=64)
+        got = np.asarray(standard_path_lengths(forest, X))
+        feat = np.asarray(forest.feature)
+        thr = np.asarray(forest.threshold)
+        ni = np.asarray(forest.num_instances)
+        want = np.array(
+            [
+                np.mean(
+                    [
+                        _numpy_standard_path(feat[t], thr[t], ni[t], X[i])
+                        for t in range(8)
+                    ]
+                )
+                for i in range(200)
+            ]
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_extended_differential_vs_numpy_oracle(self):
+        X = _rng_data(150, 4)
+        forest, S, _ = _grow_ext(X, T=6, S=64)
+        got = np.asarray(extended_path_lengths(forest, X))
+        idxs = np.asarray(forest.indices)
+        w = np.asarray(forest.weights)
+        off = np.asarray(forest.offset)
+        ni = np.asarray(forest.num_instances)
+        want = np.array(
+            [
+                np.mean(
+                    [
+                        _numpy_extended_path(idxs[t], w[t], off[t], ni[t], X[i])
+                        for t in range(6)
+                    ]
+                )
+                for i in range(150)
+            ]
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_hand_built_tree_golden_path_lengths(self):
+        """Analogue of IsolationTreeTest's hand-built 3-node tree with exact
+        expected path lengths (IsolationTreeTest.scala:20-42)."""
+        M = 3
+        forest = StandardForest(
+            feature=np.array([[0, -1, -1]], np.int32),
+            threshold=np.array([[0.5, 0.0, 0.0]], np.float32),
+            num_instances=np.array([[-1, 10, 100]], np.int32),
+        )
+        X = np.array([[0.2], [0.9]], np.float32)
+        got = np.asarray(standard_path_lengths(forest, X))
+        want = np.array(
+            [1.0 + float(avg_path_length(10)), 1.0 + float(avg_path_length(100))]
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        # golden numerics: 1 + c(10) = 4.7488806
+        assert got[0] == pytest.approx(4.7488806, abs=1e-4)
+
+
+class TestExtendedStructure:
+    def test_unit_norm_weights(self):
+        # L2-normalisation across seeds/levels (ExtendedIsolationTreeTest:147-195)
+        for seed in range(3):
+            forest, _, _ = _grow_ext(_rng_data(seed=seed), T=5, S=64, seed=seed)
+            internal = np.asarray(forest.indices)[..., 0] >= 0
+            norms = np.linalg.norm(np.asarray(forest.weights), axis=-1)
+            np.testing.assert_allclose(norms[internal], 1.0, atol=1e-5)
+
+    def test_extension_level_zero_is_axis_aligned(self):
+        # exactly one non-zero coordinate (ExtendedIsolationTreeTest:197-239)
+        forest, _, _ = _grow_ext(_rng_data(), T=5, S=64, level=0)
+        assert forest.k == 1
+        internal = np.asarray(forest.indices)[..., 0] >= 0
+        w = np.asarray(forest.weights)
+        assert np.all(np.abs(np.abs(w[internal, 0]) - 1.0) < 1e-6)
+
+    @pytest.mark.parametrize("level", [0, 1, 2, 3, 4])
+    def test_coordinate_count_per_level(self, level):
+        # k = min(level+1, F) coords, all within range (:241-293)
+        forest, _, _ = _grow_ext(_rng_data(f=5), T=4, S=32, level=level)
+        assert forest.k == min(level + 1, 5)
+        idxs = np.asarray(forest.indices)
+        internal = idxs[..., 0] >= 0
+        sel = idxs[internal]
+        assert np.all(sel >= 0) and np.all(sel < 5)
+        # sorted strictly ascending -> distinct coordinates
+        if sel.shape[1] > 1:
+            assert np.all(np.diff(sel, axis=1) > 0)
+
+    def test_zero_size_leaves_on_constant_data(self):
+        # EIF does NOT retry on degenerate splits: constant data yields empty
+        # left children as numInstances=0 leaves (ExtendedIsolationTree.scala:
+        # 234-236, ExtendedNodes.scala:32-35)
+        X = np.full((100, 3), 1.5, np.float32)
+        forest, S, _ = _grow_ext(X, T=5, S=32)
+        ni = np.asarray(forest.num_instances)
+        assert np.any(ni == 0)
+        # and scoring still works: avgPathLength(0) == 0 (:51-82)
+        pl = np.asarray(extended_path_lengths(forest, X[:3]))
+        assert np.all(np.isfinite(pl))
+
+    def test_leaf_instances_sum(self):
+        forest, S, _ = _grow_ext(_rng_data(), T=10, S=64)
+        ni = np.asarray(forest.num_instances)
+        sums = np.where(ni >= 0, ni, 0).sum(axis=1)
+        np.testing.assert_array_equal(sums, np.full(10, S))
+
+    def test_deterministic_under_seed(self):
+        X = _rng_data()
+        f1, _, _ = _grow_ext(X, T=4, S=64, seed=9)
+        f2, _, _ = _grow_ext(X, T=4, S=64, seed=9)
+        for a, b in zip(f1, f2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,190 @@
+"""Primitive-layer unit tests — mirrors the reference's pure-unit kernel tests
+(core/UtilsTest.scala:9-17 pins; params validators of
+IsolationForestParamsBase.scala; fraction/count resolution of
+SharedTrainLogic.scala:33-77)."""
+
+import numpy as np
+import pytest
+
+from isoforest_tpu.utils import (
+    ExtendedIsolationForestParams,
+    IsolationForestParams,
+    avg_path_length,
+    height_limit,
+    max_nodes_for,
+    resolve_extension_level,
+    resolve_params,
+    score_from_path_length,
+)
+
+
+class TestAvgPathLength:
+    """Golden pins from core/UtilsTest.scala:12-16."""
+
+    @pytest.mark.parametrize(
+        "n,expected",
+        [
+            (0, 0.0),
+            (1, 0.0),
+            (2, 0.15443134),
+            (10, 3.7488806),
+            (2**63 - 1, 86.49098),
+        ],
+    )
+    def test_golden_values(self, n, expected):
+        assert float(avg_path_length(n)) == pytest.approx(expected, abs=2e-5)
+
+    def test_vectorised(self):
+        out = np.asarray(avg_path_length(np.array([0, 1, 2, 10])))
+        assert out.shape == (4,)
+        assert out[0] == 0.0 and out[1] == 0.0
+        assert out[2] == pytest.approx(0.15443134, abs=1e-6)
+
+    def test_monotone(self):
+        ns = np.arange(2, 10000)
+        c = np.asarray(avg_path_length(ns))
+        assert np.all(np.diff(c) > 0)
+
+
+class TestHeightLimit:
+    def test_reference_default(self):
+        # 256 samples -> height 8 -> 511 heap slots (IsolationTree.scala:60-61)
+        assert height_limit(256) == 8
+        assert max_nodes_for(256) == 511
+
+    @pytest.mark.parametrize("n,h", [(2, 1), (3, 2), (4, 2), (5, 3), (1024, 10)])
+    def test_ceil_log2(self, n, h):
+        assert height_limit(n) == h
+
+
+class TestScore:
+    def test_score_at_mean_path_length_is_half(self):
+        # E[h] == c(n)  =>  score 0.5 (Liu et al.; IsolationForestModel.scala:135-138)
+        n = 256
+        c = float(avg_path_length(n))
+        assert float(score_from_path_length(c, n)) == pytest.approx(0.5, abs=1e-6)
+
+    def test_short_paths_score_high(self):
+        assert float(score_from_path_length(0.0, 256)) == pytest.approx(1.0)
+        assert float(score_from_path_length(100.0, 256)) < 0.01
+
+
+class TestParamValidators:
+    """IsolationForestParamsBase.scala:10-96 validator parity."""
+
+    def test_defaults(self):
+        p = IsolationForestParams()
+        assert p.num_estimators == 100
+        assert p.max_samples == 256.0
+        assert p.contamination == 0.0
+        assert p.contamination_error == 0.0
+        assert p.max_features == 1.0
+        assert p.bootstrap is False
+        assert p.random_seed == 1
+        assert p.features_col == "features"
+        assert p.prediction_col == "predictedLabel"
+        assert p.score_col == "outlierScore"
+
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            dict(num_estimators=0),
+            dict(num_estimators=-5),
+            dict(max_samples=0.0),
+            dict(max_samples=-1.0),
+            dict(contamination=0.5),
+            dict(contamination=-0.01),
+            dict(contamination_error=-0.1),
+            dict(contamination_error=1.5),
+            dict(max_features=0.0),
+            dict(bootstrap=1),
+        ],
+    )
+    def test_rejects(self, kw):
+        with pytest.raises(ValueError):
+            IsolationForestParams(**kw)
+
+    def test_extension_level_validator(self):
+        with pytest.raises(ValueError):
+            ExtendedIsolationForestParams(extension_level=-1)
+        assert ExtendedIsolationForestParams().extension_level is None
+
+    def test_param_map_round_trip(self):
+        p = IsolationForestParams(num_estimators=7, contamination=0.1, bootstrap=True)
+        m = p.to_param_map()
+        assert m["numEstimators"] == 7
+        assert m["maxSamples"] == 256.0  # persisted as double
+        assert IsolationForestParams.from_param_map(m) == p
+
+    def test_extended_param_map_round_trip(self):
+        p = ExtendedIsolationForestParams(extension_level=3)
+        m = p.to_param_map()
+        assert m["extensionLevel"] == 3
+        assert ExtendedIsolationForestParams.from_param_map(m) == p
+
+
+class TestResolveParams:
+    """Fraction-vs-count semantics (SharedTrainLogic.scala:33-77)."""
+
+    def test_count_semantics(self):
+        p = IsolationForestParams(max_samples=256.0, max_features=3.0)
+        r = resolve_params(p, total_num_features=6, total_num_samples=10000)
+        assert r.num_samples == 256
+        assert r.num_features == 3
+
+    def test_fraction_semantics(self):
+        p = IsolationForestParams(max_samples=0.5, max_features=0.5)
+        r = resolve_params(p, total_num_features=6, total_num_samples=1000)
+        assert r.num_samples == 500
+        assert r.num_features == 3
+
+    def test_max_features_one_is_all(self):
+        p = IsolationForestParams(max_features=1.0)
+        r = resolve_params(p, total_num_features=9, total_num_samples=100)
+        assert r.num_features == 9
+
+    def test_num_samples_one_throws(self):
+        # the reference's maxSamples -> 1 throw (IsolationForestTest.scala:241-266)
+        with pytest.raises(ValueError):
+            # fraction resolving to a single sample
+            resolve_params(
+                IsolationForestParams(max_samples=0.001),
+                total_num_features=3,
+                total_num_samples=1000,
+            )
+        with pytest.raises(ValueError):
+            # count semantics: floor(1.5) == 1
+            resolve_params(
+                IsolationForestParams(max_samples=1.5),
+                total_num_features=3,
+                total_num_samples=1000,
+            )
+
+    def test_num_samples_capped_at_total(self):
+        p = IsolationForestParams(max_samples=5000.0)
+        r = resolve_params(p, total_num_features=3, total_num_samples=100)
+        assert r.num_samples == 100
+
+    def test_num_features_exceeds_total_throws(self):
+        p = IsolationForestParams(max_features=10.0)
+        with pytest.raises(ValueError):
+            resolve_params(p, total_num_features=6, total_num_samples=100)
+
+    def test_empty_dataset_throws(self):
+        with pytest.raises(ValueError):
+            resolve_params(IsolationForestParams(), 6, 0)
+
+
+class TestResolveExtensionLevel:
+    """ExtendedIsolationForest.scala:56-69."""
+
+    def test_default_is_fully_extended(self):
+        assert resolve_extension_level(None, 6) == 5
+
+    def test_user_value_validated(self):
+        assert resolve_extension_level(2, 6) == 2
+        with pytest.raises(ValueError):
+            resolve_extension_level(6, 6)
+
+    def test_axis_aligned_level_zero(self):
+        assert resolve_extension_level(0, 6) == 0
