@@ -1,0 +1,486 @@
+"""Model persistence in the reference's exact on-disk layout.
+
+Layout (IsolationForestModelReadWrite.scala:210-325 and
+core/IsolationForestModelReadWriteUtils.scala:28-188):
+
+    <path>/metadata/part-00000   single-line JSON: {class, timestamp,
+                                 sparkVersion, uid, paramMap, <extras>}
+    <path>/metadata/_SUCCESS
+    <path>/data/part-00000-<uuid>-c000.avro   node table (one row per node)
+    <path>/data/_SUCCESS
+
+Node rows are ``(treeID, nodeData)`` with **pre-order** ids and ``-1`` null
+sentinels (NodeData.build, IsolationForestModelReadWrite.scala:82-132;
+extended variant ExtendedIsolationForestModelReadWrite.scala:59-67 with empty
+arrays + 0.0 sentinels for leaves). The heap-tensor forest is converted to
+pre-order on write and rebuilt on read, so models interoperate both ways with
+the reference implementation and its ONNX converter, including the committed
+Spark-era golden fixtures (snappy codec, loaded via :mod:`.avro`).
+
+Legacy models without ``totalNumFeatures`` load with the ``-1`` sentinel and a
+warning (IsolationForestModelReadWrite.scala:298-306).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+import uuid
+from typing import List, Tuple
+
+import numpy as np
+
+from ..ops.ext_growth import ExtendedForest
+from ..ops.tree_growth import StandardForest
+from ..utils import logger
+from ..utils.params import ExtendedIsolationForestParams, IsolationForestParams
+from ..utils.validation import UNKNOWN_TOTAL_NUM_FEATURES
+from . import avro
+
+SPARK_VERSION_STRING = "3.5.5"  # layout-compat version tag written to metadata
+
+STANDARD_MODEL_CLASS = "com.linkedin.relevance.isolationforest.IsolationForestModel"
+EXTENDED_MODEL_CLASS = (
+    "com.linkedin.relevance.isolationforest.extended.ExtendedIsolationForestModel"
+)
+STANDARD_ESTIMATOR_CLASS = "com.linkedin.relevance.isolationforest.IsolationForest"
+EXTENDED_ESTIMATOR_CLASS = (
+    "com.linkedin.relevance.isolationforest.extended.ExtendedIsolationForest"
+)
+
+# Schemas matching what spark-avro emits for the reference's node tables.
+STANDARD_SCHEMA = {
+    "type": "record",
+    "name": "topLevelRecord",
+    "fields": [
+        {"name": "treeID", "type": "int"},
+        {
+            "name": "nodeData",
+            "type": [
+                {
+                    "type": "record",
+                    "name": "nodeData",
+                    "namespace": ".nodeData",
+                    "fields": [
+                        {"name": "id", "type": "int"},
+                        {"name": "leftChild", "type": "int"},
+                        {"name": "rightChild", "type": "int"},
+                        {"name": "splitAttribute", "type": "int"},
+                        {"name": "splitValue", "type": "double"},
+                        {"name": "numInstances", "type": "long"},
+                    ],
+                },
+                "null",
+            ],
+        },
+    ],
+}
+
+EXTENDED_SCHEMA = {
+    "type": "record",
+    "name": "topLevelRecord",
+    "fields": [
+        {"name": "treeID", "type": "int"},
+        {
+            "name": "extendedNodeData",
+            "type": [
+                {
+                    "type": "record",
+                    "name": "extendedNodeData",
+                    "namespace": "topLevelRecord",
+                    "fields": [
+                        {"name": "id", "type": "int"},
+                        {"name": "leftChild", "type": "int"},
+                        {"name": "rightChild", "type": "int"},
+                        {"name": "indices", "type": [{"type": "array", "items": "int"}, "null"]},
+                        {"name": "weights", "type": [{"type": "array", "items": "float"}, "null"]},
+                        {"name": "offset", "type": "double"},
+                        {"name": "numInstances", "type": "long"},
+                    ],
+                },
+                "null",
+            ],
+        },
+    ],
+}
+
+
+# --------------------------------------------------------------------------- #
+# heap <-> pre-order conversion
+# --------------------------------------------------------------------------- #
+
+
+def standard_tree_to_records(feature, threshold, num_instances) -> List[dict]:
+    """One tree's heap arrays -> pre-order NodeData dicts
+    (sentinels per IsolationForestModelReadWrite.scala:36-67)."""
+    records: List[dict] = []
+
+    def walk(slot: int) -> int:
+        my_id = len(records)
+        records.append(None)  # reserve pre-order position
+        if feature[slot] >= 0:
+            left = walk(2 * slot + 1)
+            right = walk(2 * slot + 2)
+            records[my_id] = {
+                "id": my_id,
+                "leftChild": left,
+                "rightChild": right,
+                "splitAttribute": int(feature[slot]),
+                "splitValue": float(threshold[slot]),
+                "numInstances": -1,
+            }
+        else:
+            records[my_id] = {
+                "id": my_id,
+                "leftChild": -1,
+                "rightChild": -1,
+                "splitAttribute": -1,
+                "splitValue": 0.0,
+                "numInstances": int(num_instances[slot]),
+            }
+        return my_id
+
+    walk(0)
+    return records
+
+
+def extended_tree_to_records(indices, weights, offset, num_instances) -> List[dict]:
+    """EIF heap arrays -> pre-order ExtendedNodeData dicts (leaf sentinels:
+    empty arrays + 0.0, ExtendedIsolationForestModelReadWrite.scala:33-35)."""
+    records: List[dict] = []
+
+    def walk(slot: int) -> int:
+        my_id = len(records)
+        records.append(None)
+        if indices[slot, 0] >= 0:
+            left = walk(2 * slot + 1)
+            right = walk(2 * slot + 2)
+            valid = indices[slot] >= 0  # drop (-1, 0.0) padding entries
+            records[my_id] = {
+                "id": my_id,
+                "leftChild": left,
+                "rightChild": right,
+                "indices": [int(v) for v in indices[slot][valid]],
+                "weights": [float(v) for v in weights[slot][valid]],
+                "offset": float(offset[slot]),
+                "numInstances": -1,
+            }
+        else:
+            records[my_id] = {
+                "id": my_id,
+                "leftChild": -1,
+                "rightChild": -1,
+                "indices": [],
+                "weights": [],
+                "offset": 0.0,
+                "numInstances": int(num_instances[slot]),
+            }
+        return my_id
+
+    walk(0)
+    return records
+
+
+def _assign_heap_slots(records: List[dict]) -> Tuple[dict, int]:
+    """Pre-order records -> {node id: heap slot}; validates contiguous ids
+    (the reference's buildTreeFromNodes contract,
+    IsolationForestModelReadWrite.scala:179-205)."""
+    by_id = {r["id"]: r for r in records}
+    if sorted(by_id) != list(range(len(records))):
+        raise ValueError("corrupt model data: node ids are not 0..N-1")
+    slots: dict = {}
+    max_depth = 0
+    stack = [(0, 0, 0)]  # (node id, heap slot, depth)
+    while stack:
+        rid, slot, depth = stack.pop()
+        slots[rid] = slot
+        max_depth = max(max_depth, depth)
+        r = by_id[rid]
+        if r["leftChild"] >= 0:
+            stack.append((r["leftChild"], 2 * slot + 1, depth + 1))
+            stack.append((r["rightChild"], 2 * slot + 2, depth + 1))
+    return slots, max_depth
+
+
+def records_to_standard_forest(trees: List[List[dict]]) -> StandardForest:
+    depths = []
+    slot_maps = []
+    for records in trees:
+        slots, depth = _assign_heap_slots(records)
+        slot_maps.append(slots)
+        depths.append(depth)
+    height = max(depths) if depths else 0
+    M = 2 ** (height + 1) - 1
+    T = len(trees)
+    feature = np.full((T, M), -1, np.int32)
+    threshold = np.zeros((T, M), np.float32)
+    num_instances = np.full((T, M), -1, np.int32)
+    for t, records in enumerate(trees):
+        slots = slot_maps[t]
+        for r in records:
+            slot = slots[r["id"]]
+            if r["leftChild"] >= 0:
+                feature[t, slot] = r["splitAttribute"]
+                threshold[t, slot] = r["splitValue"]
+            else:
+                num_instances[t, slot] = r["numInstances"]
+    return StandardForest(
+        feature=feature, threshold=threshold, num_instances=num_instances
+    )
+
+
+def records_to_extended_forest(trees: List[List[dict]]) -> ExtendedForest:
+    depths = []
+    slot_maps = []
+    k = 1
+    for records in trees:
+        slots, depth = _assign_heap_slots(records)
+        slot_maps.append(slots)
+        depths.append(depth)
+        for r in records:
+            if r["leftChild"] >= 0:
+                k = max(k, len(r["indices"]))
+    height = max(depths) if depths else 0
+    M = 2 ** (height + 1) - 1
+    T = len(trees)
+    indices = np.full((T, M, k), -1, np.int32)
+    weights = np.zeros((T, M, k), np.float32)
+    offset = np.zeros((T, M), np.float32)
+    num_instances = np.full((T, M), -1, np.int32)
+    for t, records in enumerate(trees):
+        slots = slot_maps[t]
+        for r in records:
+            slot = slots[r["id"]]
+            if r["leftChild"] >= 0:
+                nk = len(r["indices"])
+                indices[t, slot, :nk] = r["indices"]
+                weights[t, slot, :nk] = r["weights"]
+                offset[t, slot] = r["offset"]
+            else:
+                num_instances[t, slot] = r["numInstances"]
+    return ExtendedForest(
+        indices=indices, weights=weights, offset=offset, num_instances=num_instances
+    )
+
+
+# --------------------------------------------------------------------------- #
+# directory layout helpers
+# --------------------------------------------------------------------------- #
+
+
+def _prepare_dir(path: str, overwrite: bool) -> None:
+    if os.path.exists(path):
+        if not overwrite:
+            raise FileExistsError(
+                f"path {path} already exists; pass overwrite=True to replace"
+            )
+        shutil.rmtree(path)
+    os.makedirs(os.path.join(path, "metadata"))
+
+
+def _write_metadata(path: str, metadata: dict) -> None:
+    with open(os.path.join(path, "metadata", "part-00000"), "w") as fh:
+        fh.write(json.dumps(metadata, separators=(",", ":")))
+        fh.write("\n")
+    open(os.path.join(path, "metadata", "_SUCCESS"), "w").close()
+
+
+def _read_metadata(path: str) -> dict:
+    # first line of the metadata file (loadMetadata,
+    # core/IsolationForestModelReadWriteUtils.scala:97-104)
+    meta_dir = os.path.join(path, "metadata")
+    part = os.path.join(meta_dir, "part-00000")
+    if not os.path.exists(part):
+        parts = sorted(
+            f for f in os.listdir(meta_dir) if f.startswith("part-")
+        )
+        if not parts:
+            raise FileNotFoundError(f"no metadata part files under {meta_dir}")
+        part = os.path.join(meta_dir, parts[0])
+    with open(part) as fh:
+        return json.loads(fh.readline())
+
+
+def _write_data(path: str, schema: dict, records: List[dict]) -> None:
+    data_dir = os.path.join(path, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    fname = f"part-00000-{uuid.uuid4()}-c000.avro"
+    avro.write_container(os.path.join(data_dir, fname), schema, records)
+    open(os.path.join(data_dir, "_SUCCESS"), "w").close()
+
+
+def _read_data(path: str) -> List[dict]:
+    data_dir = os.path.join(path, "data")
+    records: List[dict] = []
+    for fname in sorted(os.listdir(data_dir)):
+        if fname.endswith(".avro"):
+            _, recs = avro.read_container(os.path.join(data_dir, fname))
+            records.extend(recs)
+    if not records:
+        raise FileNotFoundError(f"no avro data files under {data_dir}")
+    return records
+
+
+def _group_trees(records: List[dict], payload_field: str) -> List[List[dict]]:
+    """groupByKey(treeID) + sortByKey equivalent
+    (IsolationForestModelReadWrite.scala:282-288)."""
+    trees: dict = {}
+    for rec in records:
+        trees.setdefault(rec["treeID"], []).append(rec[payload_field])
+    tree_ids = sorted(trees)
+    if tree_ids != list(range(len(tree_ids))):
+        raise ValueError("corrupt model data: treeIDs are not contiguous 0..T-1")
+    return [sorted(trees[t], key=lambda r: r["id"]) for t in tree_ids]
+
+
+def _check_class(metadata: dict, expected: str) -> None:
+    cls = metadata.get("class")
+    if cls != expected:
+        raise ValueError(
+            f"metadata class mismatch: expected {expected}, found {cls}"
+        )
+
+
+# --------------------------------------------------------------------------- #
+# model save / load
+# --------------------------------------------------------------------------- #
+
+
+def _model_metadata(model, class_name: str) -> dict:
+    return {
+        "class": class_name,
+        "timestamp": int(time.time() * 1000),
+        "sparkVersion": SPARK_VERSION_STRING,
+        "uid": model.uid,
+        "paramMap": model.params.to_param_map(),
+        # extras (IsolationForestModelReadWrite.scala:220-224)
+        "outlierScoreThreshold": model.outlier_score_threshold
+        if model.outlier_score_threshold >= 0
+        else -1.0,
+        "numSamples": model.num_samples,
+        "numFeatures": model.num_features,
+        "totalNumFeatures": model.total_num_features,
+    }
+
+
+def save_standard_model(model, path: str, overwrite: bool = False) -> None:
+    _prepare_dir(path, overwrite)
+    _write_metadata(path, _model_metadata(model, STANDARD_MODEL_CLASS))
+    feature = np.asarray(model.forest.feature)
+    threshold = np.asarray(model.forest.threshold)
+    num_instances = np.asarray(model.forest.num_instances)
+    records = []
+    for t in range(model.forest.num_trees):
+        for node in standard_tree_to_records(feature[t], threshold[t], num_instances[t]):
+            records.append({"treeID": t, "nodeData": node})
+    _write_data(path, STANDARD_SCHEMA, records)
+    logger.info("saved IsolationForestModel (%d trees) to %s", len(feature), path)
+
+
+def save_extended_model(model, path: str, overwrite: bool = False) -> None:
+    _prepare_dir(path, overwrite)
+    meta = _model_metadata(model, EXTENDED_MODEL_CLASS)
+    # resolved extensionLevel always persists on the model (even when the
+    # estimator left it unset — ExtendedIsolationForest.scala:102)
+    meta["paramMap"]["extensionLevel"] = int(model.extension_level)
+    _write_metadata(path, meta)
+    indices = np.asarray(model.forest.indices)
+    weights = np.asarray(model.forest.weights)
+    offset = np.asarray(model.forest.offset)
+    num_instances = np.asarray(model.forest.num_instances)
+    records = []
+    for t in range(model.forest.num_trees):
+        for node in extended_tree_to_records(
+            indices[t], weights[t], offset[t], num_instances[t]
+        ):
+            records.append({"treeID": t, "extendedNodeData": node})
+    _write_data(path, EXTENDED_SCHEMA, records)
+    logger.info("saved ExtendedIsolationForestModel (%d trees) to %s", len(indices), path)
+
+
+def _load_common(path: str, expected_class: str):
+    metadata = _read_metadata(path)
+    _check_class(metadata, expected_class)
+    if "totalNumFeatures" in metadata:
+        total_num_features = int(metadata["totalNumFeatures"])
+    else:
+        # legacy fallback (IsolationForestModelReadWrite.scala:298-306)
+        logger.warning(
+            "loading legacy model without totalNumFeatures; feature-width "
+            "validation disabled (sentinel -1)"
+        )
+        total_num_features = UNKNOWN_TOTAL_NUM_FEATURES
+    return metadata, total_num_features
+
+
+def load_standard_model(path: str):
+    from ..models.isolation_forest import IsolationForestModel
+
+    metadata, total_num_features = _load_common(path, STANDARD_MODEL_CLASS)
+    params = IsolationForestParams.from_param_map(metadata["paramMap"])
+    trees = _group_trees(_read_data(path), "nodeData")
+    forest = records_to_standard_forest(trees)
+    model = IsolationForestModel(
+        forest=forest,
+        params=params,
+        num_samples=int(metadata["numSamples"]),
+        num_features=int(metadata["numFeatures"]),
+        total_num_features=total_num_features,
+        uid=metadata.get("uid"),
+    )
+    threshold = float(metadata.get("outlierScoreThreshold", -1.0))
+    if threshold >= 0:
+        model.set_outlier_score_threshold(threshold)
+    return model
+
+
+def load_extended_model(path: str):
+    from ..models.extended import ExtendedIsolationForestModel
+
+    metadata, total_num_features = _load_common(path, EXTENDED_MODEL_CLASS)
+    params = ExtendedIsolationForestParams.from_param_map(metadata["paramMap"])
+    trees = _group_trees(_read_data(path), "extendedNodeData")
+    forest = records_to_extended_forest(trees)
+    model = ExtendedIsolationForestModel(
+        forest=forest,
+        params=params,
+        num_samples=int(metadata["numSamples"]),
+        num_features=int(metadata["numFeatures"]),
+        extension_level=int(params.extension_level)
+        if params.extension_level is not None
+        else forest.k - 1,
+        total_num_features=total_num_features,
+        uid=metadata.get("uid"),
+    )
+    threshold = float(metadata.get("outlierScoreThreshold", -1.0))
+    if threshold >= 0:
+        model.set_outlier_score_threshold(threshold)
+    return model
+
+
+# --------------------------------------------------------------------------- #
+# estimator save / load (params-only metadata, IsolationForest.scala:114-125)
+# --------------------------------------------------------------------------- #
+
+
+def save_estimator(estimator, path: str, class_name: str, overwrite: bool = False) -> None:
+    _prepare_dir(path, overwrite)
+    metadata = {
+        "class": class_name,
+        "timestamp": int(time.time() * 1000),
+        "sparkVersion": SPARK_VERSION_STRING,
+        "uid": estimator.uid,
+        "paramMap": estimator.params.to_param_map(),
+    }
+    _write_metadata(path, metadata)
+
+
+def load_estimator(path: str, params_cls):
+    metadata = _read_metadata(path)
+    cls = metadata.get("class")
+    if cls not in (STANDARD_ESTIMATOR_CLASS, EXTENDED_ESTIMATOR_CLASS):
+        raise ValueError(f"unexpected estimator class {cls!r}")
+    params = params_cls.from_param_map(metadata["paramMap"])
+    return params, metadata.get("uid")

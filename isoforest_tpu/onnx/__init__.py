@@ -1,0 +1,4 @@
+from .converter import IsolationForestConverter, convert_and_save
+from . import proto, runtime
+
+__all__ = ["IsolationForestConverter", "convert_and_save", "proto", "runtime"]

@@ -1,0 +1,191 @@
+"""ONNX export of standard isolation-forest models.
+
+Capability parity with the reference's Python converter module
+(``isolation-forest-onnx/src/isolationforestonnx/isolation_forest_converter.py``):
+the persisted model (metadata JSON + Avro node table) becomes an ONNX graph
+
+    features --ai.onnx.ml.TreeEnsembleRegressor--> expected path length E[h]
+             --Div(c(n))--Neg--Pow(2,.)--> outlierScore
+             --Less(threshold)--Not--Cast--> predictedLabel (int32)
+
+mirroring the reference graph topology (converter :177-341): the regressor
+aggregates with ``AVERAGE``, branch mode ``BRANCH_LT`` so the *true* branch is
+``x < splitValue`` = left child, and each leaf's target weight is
+``depth + avg_path_length(numInstances)`` with depth recomputed from the
+pre-order parent map (:361-373). Standard models only — same restriction as
+the reference (the ONNX tree ensemble cannot express hyperplane splits).
+
+Opsets: ``ai.onnx.ml`` v1 + core v14, ``ir_version`` 10 (:156-166).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..io.persistence import (
+    STANDARD_MODEL_CLASS,
+    _read_data,
+    _read_metadata,
+    _group_trees,
+)
+from . import proto
+
+_EULER = 0.5772156649
+
+
+def _avg_path_len(n: int) -> float:
+    """float64 normaliser, like the reference converter's _get_avg_path_len
+    (:343-360); cast to f32 at attribute-encode time."""
+    if n <= 1:
+        return 0.0
+    return 2.0 * (math.log(n - 1.0) + _EULER) - 2.0 * (n - 1.0) / n
+
+
+def _node_depths(records: List[dict]) -> Dict[int, int]:
+    """Depth per node id from the pre-order parent map (converter :361-373)."""
+    depths = {0: 0}
+    for r in records:
+        if r["leftChild"] >= 0:
+            depths[r["leftChild"]] = depths[r["id"]] + 1
+            depths[r["rightChild"]] = depths[r["id"]] + 1
+    return depths
+
+
+class IsolationForestConverter:
+    """Convert a persisted standard model directory to ONNX bytes.
+
+    Accepts the reference's on-disk layout (so it can convert models written
+    by the Spark implementation too) — the same coupling surface as the
+    reference's converter, which reads metadata JSON + Avro node rows.
+    """
+
+    def __init__(self, model_path: str):
+        metadata = _read_metadata(model_path)
+        if metadata.get("class") != STANDARD_MODEL_CLASS:
+            raise ValueError(
+                "ONNX conversion supports the standard IsolationForestModel only "
+                f"(got class {metadata.get('class')!r}) — hyperplane splits of the "
+                "extended model cannot be expressed as an ONNX tree ensemble"
+            )
+        self._metadata = metadata
+        self._trees = _group_trees(_read_data(model_path), "nodeData")
+        self.num_features = int(metadata["numFeatures"])
+        self.num_samples = int(metadata["numSamples"])
+        self.threshold = float(metadata.get("outlierScoreThreshold", -1.0))
+
+    # ------------------------------------------------------------------ #
+
+    def _tree_ensemble_attrs(self) -> List[bytes]:
+        treeids: List[int] = []
+        nodeids: List[int] = []
+        featureids: List[int] = []
+        modes: List[str] = []
+        values: List[float] = []
+        true_ids: List[int] = []
+        false_ids: List[int] = []
+        missing: List[int] = []
+        t_treeids: List[int] = []
+        t_nodeids: List[int] = []
+        t_ids: List[int] = []
+        t_weights: List[float] = []
+
+        for tree_id, records in enumerate(self._trees):
+            depths = _node_depths(records)
+            for r in records:
+                treeids.append(tree_id)
+                nodeids.append(r["id"])
+                missing.append(0)
+                if r["leftChild"] >= 0:
+                    featureids.append(r["splitAttribute"])
+                    modes.append("BRANCH_LT")  # true branch: x < split -> left
+                    values.append(float(r["splitValue"]))
+                    true_ids.append(r["leftChild"])
+                    false_ids.append(r["rightChild"])
+                else:
+                    featureids.append(0)
+                    modes.append("LEAF")
+                    values.append(0.0)
+                    true_ids.append(0)
+                    false_ids.append(0)
+                    t_treeids.append(tree_id)
+                    t_nodeids.append(r["id"])
+                    t_ids.append(0)
+                    t_weights.append(
+                        depths[r["id"]] + _avg_path_len(int(r["numInstances"]))
+                    )
+
+        return [
+            proto.attribute("aggregate_function", "AVERAGE"),
+            proto.attribute("n_targets", 1),
+            proto.attribute("nodes_falsenodeids", false_ids),
+            proto.attribute("nodes_featureids", featureids),
+            proto.attribute("nodes_hitrates", [1.0] * len(nodeids)),
+            proto.attribute("nodes_missing_value_tracks_true", missing),
+            proto.attribute("nodes_modes", modes),
+            proto.attribute("nodes_nodeids", nodeids),
+            proto.attribute("nodes_treeids", treeids),
+            proto.attribute("nodes_truenodeids", true_ids),
+            proto.attribute("nodes_values", values),
+            proto.attribute("post_transform", "NONE"),
+            proto.attribute("target_ids", t_ids),
+            proto.attribute("target_nodeids", t_nodeids),
+            proto.attribute("target_treeids", t_treeids),
+            proto.attribute("target_weights", t_weights),
+        ]
+
+    def convert(self) -> bytes:
+        """Build the serialized ModelProto."""
+        c_n = float(np.float32(_avg_path_len(self.num_samples)))
+        # threshold < 0 (unset) -> labels must be all zero, like
+        # IsolationForestModel.transform (:142-148): use a sentinel above the
+        # score range so Less() is always true -> Not -> 0.
+        thr = self.threshold if self.threshold > 0 else 2.0
+
+        nodes = [
+            proto.node(
+                "TreeEnsembleRegressor",
+                ["features"],
+                ["expectedPathLength"],
+                name="treeEnsemble",
+                domain="ai.onnx.ml",
+                attributes=self._tree_ensemble_attrs(),
+            ),
+            proto.node("Div", ["expectedPathLength", "cN"], ["normalizedPathLength"]),
+            proto.node("Neg", ["normalizedPathLength"], ["negatedPathLength"]),
+            proto.node("Pow", ["two", "negatedPathLength"], ["outlierScore"]),
+            proto.node("Less", ["outlierScore", "scoreThreshold"], ["isInlier"]),
+            proto.node("Not", ["isInlier"], ["isOutlier"]),
+            proto.node(
+                "Cast",
+                ["isOutlier"],
+                ["predictedLabel"],
+                attributes=[proto.attribute("to", proto.INT32)],
+            ),
+        ]
+        graph = proto.graph(
+            nodes,
+            name="isolationForest",
+            inputs=[proto.value_info("features", proto.FLOAT, ["batch", self.num_features])],
+            outputs=[
+                proto.value_info("outlierScore", proto.FLOAT, ["batch", 1]),
+                proto.value_info("predictedLabel", proto.INT32, ["batch", 1]),
+            ],
+            initializers=[
+                proto.tensor_f32("cN", [c_n]),
+                proto.tensor_f32("two", [2.0]),
+                proto.tensor_f32("scoreThreshold", [thr]),
+            ],
+        )
+        return proto.model(graph, opset_imports=[("ai.onnx.ml", 1), ("", 14)])
+
+    def convert_and_save(self, output_path: str) -> None:
+        with open(output_path, "wb") as fh:
+            fh.write(self.convert())
+
+
+def convert_and_save(model_path: str, output_path: str) -> None:
+    IsolationForestConverter(model_path).convert_and_save(output_path)

@@ -52,6 +52,7 @@ def sharded_grow_forest(mesh, tree_keys, X, bag_idx, feat_idx, height: int):
             mesh=mesh,
             in_specs=(tree_spec, P(), tree_spec, tree_spec),
             out_specs=StandardForest(tree_spec, tree_spec, tree_spec),
+            check_vma=False,
         )
     )
     forest = f(tree_keys, X, bag_idx, feat_idx)
@@ -78,6 +79,7 @@ def sharded_grow_extended_forest(
             mesh=mesh,
             in_specs=(tree_spec, P(), tree_spec, tree_spec),
             out_specs=ExtendedForest(tree_spec, tree_spec, tree_spec, tree_spec),
+            check_vma=False,
         )
     )
     forest = f(tree_keys, X, bag_idx, feat_idx)
@@ -106,6 +108,7 @@ def sharded_score(mesh, forest, X, num_samples: int) -> np.ndarray:
             mesh=mesh,
             in_specs=(forest_spec, row_spec),
             out_specs=P((DATA_AXIS, TREES_AXIS)),
+            check_vma=False,
         )
     )
     scores = f(forest, Xp)

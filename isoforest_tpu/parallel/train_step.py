@@ -74,6 +74,7 @@ def make_train_step(
         mesh=mesh,
         in_specs=(tree_spec, P(), tree_spec, tree_spec),
         out_specs=forest_specs,
+        check_vma=False,
     )
 
     def score_local(forest_rep, x_local):
@@ -84,6 +85,7 @@ def make_train_step(
         mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P(), forest_specs), row_spec),
         out_specs=P((DATA_AXIS, TREES_AXIS)),
+        check_vma=False,
     )
 
     @jax.jit

@@ -1,0 +1,157 @@
+"""End-to-end quality gates on real data — the reference's AUROC-tolerance
+acceptance layer (IsolationForestTest.scala:47-266,
+extended/ExtendedIsolationForestTest.scala:15-373)."""
+
+import numpy as np
+import pytest
+
+from isoforest_tpu import (
+    ExtendedIsolationForest,
+    IsolationForest,
+)
+
+
+class TestStandardQualityGates:
+    def test_mammography_auroc(self, mammography, auroc_fn):
+        """100 trees / 256 samples -> AUROC 0.86 +/- 0.02
+        (IsolationForestTest.scala:78-86)."""
+        X, y = mammography
+        model = IsolationForest(contamination=0.02, random_seed=1).fit(X)
+        scores = model.score(X)
+        assert auroc_fn(scores, y) == pytest.approx(0.86, abs=0.02)
+
+    def test_mammography_exact_contamination(self, mammography):
+        """contaminationError=0 -> exact quantile; observed contamination must
+        match the request almost exactly (IsolationForestTest exact variant)."""
+        X, y = mammography
+        model = IsolationForest(
+            contamination=0.02, contamination_error=0.0, random_seed=1
+        ).fit(X)
+        labels = model.transform(X)["predictedLabel"]
+        observed = labels.mean()
+        assert observed == pytest.approx(0.02, abs=0.001)
+
+    def test_shuttle_auroc_and_score_means(self, shuttle, auroc_fn):
+        """Shuttle: AUROC > 0.99; outlier/inlier mean scores 0.61/0.41 +/- 0.02
+        (IsolationForestTest.scala:170-239)."""
+        X, y = shuttle
+        model = IsolationForest(contamination=0.07, random_seed=1).fit(X)
+        scores = model.score(X)
+        assert auroc_fn(scores, y) > 0.99
+        assert scores[y == 1].mean() == pytest.approx(0.61, abs=0.02)
+        assert scores[y == 0].mean() == pytest.approx(0.41, abs=0.02)
+
+    def test_zero_contamination_all_labels_zero(self, mammography):
+        """contamination=0 -> threshold unset -> every label 0.0
+        (IsolationForestTest.scala:132-168)."""
+        X, _ = mammography
+        model = IsolationForest(contamination=0.0, random_seed=1).fit(X)
+        assert model.outlier_score_threshold == -1.0
+        out = model.transform(X)
+        assert np.all(out["predictedLabel"] == 0.0)
+
+    def test_bootstrap_mode(self, mammography, auroc_fn):
+        X, y = mammography
+        model = IsolationForest(
+            num_estimators=50, bootstrap=True, random_seed=1
+        ).fit(X)
+        assert auroc_fn(model.score(X), y) > 0.8
+
+    def test_max_samples_one_throws(self, mammography):
+        """maxSamples resolving to 1 throws (IsolationForestTest.scala:241-266)."""
+        X, _ = mammography
+        with pytest.raises(ValueError):
+            IsolationForest(max_samples=1.5).fit(X)
+
+    def test_reproducible_across_fits(self, mammography):
+        X, _ = mammography
+        s1 = IsolationForest(num_estimators=20, random_seed=5).fit(X).score(X[:100])
+        s2 = IsolationForest(num_estimators=20, random_seed=5).fit(X).score(X[:100])
+        np.testing.assert_array_equal(s1, s2)
+
+
+class TestExtendedQualityGates:
+    def test_mammography_ext5(self, mammography, auroc_fn):
+        """extensionLevel=5 (full for 6 features) -> AUROC 0.86 +/- 0.02
+        (ExtendedIsolationForestTest.scala:46-53)."""
+        X, y = mammography
+        model = ExtendedIsolationForest(
+            contamination=0.02, extension_level=5, random_seed=1
+        ).fit(X)
+        assert auroc_fn(model.score(X), y) == pytest.approx(0.86, abs=0.02)
+
+    def test_mammography_ext0_axis_aligned(self, mammography, auroc_fn):
+        """extensionLevel=0 -> axis-aligned hyperplanes, still ~0.86
+        (ExtendedIsolationForestTest.scala:90-97)."""
+        X, y = mammography
+        model = ExtendedIsolationForest(
+            contamination=0.02, extension_level=0, random_seed=1
+        ).fit(X)
+        assert auroc_fn(model.score(X), y) == pytest.approx(0.86, abs=0.03)
+
+    @pytest.mark.parametrize("level", [1, 2, 3, 4])
+    def test_auroc_sweep_levels(self, mammography, auroc_fn, level):
+        """AUROC > 0.7 for extension levels 1-4
+        (ExtendedIsolationForestTest.scala:249-255)."""
+        X, y = mammography
+        model = ExtendedIsolationForest(
+            num_estimators=50, extension_level=level, random_seed=1
+        ).fit(X)
+        assert auroc_fn(model.score(X), y) > 0.7
+
+    def test_extension_level_above_max_throws(self, mammography):
+        """extensionLevel > numFeatures-1 throws
+        (ExtendedIsolationForestTest.scala:184-211)."""
+        X, _ = mammography
+        with pytest.raises(ValueError):
+            ExtendedIsolationForest(extension_level=6).fit(X)  # 6 features -> max 5
+
+    def test_default_level_does_not_leak_across_fits(self):
+        """Unset extensionLevel resolves per-fit and never mutates the
+        estimator (ExtendedIsolationForestTest.scala:260-331)."""
+        rng = np.random.default_rng(0)
+        est = ExtendedIsolationForest(num_estimators=5, max_samples=64.0)
+        m6 = est.fit(rng.normal(size=(500, 6)).astype(np.float32))
+        assert m6.extension_level == 5
+        m3 = est.fit(rng.normal(size=(500, 3)).astype(np.float32))
+        assert m3.extension_level == 2
+        assert est.params.extension_level is None
+
+
+class TestTransformSemantics:
+    def test_dataframe_in_dataframe_out(self, mammography):
+        import pandas as pd
+
+        X, y = mammography
+        df = pd.DataFrame({"features": list(X[:1000]), "label": y[:1000]})
+        model = IsolationForest(num_estimators=20, contamination=0.05).fit(df)
+        out = model.transform(df)
+        assert list(out.columns) == ["features", "label", "outlierScore", "predictedLabel"]
+        assert out["predictedLabel"].isin([0.0, 1.0]).all()
+
+    def test_custom_column_names(self, mammography):
+        import pandas as pd
+
+        X, _ = mammography
+        df = pd.DataFrame({"vec": list(X[:500])})
+        model = IsolationForest(
+            num_estimators=10,
+            contamination=0.05,
+            features_col="vec",
+            score_col="s",
+            prediction_col="p",
+        ).fit(df)
+        out = model.transform(df)
+        assert "s" in out.columns and "p" in out.columns
+
+    def test_manual_threshold_override(self, mammography):
+        X, _ = mammography
+        model = IsolationForest(num_estimators=10).fit(X[:2000])
+        model.set_outlier_score_threshold(0.5)
+        out = model.transform(X[:2000])
+        scores = out["outlierScore"]
+        np.testing.assert_array_equal(
+            out["predictedLabel"], (scores >= 0.5).astype(np.float64)
+        )
+        with pytest.raises(ValueError):
+            model.set_outlier_score_threshold(1.5)

@@ -1,0 +1,165 @@
+"""ONNX converter tests — mirrors the reference's converter unit tests and
+the Scala->ONNX score-parity integration gate
+(test_isolation_forest_onnx_integration.py:86-89: max |score diff| < 1e-5)."""
+
+import math
+import pathlib
+
+import numpy as np
+import pytest
+
+from isoforest_tpu import IsolationForest, IsolationForestModel
+from isoforest_tpu.onnx import IsolationForestConverter, proto
+from isoforest_tpu.onnx.converter import _avg_path_len
+from isoforest_tpu.onnx.runtime import parse_model, run_model
+
+_FIXTURES = pathlib.Path("/root/reference/isolation-forest/src/test/resources")
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(3000, 6)).astype(np.float32)
+    X[:60] += 4.0
+    model = IsolationForest(num_estimators=25, contamination=0.02, random_seed=3).fit(X)
+    path = str(tmp_path_factory.mktemp("onnx") / "model")
+    model.save(path)
+    return model, X, path
+
+
+class TestAvgPathLenPins:
+    """Converter-local normaliser pins (test_isolation_forest_converter.py)."""
+
+    @pytest.mark.parametrize(
+        "n,expected",
+        [(0, 0.0), (1, 0.0), (2, 0.15443133), (10, 3.74888048)],
+    )
+    def test_pins(self, n, expected):
+        assert _avg_path_len(n) == pytest.approx(expected, abs=1e-6)
+
+
+class TestGraphStructure:
+    def test_model_parses_and_declares_opsets(self, saved_model):
+        _, _, path = saved_model
+        parsed = parse_model(IsolationForestConverter(path).convert())
+        assert parsed["ir_version"] == 10
+        assert ("ai.onnx.ml", 1) in parsed["opsets"]
+        assert ("", 14) in parsed["opsets"]
+        assert parsed["inputs"] == ["features"]
+        assert parsed["outputs"] == ["outlierScore", "predictedLabel"]
+        ops = [n["op_type"] for n in parsed["nodes"]]
+        assert ops == [
+            "TreeEnsembleRegressor", "Div", "Neg", "Pow", "Less", "Not", "Cast",
+        ]
+
+    def test_tree_attrs_consistent(self, saved_model):
+        model, _, path = saved_model
+        parsed = parse_model(IsolationForestConverter(path).convert())
+        attrs = parsed["nodes"][0]["attrs"]
+        assert attrs["aggregate_function"] == "AVERAGE"
+        assert attrs["post_transform"] == "NONE"
+        assert attrs["n_targets"] == 1
+        n_nodes = len(attrs["nodes_nodeids"])
+        assert len(attrs["nodes_modes"]) == n_nodes
+        assert len(attrs["nodes_values"]) == n_nodes
+        assert set(attrs["nodes_modes"]) == {"BRANCH_LT", "LEAF"}
+        assert int(attrs["nodes_treeids"].max()) + 1 == model.forest.num_trees
+        leaves = sum(m == "LEAF" for m in attrs["nodes_modes"])
+        assert len(attrs["target_weights"]) == leaves
+        # leaf target weight = depth + c(numInstances) >= 0
+        assert np.all(attrs["target_weights"] >= 0)
+
+    def test_3node_forest_attrs(self, tmp_path):
+        """Attr building on a tiny hand-made forest (the reference's mocked
+        3-node test, test_isolation_forest_converter.py)."""
+        from isoforest_tpu.ops.tree_growth import StandardForest
+        from isoforest_tpu.utils import IsolationForestParams
+
+        forest = StandardForest(
+            feature=np.array([[1, -1, -1]], np.int32),
+            threshold=np.array([[0.25, 0.0, 0.0]], np.float32),
+            num_instances=np.array([[-1, 3, 7]], np.int32),
+        )
+        model = IsolationForestModel(
+            forest=forest,
+            params=IsolationForestParams(num_estimators=1),
+            num_samples=10,
+            num_features=2,
+            total_num_features=2,
+        )
+        path = str(tmp_path / "m")
+        model.save(path)
+        attrs = parse_model(IsolationForestConverter(path).convert())["nodes"][0][
+            "attrs"
+        ]
+        np.testing.assert_array_equal(attrs["nodes_nodeids"], [0, 1, 2])
+        assert attrs["nodes_modes"] == ["BRANCH_LT", "LEAF", "LEAF"]
+        np.testing.assert_array_equal(attrs["nodes_truenodeids"], [1, 0, 0])
+        np.testing.assert_array_equal(attrs["nodes_falsenodeids"], [2, 0, 0])
+        np.testing.assert_allclose(
+            attrs["target_weights"],
+            [1 + _avg_path_len(3), 1 + _avg_path_len(7)],
+            rtol=1e-6,
+        )
+
+
+class TestScoreParity:
+    def test_parity_vs_jax_scorer(self, saved_model):
+        """The reference integration gate: max |score diff| < 1e-5."""
+        model, X, path = saved_model
+        onnx_bytes = IsolationForestConverter(path).convert()
+        scores, labels = run_model(onnx_bytes, {"features": X})
+        jax_scores = model.score(X)
+        assert np.abs(scores[:, 0] - jax_scores).max() < 1e-5
+        jax_labels = model.predict(jax_scores)
+        # labels may flip only within float noise of the threshold
+        disagree = labels[:, 0] != jax_labels
+        if disagree.any():
+            assert np.all(
+                np.abs(jax_scores[disagree] - model.outlier_score_threshold) < 1e-5
+            )
+
+    def test_no_threshold_means_zero_labels(self, tmp_path):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(500, 4)).astype(np.float32)
+        model = IsolationForest(num_estimators=5).fit(X)  # contamination 0
+        path = str(tmp_path / "m")
+        model.save(path)
+        _, labels = run_model(
+            IsolationForestConverter(path).convert(), {"features": X}
+        )
+        assert np.all(labels == 0)
+
+    def test_reference_fixture_conversion(self, mammography, auroc_fn):
+        """Convert the Spark-written fixture; reference pins AUROC 0.8596."""
+        path = _FIXTURES / "savedIsolationForestModel"
+        if not path.exists():
+            pytest.skip("reference fixture unavailable")
+        onnx_bytes = IsolationForestConverter(str(path)).convert()
+        X, y = mammography
+        scores, _ = run_model(onnx_bytes, {"features": X})
+        assert auroc_fn(scores[:, 0], y) == pytest.approx(0.8596, abs=0.02)
+
+    def test_extended_model_rejected(self):
+        path = _FIXTURES / "savedExtendedIsolationForestModel"
+        if not path.exists():
+            pytest.skip("reference fixture unavailable")
+        with pytest.raises(ValueError, match="standard"):
+            IsolationForestConverter(str(path))
+
+
+class TestProtoCodec:
+    def test_varint_negative(self):
+        data = proto.field_packed_varints(8, [-1, 0, 5])
+        fields = proto.decode_message(data)
+        assert proto.unpack_varints(fields[8][0][1]) == [-1, 0, 5]
+
+    def test_attribute_round_trip(self):
+        from isoforest_tpu.onnx.runtime import _parse_attr
+
+        name, val = _parse_attr(proto.attribute("modes", ["LEAF", "BRANCH_LT"]))
+        assert name == "modes" and val == ["LEAF", "BRANCH_LT"]
+        name, val = _parse_attr(proto.attribute("w", [1.5, -2.0]))
+        np.testing.assert_allclose(val, [1.5, -2.0])
+        name, val = _parse_attr(proto.attribute("n", 7))
+        assert val == 7

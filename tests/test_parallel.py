@@ -1,0 +1,154 @@
+"""Mesh/sharding tests over 8 virtual CPU devices — the multi-node layer the
+reference exercises via local[4] Spark sessions (SURVEY.md §4). The key
+invariant: sharded execution is *bitwise identical* to single-device
+execution, because per-tree PRNG streams are derived from global tree ids."""
+
+import jax
+import numpy as np
+import pytest
+
+from isoforest_tpu import IsolationForest
+from isoforest_tpu.ops.bagging import bagged_indices, feature_subsets, per_tree_keys
+from isoforest_tpu.ops.traversal import score_matrix
+from isoforest_tpu.ops.tree_growth import grow_forest
+from isoforest_tpu.parallel import (
+    create_mesh,
+    make_train_step,
+    sharded_grow_forest,
+    sharded_score,
+)
+from isoforest_tpu.utils import height_limit
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest should force 8 virtual cpu devices"
+    return create_mesh()
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(4096, 5)).astype(np.float32)
+    X[:50] += 6.0
+    return X
+
+
+class TestMesh:
+    def test_factorisation(self, mesh):
+        assert mesh.shape["data"] * mesh.shape["trees"] == 8
+        assert mesh.shape["data"] == 2 and mesh.shape["trees"] == 4
+
+    def test_explicit_data_parallelism(self):
+        m = create_mesh(data_parallelism=1)
+        assert m.shape["data"] == 1 and m.shape["trees"] == 8
+
+    def test_single_device_mesh(self):
+        m = create_mesh(devices=jax.devices()[:1])
+        assert m.shape["data"] == 1 and m.shape["trees"] == 1
+
+
+class TestShardedEqualsLocal:
+    def test_grow_forest_bitwise_equal(self, mesh, data):
+        T, S = 16, 64
+        key = jax.random.PRNGKey(0)
+        bag = bagged_indices(jax.random.fold_in(key, 0), len(data), S, T, False)
+        fidx = feature_subsets(jax.random.fold_in(key, 1), 5, 5, T)
+        tk = per_tree_keys(jax.random.fold_in(key, 2), T)
+        h = height_limit(S)
+        local = grow_forest(tk, data, bag, fidx, h)
+        sharded = sharded_grow_forest(mesh, tk, data, bag, fidx, h)
+        for a, b in zip(local, sharded):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_grow_forest_with_tree_padding(self, mesh, data):
+        # T=10 not divisible by 8 -> padded to 16, sliced back
+        T, S = 10, 64
+        key = jax.random.PRNGKey(1)
+        bag = bagged_indices(jax.random.fold_in(key, 0), len(data), S, T, False)
+        fidx = feature_subsets(jax.random.fold_in(key, 1), 5, 5, T)
+        tk = per_tree_keys(jax.random.fold_in(key, 2), T)
+        h = height_limit(S)
+        sharded = sharded_grow_forest(mesh, tk, data, bag, fidx, h)
+        assert sharded.num_trees == T
+        local = grow_forest(tk, data, bag, fidx, h)
+        np.testing.assert_array_equal(
+            np.asarray(local.feature), np.asarray(sharded.feature)
+        )
+
+    def test_score_equal(self, mesh, data):
+        model = IsolationForest(num_estimators=16, max_samples=64.0).fit(data)
+        local = score_matrix(model.forest, data, model.num_samples)
+        sharded = sharded_score(mesh, model.forest, data, model.num_samples)
+        np.testing.assert_allclose(local, sharded, rtol=1e-6)
+
+    def test_score_row_padding(self, mesh, data):
+        model = IsolationForest(num_estimators=8, max_samples=64.0).fit(data)
+        odd = data[:4093]  # not divisible by 8
+        sharded = sharded_score(mesh, model.forest, odd, model.num_samples)
+        assert sharded.shape == (4093,)
+        local = score_matrix(model.forest, odd, model.num_samples)
+        np.testing.assert_allclose(local, sharded, rtol=1e-6)
+
+
+class TestFitViaMesh:
+    def test_fit_with_mesh_matches_local(self, mesh, data, auroc_fn):
+        m_local = IsolationForest(
+            num_estimators=16, max_samples=128.0, contamination=0.02
+        ).fit(data)
+        m_mesh = IsolationForest(
+            num_estimators=16, max_samples=128.0, contamination=0.02
+        ).fit(data, mesh=mesh)
+        np.testing.assert_array_equal(
+            np.asarray(m_local.forest.feature), np.asarray(m_mesh.forest.feature)
+        )
+        assert m_mesh.outlier_score_threshold == pytest.approx(
+            m_local.outlier_score_threshold, abs=1e-6
+        )
+
+
+class TestFusedTrainStep:
+    def test_runs_and_matches_quantile(self, mesh, data):
+        T, S = 16, 64
+        step = make_train_step(
+            mesh,
+            num_rows=len(data),
+            num_features_total=5,
+            num_trees=T,
+            num_samples=S,
+            num_features=5,
+            contamination=0.1,
+        )
+        result = step(jax.random.PRNGKey(0), data)
+        scores = np.asarray(result.scores)
+        assert scores.shape == (len(data),)
+        thr = float(result.threshold)
+        observed = (scores >= thr).mean()
+        assert observed == pytest.approx(0.1, abs=0.005)
+        assert result.forest.num_trees == T
+
+    def test_extended_variant(self, mesh, data):
+        step = make_train_step(
+            mesh,
+            num_rows=len(data),
+            num_features_total=5,
+            num_trees=8,
+            num_samples=64,
+            num_features=5,
+            extended=True,
+            extension_level=2,
+        )
+        result = step(jax.random.PRNGKey(0), data)
+        assert float(result.threshold) == -1.0
+        assert result.forest.k == 3
+
+    def test_indivisible_counts_rejected(self, mesh, data):
+        with pytest.raises(ValueError):
+            make_train_step(
+                mesh,
+                num_rows=len(data),
+                num_features_total=5,
+                num_trees=9,  # not divisible by 8
+                num_samples=64,
+                num_features=5,
+            )
