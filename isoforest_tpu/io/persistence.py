@@ -477,10 +477,8 @@ def save_estimator(estimator, path: str, class_name: str, overwrite: bool = Fals
     _write_metadata(path, metadata)
 
 
-def load_estimator(path: str, params_cls):
+def load_estimator(path: str, params_cls, expected_class: str):
     metadata = _read_metadata(path)
-    cls = metadata.get("class")
-    if cls not in (STANDARD_ESTIMATOR_CLASS, EXTENDED_ESTIMATOR_CLASS):
-        raise ValueError(f"unexpected estimator class {cls!r}")
+    _check_class(metadata, expected_class)
     params = params_cls.from_param_map(metadata["paramMap"])
     return params, metadata.get("uid")

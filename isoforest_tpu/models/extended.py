@@ -118,7 +118,9 @@ class ExtendedIsolationForest(_ParamSetters):
     def load(cls, path: str) -> "ExtendedIsolationForest":
         from ..io.persistence import load_estimator
 
-        params, uid = load_estimator(path, ExtendedIsolationForestParams)
+        params, uid = load_estimator(
+            path, ExtendedIsolationForestParams, _REFERENCE_ESTIMATOR_CLASS
+        )
         return cls(params=params, uid=uid)
 
 

@@ -147,7 +147,9 @@ class IsolationForest(_ParamSetters):
     def load(cls, path: str) -> "IsolationForest":
         from ..io.persistence import load_estimator
 
-        params, uid = load_estimator(path, IsolationForestParams)
+        params, uid = load_estimator(
+            path, IsolationForestParams, _REFERENCE_ESTIMATOR_CLASS
+        )
         return cls(params=params, uid=uid)
 
 

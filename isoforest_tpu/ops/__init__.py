@@ -1,4 +1,9 @@
 from .bagging import bagged_indices, feature_subsets, gather_tree_data
+from .dense_traversal import (
+    extended_path_lengths_dense,
+    path_lengths_dense,
+    standard_path_lengths_dense,
+)
 from .ext_growth import ExtendedForest, grow_extended_forest
 from .quantile import (
     contamination_threshold,
@@ -18,6 +23,9 @@ __all__ = [
     "bagged_indices",
     "feature_subsets",
     "gather_tree_data",
+    "extended_path_lengths_dense",
+    "path_lengths_dense",
+    "standard_path_lengths_dense",
     "ExtendedForest",
     "grow_extended_forest",
     "contamination_threshold",

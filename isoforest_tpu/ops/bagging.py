@@ -22,12 +22,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-# Above this row count, exact without-replacement sampling (a full permutation
-# per tree) is replaced by uniform draws with replacement: for S samples out of
-# N rows the collision probability per tree is ~S^2/(2N) < 0.4% at S=256,
-# N=10M — statistically negligible, and it keeps bagging O(T*S) instead of
-# O(T*N).
-_EXACT_WITHOUT_REPLACEMENT_MAX_ROWS = 1 << 20
+# Exact without-replacement sampling costs a full N-row permutation per tree
+# (O(T*N)); when N >> S the expected duplicate count of plain uniform draws is
+# ~S^2/(2N) per tree — under 1% of the bag at N > 50*S — so the approximate
+# path is statistically indistinguishable and keeps bagging O(T*S).
+_EXACT_SAMPLING_ROWS_PER_SAMPLE = 50
 
 
 def per_tree_keys(key: jax.Array, num_trees: int) -> jax.Array:
@@ -55,7 +54,7 @@ def bagged_indices(
     SharedTrainLogic.scala:283-287).
     """
     tree_keys = per_tree_keys(key, num_trees)
-    if bootstrap or num_rows > _EXACT_WITHOUT_REPLACEMENT_MAX_ROWS:
+    if bootstrap or num_rows > _EXACT_SAMPLING_ROWS_PER_SAMPLE * num_samples:
         sample = lambda k: jax.random.randint(
             k, (num_samples,), 0, num_rows, dtype=jnp.int32
         )

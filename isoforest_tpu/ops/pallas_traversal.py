@@ -1,0 +1,196 @@
+"""Pallas TPU kernel for dense forest scoring.
+
+Same gather-free algorithm as :mod:`.dense_traversal`, hand-blocked for the
+TPU memory hierarchy: the grid is ``(row_blocks, trees)`` with trees minor,
+so each row-block's accumulator stays resident in VMEM while the per-tree
+node tables (a few KB each) stream HBM -> VMEM. Every instruction is a
+full-width VPU op or (for the extended forest's hyperplane tests) an MXU
+matmul; there is no data-dependent indexing anywhere.
+
+Correctness is pinned against the XLA dense path in interpret mode (tests run
+CPU-only); on TPU hardware select it via ``score_matrix(strategy="pallas")``
+or ``ISOFOREST_TPU_STRATEGY=pallas``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces are unavailable when lowering for CPU interpret mode
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+from ..utils.math import avg_path_length, height_of as _height_of
+from .ext_growth import ExtendedForest
+from .tree_growth import StandardForest
+
+_ROW_BLOCK = 1024
+
+
+def _leaf_value_tables(num_instances: np.ndarray, h: int) -> jax.Array:
+    """[T, M] ``depth + c(numInstances)`` at leaves, 0 elsewhere (host prep)."""
+    depth = np.concatenate(
+        [np.full((1 << level,), float(level), np.float32) for level in range(h + 1)]
+    )
+    ni = np.asarray(num_instances)
+    leaf = ni >= 0
+    return jnp.asarray(
+        np.where(leaf, depth[None, :] + np.asarray(avg_path_length(ni)), 0.0).astype(
+            np.float32
+        )
+    )
+
+
+def _walk_levels(B, internal_f32, leaf_value, h: int):
+    """Reach propagation on [C_blk, M] blocks — mirrors dense_traversal."""
+    C = B.shape[0]
+    total = jnp.zeros((C,), jnp.float32)
+    reach = jnp.ones((C, 1), jnp.float32)
+    for level in range(h + 1):
+        start = (1 << level) - 1
+        width = 1 << level
+        total = total + jnp.sum(reach * leaf_value[:, start : start + width], axis=1)
+        if level < h:
+            B_l = B[:, start : start + width]
+            alive = reach * internal_f32[:, start : start + width]
+            left = alive * (1.0 - B_l)
+            right = alive * B_l
+            reach = jnp.stack([left, right], axis=2).reshape(C, 2 * width)
+    return total
+
+
+def _standard_kernel(h, F, T, x_ref, feat_ref, thr_ref, leaf_ref, out_ref):
+    t = pl.program_id(1)
+    x = x_ref[...]  # [C_blk, F]
+    feature = feat_ref[...]  # [1, M] f32 (feature id; -1 leaf)
+    thr = thr_ref[...]
+    # dense one-hot feature select without gathers: F static passes
+    xv = jnp.zeros((x.shape[0], feature.shape[1]), jnp.float32)
+    for f in range(F):
+        sel = (feature == float(f)).astype(jnp.float32)  # [1, M]
+        xv = xv + x[:, f : f + 1] * sel
+    B = (xv >= thr).astype(jnp.float32)
+    internal = (feature >= 0.0).astype(jnp.float32) + jnp.zeros_like(xv)
+    pl_len = _walk_levels(B, internal, leaf_ref[...] + jnp.zeros_like(xv), h)
+
+    @pl.when(t == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += pl_len[:, None] / T
+
+
+def _extended_kernel(h, T, x_ref, w_ref, off_ref, internal_ref, leaf_ref, out_ref):
+    t = pl.program_id(1)
+    x = x_ref[...]  # [C_blk, F]
+    W = w_ref[0]  # block is [1, M, F] -> [M, F] dense hyperplanes
+    dots = jax.lax.dot_general(
+        x, W, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [C_blk, M] — MXU
+    B = (dots >= off_ref[...]).astype(jnp.float32)
+    internal = internal_ref[...] + jnp.zeros_like(dots)
+    pl_len = _walk_levels(B, internal, leaf_ref[...] + jnp.zeros_like(dots), h)
+
+    @pl.when(t == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += pl_len[:, None] / T
+
+
+def _vmem_spec(block_shape, index_map):
+    kw = {"memory_space": _VMEM} if _VMEM is not None else {}
+    return pl.BlockSpec(block_shape, index_map, **kw)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _standard_pallas(X, feature_f32, threshold, leaf_value, interpret=False):
+    C, F = X.shape
+    T, M = threshold.shape
+    h = _height_of(M)
+    grid = (C // _ROW_BLOCK, T)
+    return pl.pallas_call(
+        functools.partial(_standard_kernel, h, F, T),
+        grid=grid,
+        in_specs=[
+            _vmem_spec((_ROW_BLOCK, F), lambda rb, t: (rb, 0)),
+            _vmem_spec((1, M), lambda rb, t: (t, 0)),
+            _vmem_spec((1, M), lambda rb, t: (t, 0)),
+            _vmem_spec((1, M), lambda rb, t: (t, 0)),
+        ],
+        out_specs=_vmem_spec((_ROW_BLOCK, 1), lambda rb, t: (rb, 0)),
+        out_shape=jax.ShapeDtypeStruct((C, 1), jnp.float32),
+        interpret=interpret,
+    )(X, feature_f32, threshold, leaf_value)[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _extended_pallas(X, W_dense, offset, internal, leaf_value, interpret=False):
+    C, F = X.shape
+    T, M = offset.shape
+    h = _height_of(M)
+    grid = (C // _ROW_BLOCK, T)
+    return pl.pallas_call(
+        functools.partial(_extended_kernel, h, T),
+        grid=grid,
+        in_specs=[
+            _vmem_spec((_ROW_BLOCK, F), lambda rb, t: (rb, 0)),
+            _vmem_spec((1, M, F), lambda rb, t: (t, 0, 0)),
+            _vmem_spec((1, M), lambda rb, t: (t, 0)),
+            _vmem_spec((1, M), lambda rb, t: (t, 0)),
+            _vmem_spec((1, M), lambda rb, t: (t, 0)),
+        ],
+        out_specs=_vmem_spec((_ROW_BLOCK, 1), lambda rb, t: (rb, 0)),
+        out_shape=jax.ShapeDtypeStruct((C, 1), jnp.float32),
+        interpret=interpret,
+    )(X, W_dense, offset, internal, leaf_value)[:, 0]
+
+
+def path_lengths_pallas(forest, X, interpret: bool = False) -> jax.Array:
+    """Mean path lengths via the Pallas kernel. Rows are padded to the row
+    block internally; pass ``interpret=True`` off-TPU."""
+    X = jnp.asarray(X, jnp.float32)
+    n = X.shape[0]
+    pad = (-n) % _ROW_BLOCK
+    if pad:
+        X = jnp.pad(X, ((0, pad), (0, 0)))
+    h = _height_of(
+        forest.max_nodes if hasattr(forest, "max_nodes") else forest[0].shape[1]
+    )
+    if isinstance(forest, StandardForest):
+        leaf_value = _leaf_value_tables(forest.num_instances, h)
+        out = _standard_pallas(
+            X,
+            jnp.asarray(forest.feature, jnp.float32),
+            jnp.asarray(forest.threshold),
+            leaf_value,
+            interpret=interpret,
+        )
+    else:
+        F = X.shape[1]
+        indices = np.asarray(forest.indices)
+        weights = np.asarray(forest.weights)
+        T, M, k = indices.shape
+        W = np.zeros((T, M, F), np.float32)
+        t_ix, m_ix, k_ix = np.nonzero(indices >= 0)
+        W[t_ix, m_ix, indices[t_ix, m_ix, k_ix]] += weights[t_ix, m_ix, k_ix]
+        leaf_value = _leaf_value_tables(forest.num_instances, h)
+        internal = jnp.asarray((indices[..., 0] >= 0).astype(np.float32))
+        out = _extended_pallas(
+            X,
+            jnp.asarray(W),
+            jnp.asarray(forest.offset),
+            internal,
+            leaf_value,
+            interpret=interpret,
+        )
+    return out[:n]

@@ -19,19 +19,16 @@ Path length = (depth of final leaf) + ``avg_path_length(leaf.numInstances)``
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..utils.math import avg_path_length, score_from_path_length
+from ..utils.math import avg_path_length, height_of as _height_of, score_from_path_length
 from .ext_growth import ExtendedForest
 from .tree_growth import StandardForest
-
-
-def _height_of(max_nodes: int) -> int:
-    return int(np.log2(max_nodes + 1)) - 1
 
 
 def standard_path_lengths(forest: StandardForest, X: jax.Array) -> jax.Array:
@@ -98,9 +95,15 @@ def path_lengths(forest, X: jax.Array) -> jax.Array:
     return extended_path_lengths(forest, X)
 
 
-@functools.partial(jax.jit, static_argnames=("num_samples",))
-def _score_chunk(forest, X, num_samples: int) -> jax.Array:
-    return score_from_path_length(path_lengths(forest, X), num_samples)
+@functools.partial(jax.jit, static_argnames=("num_samples", "strategy"))
+def _score_chunk(forest, X, num_samples: int, strategy: str = "dense") -> jax.Array:
+    if strategy == "dense":
+        from .dense_traversal import path_lengths_dense
+
+        pl = path_lengths_dense(forest, X)
+    else:
+        pl = path_lengths(forest, X)
+    return score_from_path_length(pl, num_samples)
 
 
 def score_matrix(
@@ -108,14 +111,53 @@ def score_matrix(
     X,
     num_samples: int,
     chunk_size: int = 1 << 18,
+    strategy: str = "auto",
 ) -> np.ndarray:
     """Score a full ``[N, F]`` matrix, chunked along rows.
 
-    Chunking bounds the ``[T, C]`` traversal state so forests with many trees
-    never materialise ``[T, N]``. Row counts are always padded up to a
-    power-of-two bucket (min 1024) so varying batch sizes reuse a handful of
-    compiled programs instead of recompiling per distinct ``n``.
+    Chunking bounds the traversal state so big-N scoring streams through a
+    fixed working set. Row counts are always padded up to a power-of-two
+    bucket (min 1024) so varying batch sizes reuse a handful of compiled
+    programs instead of recompiling per distinct ``n``.
+
+    ``strategy``:
+      * ``"gather"`` — pointer-walk formulation, ``O(C * h)`` gathers.
+        Fastest on CPU (measured ~50x over dense) and the default.
+      * ``"dense"`` — gather-free level-walk (:mod:`.dense_traversal`),
+        ``O(C * M)`` full-width vector ops; the hyperplane variant runs on
+        the MXU. Candidate fast path on TPU where per-lane gathers
+        serialise.
+      * ``"pallas"`` — hand-blocked TPU kernel of the dense algorithm
+        (:mod:`.pallas_traversal`).
+      * ``"auto"`` — ``ISOFOREST_TPU_STRATEGY`` env var if set, else
+        ``gather``. ``bench.py`` measures all strategies on the live
+        backend and reports the winner, so hardware picks its own path.
     """
+    if strategy == "auto":
+        strategy = os.environ.get("ISOFOREST_TPU_STRATEGY", "gather")
+        if strategy not in ("gather", "dense", "pallas"):
+            from ..utils import logger
+
+            logger.warning(
+                "ISOFOREST_TPU_STRATEGY=%r is not one of gather/dense/pallas; "
+                "using gather",
+                strategy,
+            )
+            strategy = "gather"
+    if strategy not in ("gather", "dense", "pallas"):
+        raise ValueError(
+            f"unknown scoring strategy {strategy!r}; expected one of "
+            "'auto', 'gather', 'dense', 'pallas'"
+        )
+    if strategy == "pallas":
+        from .pallas_traversal import path_lengths_pallas
+
+        X = jnp.asarray(X, jnp.float32)
+        if X.shape[0] == 0:
+            return np.zeros((0,), np.float32)
+        interpret = jax.devices()[0].platform != "tpu"
+        pl_len = path_lengths_pallas(forest, X, interpret=interpret)
+        return np.asarray(score_from_path_length(pl_len, num_samples))
     X = jnp.asarray(X, jnp.float32)
     n = X.shape[0]
     if n == 0:
@@ -125,7 +167,7 @@ def score_matrix(
         pad = bucket - n
         if pad:
             X = jnp.pad(X, ((0, pad), (0, 0)))
-        scores = _score_chunk(forest, X, num_samples)
+        scores = _score_chunk(forest, X, num_samples, strategy)
         return np.asarray(scores[:n])
 
     outs = []
@@ -134,6 +176,6 @@ def score_matrix(
         pad = chunk_size - chunk.shape[0]
         if pad:
             chunk = jnp.pad(chunk, ((0, pad), (0, 0)))
-        scores = _score_chunk(forest, chunk, num_samples)
+        scores = _score_chunk(forest, chunk, num_samples, strategy)
         outs.append(np.asarray(scores[: chunk_size - pad] if pad else scores))
     return np.concatenate(outs)

@@ -48,6 +48,12 @@ def height_limit(num_samples: int) -> int:
     return int(np.ceil(np.log2(float(num_samples))))
 
 
+def height_of(max_nodes: int) -> int:
+    """Inverse of :func:`max_nodes_for`: tree height of an ``max_nodes``-slot
+    implicit heap (``log2(M + 1) - 1``)."""
+    return int(np.log2(max_nodes + 1)) - 1
+
+
 def max_nodes_for(num_samples: int) -> int:
     """Slot count of the implicit-heap tree tensor for ``num_samples`` points.
 

@@ -1,0 +1,69 @@
+"""Equivalence tests across the three scoring strategies (gather pointer-walk,
+dense level-walk, pallas kernel in interpret mode) — all must produce the
+same scores to float32 tolerance on both forest families."""
+
+import numpy as np
+import pytest
+
+from isoforest_tpu import ExtendedIsolationForest, IsolationForest
+from isoforest_tpu.ops.traversal import score_matrix
+
+
+@pytest.fixture(scope="module")
+def models():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(4000, 6)).astype(np.float32)
+    X[:80] += 5.0
+    std = IsolationForest(num_estimators=12, max_samples=128.0, random_seed=1).fit(X)
+    ext = ExtendedIsolationForest(
+        num_estimators=10, max_samples=128.0, extension_level=3, random_seed=1
+    ).fit(X)
+    return X, std, ext
+
+
+@pytest.mark.parametrize("strategy", ["dense", "pallas"])
+class TestStrategyEquivalence:
+    def test_standard(self, models, strategy):
+        X, std, _ = models
+        base = score_matrix(std.forest, X, std.num_samples, strategy="gather")
+        got = score_matrix(std.forest, X, std.num_samples, strategy=strategy)
+        np.testing.assert_allclose(got, base, atol=3e-6)
+
+    def test_extended(self, models, strategy):
+        X, _, ext = models
+        base = score_matrix(ext.forest, X, ext.num_samples, strategy="gather")
+        got = score_matrix(ext.forest, X, ext.num_samples, strategy=strategy)
+        np.testing.assert_allclose(got, base, atol=3e-6)
+
+    def test_unpadded_row_counts(self, models, strategy):
+        X, std, _ = models
+        odd = X[:1537]  # not a multiple of any block size
+        base = score_matrix(std.forest, odd, std.num_samples, strategy="gather")
+        got = score_matrix(std.forest, odd, std.num_samples, strategy=strategy)
+        assert got.shape == (1537,)
+        np.testing.assert_allclose(got, base, atol=3e-6)
+
+
+class TestAutoStrategy:
+    def test_env_override(self, models, monkeypatch):
+        X, std, _ = models
+        monkeypatch.setenv("ISOFOREST_TPU_STRATEGY", "dense")
+        got = score_matrix(std.forest, X[:512], std.num_samples, strategy="auto")
+        base = score_matrix(std.forest, X[:512], std.num_samples, strategy="gather")
+        np.testing.assert_allclose(got, base, atol=3e-6)
+
+    def test_default_is_gather(self, models, monkeypatch):
+        X, std, _ = models
+        monkeypatch.delenv("ISOFOREST_TPU_STRATEGY", raising=False)
+        got = score_matrix(std.forest, X[:512], std.num_samples, strategy="auto")
+        base = score_matrix(std.forest, X[:512], std.num_samples, strategy="gather")
+        np.testing.assert_array_equal(got, base)
+
+    def test_constant_data_degenerate_trees(self):
+        # zero-size leaves + all-leaf roots traverse identically everywhere
+        X = np.full((1100, 3), 2.0, np.float32)
+        ext = ExtendedIsolationForest(num_estimators=4, max_samples=32.0).fit(X)
+        base = score_matrix(ext.forest, X, ext.num_samples, strategy="gather")
+        for strategy in ["dense", "pallas"]:
+            got = score_matrix(ext.forest, X, ext.num_samples, strategy=strategy)
+            np.testing.assert_allclose(got, base, atol=3e-6)
