@@ -78,6 +78,10 @@ def _pick_strategy(model, X: np.ndarray) -> str:
             timings[strat] = time.perf_counter() - start
         except Exception as exc:
             print(f"[bench] strategy {strat} unavailable: {exc}", file=sys.stderr)
+    if not timings:
+        print("[bench] all strategies failed to time; defaulting to gather", file=sys.stderr)
+        os.environ["ISOFOREST_TPU_STRATEGY"] = "gather"
+        return "gather"
     best = min(timings, key=timings.get)
     print(f"[bench] traversal strategy timings {timings} -> {best}", file=sys.stderr)
     os.environ["ISOFOREST_TPU_STRATEGY"] = best

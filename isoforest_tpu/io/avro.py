@@ -363,7 +363,15 @@ def read_container(path: str) -> Tuple[Any, List[dict]]:
         if codec == "deflate":
             block = zlib.decompress(block, -15)
         elif codec == "snappy":
-            block = snappy_decompress(block[:-4])  # trailing 4-byte CRC32 (BE)
+            payload = block[:-4]  # trailing 4-byte CRC32 (BE) of plaintext
+            decoded = None
+            try:  # native fast path (isoforest_tpu/native), pure-Python fallback
+                from .. import native as _native
+
+                decoded = _native.snappy_decompress(payload)
+            except ImportError:  # pragma: no cover
+                decoded = None
+            block = decoded if decoded is not None else snappy_decompress(payload)
             crc = struct.unpack(">I", data[reader.pos - 4 : reader.pos])[0]
             if zlib.crc32(block) & 0xFFFFFFFF != crc:
                 raise ValueError(f"{path}: snappy block CRC mismatch")

@@ -204,7 +204,11 @@ def _assign_heap_slots(records: List[dict]) -> Tuple[dict, int]:
     return slots, max_depth
 
 
-def records_to_standard_forest(trees: List[List[dict]]) -> StandardForest:
+def records_to_standard_forest(
+    trees: List[List[dict]], threshold_dtype=np.float32
+) -> StandardForest:
+    """``threshold_dtype=np.float64`` preserves the reference's Double split
+    values exactly (inspection / golden-structure checks); compute uses f32."""
     depths = []
     slot_maps = []
     for records in trees:
@@ -215,7 +219,7 @@ def records_to_standard_forest(trees: List[List[dict]]) -> StandardForest:
     M = 2 ** (height + 1) - 1
     T = len(trees)
     feature = np.full((T, M), -1, np.int32)
-    threshold = np.zeros((T, M), np.float32)
+    threshold = np.zeros((T, M), threshold_dtype)
     num_instances = np.full((T, M), -1, np.int32)
     for t, records in enumerate(trees):
         slots = slot_maps[t]
@@ -231,7 +235,9 @@ def records_to_standard_forest(trees: List[List[dict]]) -> StandardForest:
     )
 
 
-def records_to_extended_forest(trees: List[List[dict]]) -> ExtendedForest:
+def records_to_extended_forest(
+    trees: List[List[dict]], offset_dtype=np.float32
+) -> ExtendedForest:
     depths = []
     slot_maps = []
     k = 1
@@ -247,7 +253,7 @@ def records_to_extended_forest(trees: List[List[dict]]) -> ExtendedForest:
     T = len(trees)
     indices = np.full((T, M, k), -1, np.int32)
     weights = np.zeros((T, M, k), np.float32)
-    offset = np.zeros((T, M), np.float32)
+    offset = np.zeros((T, M), offset_dtype)
     num_instances = np.full((T, M), -1, np.int32)
     for t, records in enumerate(trees):
         slots = slot_maps[t]

@@ -1,0 +1,187 @@
+"""ctypes bindings for the native IO accelerator (build-on-demand).
+
+Compiles ``isoforest_io.cpp`` with the system C++ toolchain on first use and
+caches the shared object next to the source. Every entry point has a
+pure-Python fallback in :mod:`isoforest_tpu.io.avro`; absence of a compiler
+degrades gracefully to the portable path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = pathlib.Path(__file__).parent
+_SRC = _HERE / "isoforest_io.cpp"
+_SO = _HERE / "_isoforest_io.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    compiler = os.environ.get("CXX", "g++")
+    cmd = [
+        compiler,
+        "-O3",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        str(_SRC),
+        "-o",
+        str(_SO),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except Exception:
+        return None
+    return ctypes.CDLL(str(_SO))
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    i64 = ctypes.c_int64
+
+    lib.if_snappy_uncompressed_len.restype = i64
+    lib.if_snappy_uncompressed_len.argtypes = [i8p, i64]
+    lib.if_snappy_decompress.restype = i64
+    lib.if_snappy_decompress.argtypes = [i8p, i64, i8p, i64]
+    lib.if_decode_standard.restype = i64
+    lib.if_decode_standard.argtypes = [
+        i8p, i64, i64, i32p, i32p, i32p, i32p, i32p, f64p, i64p,
+    ]
+    lib.if_decode_extended.restype = i64
+    lib.if_decode_extended.argtypes = [
+        i8p, i64, i64, i32p, i32p, i32p, i32p, f64p, i64p, i32p, i32p, f32p, i64,
+    ]
+    return lib
+
+
+def get_library() -> Optional[ctypes.CDLL]:
+    """The bound native library, building it if needed; None if unavailable."""
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed or os.environ.get("ISOFOREST_TPU_NO_NATIVE"):
+        return None
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        lib = None
+        if _SO.exists():
+            try:
+                lib = ctypes.CDLL(str(_SO))
+            except OSError:
+                lib = None
+        if lib is None:
+            lib = _build()
+        if lib is None:
+            _build_failed = True
+            return None
+        _lib = _bind(lib)
+    return _lib
+
+
+def available() -> bool:
+    return get_library() is not None
+
+
+def _u8ptr(buf: np.ndarray):
+    return buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def snappy_decompress(data: bytes) -> Optional[bytes]:
+    """Native snappy block decode; None when the library is unavailable.
+    Raises ValueError on corrupt input (parity with the Python fallback)."""
+    lib = get_library()
+    if lib is None:
+        return None
+    src = np.frombuffer(data, np.uint8)
+    n = lib.if_snappy_uncompressed_len(_u8ptr(src), len(data))
+    if n < 0:
+        raise ValueError("corrupt snappy stream: bad length header")
+    out = np.empty(int(n), np.uint8)
+    written = lib.if_snappy_decompress(_u8ptr(src), len(data), _u8ptr(out), int(n))
+    if written != n:
+        raise ValueError("corrupt snappy stream")
+    return out.tobytes()
+
+
+def decode_standard_block(body: bytes, count: int):
+    """Decode `count` standard node records from an uncompressed Avro block
+    body -> dict of numpy columns; None if the library is unavailable."""
+    lib = get_library()
+    if lib is None:
+        return None
+    src = np.frombuffer(body, np.uint8)
+    cols = {
+        "treeID": np.empty(count, np.int32),
+        "id": np.empty(count, np.int32),
+        "leftChild": np.empty(count, np.int32),
+        "rightChild": np.empty(count, np.int32),
+        "splitAttribute": np.empty(count, np.int32),
+        "splitValue": np.empty(count, np.float64),
+        "numInstances": np.empty(count, np.int64),
+    }
+    consumed = lib.if_decode_standard(
+        _u8ptr(src), len(body), count,
+        cols["treeID"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["id"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["leftChild"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["rightChild"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["splitAttribute"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["splitValue"].ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        cols["numInstances"].ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if consumed != len(body):
+        raise ValueError("corrupt Avro block (standard node records)")
+    return cols
+
+
+def decode_extended_block(body: bytes, count: int):
+    """Extended-schema variant; returns (columns, flat_indices, flat_weights,
+    per_record_len) or None."""
+    lib = get_library()
+    if lib is None:
+        return None
+    src = np.frombuffer(body, np.uint8)
+    flat_cap = max(len(body), 16)  # safe upper bound: >= total array items
+    cols = {
+        "treeID": np.empty(count, np.int32),
+        "id": np.empty(count, np.int32),
+        "leftChild": np.empty(count, np.int32),
+        "rightChild": np.empty(count, np.int32),
+        "offset": np.empty(count, np.float64),
+        "numInstances": np.empty(count, np.int64),
+    }
+    hyper_len = np.empty(count, np.int32)
+    flat_indices = np.empty(flat_cap, np.int32)
+    flat_weights = np.empty(flat_cap, np.float32)
+    consumed = lib.if_decode_extended(
+        _u8ptr(src), len(body), count,
+        cols["treeID"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["id"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["leftChild"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["rightChild"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["offset"].ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        cols["numInstances"].ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        hyper_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        flat_indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        flat_weights.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        flat_cap,
+    )
+    if consumed != len(body):
+        raise ValueError("corrupt Avro block (extended node records)")
+    total = int(hyper_len.sum())
+    return cols, flat_indices[:total], flat_weights[:total], hyper_len

@@ -1,0 +1,254 @@
+// Native IO accelerator: snappy block decompression + Avro node-record
+// decoding for the two model schemas.
+//
+// The reference's IO runs on the JVM (spark-avro + snappy-java); this
+// framework's portable path is the pure-Python codec in isoforest_tpu/io/avro.py.
+// This translation unit is the native fast path for the record-decoding hot
+// loop when loading large models (e.g. 1000-tree forests = ~500k node
+// records): the Python loader calls these functions through ctypes and falls
+// back transparently when the shared object is unavailable.
+//
+// Clean-room implementations against the public snappy format description and
+// the Avro 1.x binary encoding specification.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// -- varint / zigzag ---------------------------------------------------------
+
+inline bool read_varint(const uint8_t*& p, const uint8_t* end, uint64_t& out) {
+  uint64_t result = 0;
+  int shift = 0;
+  while (p < end) {
+    uint8_t b = *p++;
+    result |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      out = result;
+      return true;
+    }
+    shift += 7;
+    if (shift > 63) return false;
+  }
+  return false;
+}
+
+inline bool read_long(const uint8_t*& p, const uint8_t* end, int64_t& out) {
+  uint64_t raw;
+  if (!read_varint(p, end, raw)) return false;
+  out = static_cast<int64_t>(raw >> 1) ^ -static_cast<int64_t>(raw & 1);
+  return true;
+}
+
+inline bool read_double(const uint8_t*& p, const uint8_t* end, double& out) {
+  if (end - p < 8) return false;
+  std::memcpy(&out, p, 8);
+  p += 8;
+  return true;
+}
+
+inline bool read_float(const uint8_t*& p, const uint8_t* end, float& out) {
+  if (end - p < 4) return false;
+  std::memcpy(&out, p, 4);
+  p += 4;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// -- snappy ------------------------------------------------------------------
+
+// Returns the uncompressed length encoded in a raw snappy block, or -1.
+int64_t if_snappy_uncompressed_len(const uint8_t* data, int64_t len) {
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  uint64_t n;
+  if (!read_varint(p, end, n)) return -1;
+  return static_cast<int64_t>(n);
+}
+
+// Decompress a raw snappy block into out (capacity out_cap).
+// Returns bytes written, or -1 on corruption / insufficient capacity.
+int64_t if_snappy_decompress(const uint8_t* data, int64_t len, uint8_t* out,
+                             int64_t out_cap) {
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  uint64_t expected;
+  if (!read_varint(p, end, expected)) return -1;
+  if (static_cast<int64_t>(expected) > out_cap) return -1;
+  int64_t pos = 0;
+  while (p < end) {
+    uint8_t tag = *p++;
+    uint32_t kind = tag & 0x03;
+    if (kind == 0) {  // literal
+      int64_t n = tag >> 2;
+      if (n >= 60) {
+        int extra = static_cast<int>(n) - 59;
+        if (end - p < extra) return -1;
+        n = 0;
+        for (int i = 0; i < extra; ++i) n |= static_cast<int64_t>(p[i]) << (8 * i);
+        p += extra;
+      }
+      n += 1;
+      if (end - p < n || pos + n > out_cap) return -1;
+      std::memcpy(out + pos, p, n);
+      p += n;
+      pos += n;
+    } else {
+      int64_t length, offset;
+      if (kind == 1) {
+        if (p >= end) return -1;
+        length = ((tag >> 2) & 0x07) + 4;
+        offset = (static_cast<int64_t>(tag >> 5) << 8) | *p++;
+      } else if (kind == 2) {
+        if (end - p < 2) return -1;
+        length = (tag >> 2) + 1;
+        offset = p[0] | (static_cast<int64_t>(p[1]) << 8);
+        p += 2;
+      } else {
+        if (end - p < 4) return -1;
+        length = (tag >> 2) + 1;
+        offset = 0;
+        for (int i = 0; i < 4; ++i) offset |= static_cast<int64_t>(p[i]) << (8 * i);
+        p += 4;
+      }
+      if (offset <= 0 || offset > pos || pos + length > out_cap) return -1;
+      for (int64_t i = 0; i < length; ++i) {  // overlapping copies: byte-wise
+        out[pos] = out[pos - offset];
+        ++pos;
+      }
+    }
+  }
+  return pos == static_cast<int64_t>(expected) ? pos : -1;
+}
+
+// -- Avro node-record decoding ----------------------------------------------
+
+// Decode `count` records of the standard schema
+//   {treeID:int, nodeData: union[{id,leftChild,rightChild,splitAttribute:int,
+//                                 splitValue:double, numInstances:long}, null]}
+// from an uncompressed Avro block body. Union branch 0 = record, 1 = null
+// (spark-avro layout). Null nodeData rows get id = -2.
+// Returns bytes consumed, or -1 on decode error.
+int64_t if_decode_standard(const uint8_t* data, int64_t len, int64_t count,
+                           int32_t* tree_id, int32_t* node_id,
+                           int32_t* left_child, int32_t* right_child,
+                           int32_t* split_attribute, double* split_value,
+                           int64_t* num_instances) {
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  for (int64_t i = 0; i < count; ++i) {
+    int64_t v;
+    if (!read_long(p, end, v)) return -1;
+    tree_id[i] = static_cast<int32_t>(v);
+    if (!read_long(p, end, v)) return -1;  // union index
+    if (v == 0) {
+      int64_t id, lc, rc, sa, ni;
+      double sv;
+      if (!read_long(p, end, id) || !read_long(p, end, lc) ||
+          !read_long(p, end, rc) || !read_long(p, end, sa) ||
+          !read_double(p, end, sv) || !read_long(p, end, ni))
+        return -1;
+      node_id[i] = static_cast<int32_t>(id);
+      left_child[i] = static_cast<int32_t>(lc);
+      right_child[i] = static_cast<int32_t>(rc);
+      split_attribute[i] = static_cast<int32_t>(sa);
+      split_value[i] = sv;
+      num_instances[i] = ni;
+    } else {
+      node_id[i] = -2;
+    }
+  }
+  return p - data;
+}
+
+// Decode `count` records of the extended schema. Variable-length
+// indices/weights are appended to flat buffers (capacity flat_cap) with
+// per-record counts in hyper_len. Null rows get id = -2.
+// Returns bytes consumed, or -1 on error / capacity overflow.
+int64_t if_decode_extended(const uint8_t* data, int64_t len, int64_t count,
+                           int32_t* tree_id, int32_t* node_id,
+                           int32_t* left_child, int32_t* right_child,
+                           double* offset_out, int64_t* num_instances,
+                           int32_t* hyper_len, int32_t* flat_indices,
+                           float* flat_weights, int64_t flat_cap) {
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  int64_t flat_pos = 0;
+  for (int64_t i = 0; i < count; ++i) {
+    int64_t v;
+    if (!read_long(p, end, v)) return -1;
+    tree_id[i] = static_cast<int32_t>(v);
+    if (!read_long(p, end, v)) return -1;  // union index
+    if (v != 0) {
+      node_id[i] = -2;
+      hyper_len[i] = 0;
+      continue;
+    }
+    int64_t id, lc, rc;
+    if (!read_long(p, end, id) || !read_long(p, end, lc) || !read_long(p, end, rc))
+      return -1;
+    node_id[i] = static_cast<int32_t>(id);
+    left_child[i] = static_cast<int32_t>(lc);
+    right_child[i] = static_cast<int32_t>(rc);
+    // indices: union[array[int], null]
+    int64_t union_idx;
+    if (!read_long(p, end, union_idx)) return -1;
+    int64_t n_idx = 0;
+    if (union_idx == 0) {
+      int64_t block;
+      while (true) {
+        if (!read_long(p, end, block)) return -1;
+        if (block == 0) break;
+        if (block < 0) {
+          int64_t bytes;
+          if (!read_long(p, end, bytes)) return -1;
+          block = -block;
+        }
+        for (int64_t j = 0; j < block; ++j) {
+          int64_t item;
+          if (!read_long(p, end, item)) return -1;
+          if (flat_pos + n_idx >= flat_cap) return -1;
+          flat_indices[flat_pos + n_idx] = static_cast<int32_t>(item);
+          ++n_idx;
+        }
+      }
+    }
+    // weights: union[array[float], null]
+    if (!read_long(p, end, union_idx)) return -1;
+    int64_t n_w = 0;
+    if (union_idx == 0) {
+      int64_t block;
+      while (true) {
+        if (!read_long(p, end, block)) return -1;
+        if (block == 0) break;
+        if (block < 0) {
+          int64_t bytes;
+          if (!read_long(p, end, bytes)) return -1;
+          block = -block;
+        }
+        for (int64_t j = 0; j < block; ++j) {
+          float w;
+          if (!read_float(p, end, w)) return -1;
+          if (flat_pos + n_w >= flat_cap) return -1;
+          flat_weights[flat_pos + n_w] = w;
+          ++n_w;
+        }
+      }
+    }
+    if (n_w != n_idx) return -1;
+    hyper_len[i] = static_cast<int32_t>(n_idx);
+    flat_pos += n_idx;
+    double off;
+    int64_t ni;
+    if (!read_double(p, end, off) || !read_long(p, end, ni)) return -1;
+    offset_out[i] = off;
+    num_instances[i] = ni;
+  }
+  return p - data;
+}
+
+}  // extern "C"

@@ -29,11 +29,8 @@ caller.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
 from ..utils.math import avg_path_length, height_of as _height_of
@@ -70,7 +67,7 @@ def _level_walk(B: jax.Array, is_internal: jax.Array, leaf_value: jax.Array, h: 
     return total
 
 
-def _leaf_values(num_instances: jax.Array, M: int, h: int) -> jax.Array:
+def _leaf_values(num_instances: jax.Array, h: int) -> jax.Array:
     """Per-slot ``depth + c(numInstances)`` at leaves, 0 elsewhere."""
     depth = jnp.concatenate(
         [jnp.full(((1 << level),), float(level), jnp.float32) for level in range(h + 1)]
@@ -81,8 +78,7 @@ def _leaf_values(num_instances: jax.Array, M: int, h: int) -> jax.Array:
 
 def standard_path_lengths_dense(forest: StandardForest, X: jax.Array) -> jax.Array:
     """Dense scoring for the standard forest; ``f32[C]`` mean path lengths."""
-    M = forest.max_nodes
-    h = _height_of(M)
+    h = _height_of(forest.max_nodes)
     F = X.shape[1]
 
     def one_tree(carry, tree):
@@ -91,7 +87,7 @@ def standard_path_lengths_dense(forest: StandardForest, X: jax.Array) -> jax.Arr
         foh = jax.nn.one_hot(jnp.maximum(feature, 0), F, dtype=X.dtype)  # [M, F]
         xv = jnp.einsum("cf,mf->cm", X, foh)
         B = xv >= threshold[None, :]
-        leaf_value = _leaf_values(num_instances, M, h)
+        leaf_value = _leaf_values(num_instances, h)
         pl = _level_walk(B, feature >= 0, leaf_value, h)
         return carry + pl, None
 
@@ -105,10 +101,8 @@ def standard_path_lengths_dense(forest: StandardForest, X: jax.Array) -> jax.Arr
 
 def extended_path_lengths_dense(forest: ExtendedForest, X: jax.Array) -> jax.Array:
     """Dense EIF scoring: hyperplane tests as one MXU matmul per tree."""
-    M = forest.max_nodes
-    h = _height_of(M)
+    h = _height_of(forest.max_nodes)
     F = X.shape[1]
-    k = forest.k
 
     def one_tree(carry, tree):
         indices, weights, offset, num_instances = tree
@@ -118,7 +112,7 @@ def extended_path_lengths_dense(forest: ExtendedForest, X: jax.Array) -> jax.Arr
         W = jnp.einsum("mk,mkf->mf", weights * valid[..., 0], foh)  # [M, F]
         dots = X @ W.T  # [C, M] — MXU
         B = dots >= offset[None, :]
-        leaf_value = _leaf_values(num_instances, M, h)
+        leaf_value = _leaf_values(num_instances, h)
         pl = _level_walk(B, indices[:, 0] >= 0, leaf_value, h)
         return carry + pl, None
 

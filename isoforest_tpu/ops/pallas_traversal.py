@@ -155,6 +155,27 @@ def _extended_pallas(X, W_dense, offset, internal, leaf_value, interpret=False):
     )(X, W_dense, offset, internal, leaf_value)[:, 0]
 
 
+# The forest is immutable once trained/loaded, but the kernel needs host-side
+# prep (leaf-value tables; densified hyperplanes for EIF — O(T*M*F)). Cache
+# prep per forest, keyed by the identity of its first array; holding a strong
+# reference to that key array prevents id() reuse. Bounded FIFO.
+_PREP_CACHE: dict = {}
+_PREP_CACHE_MAX = 8
+
+
+def _cached_prep(forest, build, extra_key=()):
+    key_array = forest[0]
+    key = (id(key_array), tuple(forest[0].shape), extra_key)
+    hit = _PREP_CACHE.get(key)
+    if hit is not None and hit[0] is key_array:
+        return hit[1]
+    prep = build()
+    if len(_PREP_CACHE) >= _PREP_CACHE_MAX:
+        _PREP_CACHE.pop(next(iter(_PREP_CACHE)))
+    _PREP_CACHE[key] = (key_array, prep)
+    return prep
+
+
 def path_lengths_pallas(forest, X, interpret: bool = False) -> jax.Array:
     """Mean path lengths via the Pallas kernel. Rows are padded to the row
     block internally; pass ``interpret=True`` off-TPU."""
@@ -163,34 +184,37 @@ def path_lengths_pallas(forest, X, interpret: bool = False) -> jax.Array:
     pad = (-n) % _ROW_BLOCK
     if pad:
         X = jnp.pad(X, ((0, pad), (0, 0)))
-    h = _height_of(
-        forest.max_nodes if hasattr(forest, "max_nodes") else forest[0].shape[1]
-    )
+    h = _height_of(forest.max_nodes)
     if isinstance(forest, StandardForest):
-        leaf_value = _leaf_value_tables(forest.num_instances, h)
-        out = _standard_pallas(
-            X,
-            jnp.asarray(forest.feature, jnp.float32),
-            jnp.asarray(forest.threshold),
-            leaf_value,
-            interpret=interpret,
-        )
+
+        def build_standard():
+            return (
+                jnp.asarray(forest.feature, jnp.float32),
+                jnp.asarray(forest.threshold),
+                _leaf_value_tables(forest.num_instances, h),
+            )
+
+        feature_f32, threshold, leaf_value = _cached_prep(forest, build_standard)
+        out = _standard_pallas(X, feature_f32, threshold, leaf_value, interpret=interpret)
     else:
         F = X.shape[1]
-        indices = np.asarray(forest.indices)
-        weights = np.asarray(forest.weights)
-        T, M, k = indices.shape
-        W = np.zeros((T, M, F), np.float32)
-        t_ix, m_ix, k_ix = np.nonzero(indices >= 0)
-        W[t_ix, m_ix, indices[t_ix, m_ix, k_ix]] += weights[t_ix, m_ix, k_ix]
-        leaf_value = _leaf_value_tables(forest.num_instances, h)
-        internal = jnp.asarray((indices[..., 0] >= 0).astype(np.float32))
-        out = _extended_pallas(
-            X,
-            jnp.asarray(W),
-            jnp.asarray(forest.offset),
-            internal,
-            leaf_value,
-            interpret=interpret,
+
+        def build_extended():
+            indices = np.asarray(forest.indices)
+            weights = np.asarray(forest.weights)
+            T, M, _ = indices.shape
+            W = np.zeros((T, M, F), np.float32)
+            t_ix, m_ix, k_ix = np.nonzero(indices >= 0)
+            W[t_ix, m_ix, indices[t_ix, m_ix, k_ix]] += weights[t_ix, m_ix, k_ix]
+            return (
+                jnp.asarray(W),
+                jnp.asarray(forest.offset),
+                jnp.asarray((indices[..., 0] >= 0).astype(np.float32)),
+                _leaf_value_tables(forest.num_instances, h),
+            )
+
+        W, offset, internal, leaf_value = _cached_prep(
+            forest, build_extended, extra_key=(F,)
         )
+        out = _extended_pallas(X, W, offset, internal, leaf_value, interpret=interpret)
     return out[:n]

@@ -152,12 +152,17 @@ def score_matrix(
     if strategy == "pallas":
         from .pallas_traversal import path_lengths_pallas
 
-        X = jnp.asarray(X, jnp.float32)
-        if X.shape[0] == 0:
-            return np.zeros((0,), np.float32)
         interpret = jax.devices()[0].platform != "tpu"
-        pl_len = path_lengths_pallas(forest, X, interpret=interpret)
-        return np.asarray(score_from_path_length(pl_len, num_samples))
+
+        def run_chunk(chunk):
+            pl_len = path_lengths_pallas(forest, chunk, interpret=interpret)
+            return score_from_path_length(pl_len, num_samples)
+
+    else:
+
+        def run_chunk(chunk):
+            return _score_chunk(forest, chunk, num_samples, strategy)
+
     X = jnp.asarray(X, jnp.float32)
     n = X.shape[0]
     if n == 0:
@@ -167,8 +172,7 @@ def score_matrix(
         pad = bucket - n
         if pad:
             X = jnp.pad(X, ((0, pad), (0, 0)))
-        scores = _score_chunk(forest, X, num_samples, strategy)
-        return np.asarray(scores[:n])
+        return np.asarray(run_chunk(X)[:n])
 
     outs = []
     for start in range(0, n, chunk_size):
@@ -176,6 +180,6 @@ def score_matrix(
         pad = chunk_size - chunk.shape[0]
         if pad:
             chunk = jnp.pad(chunk, ((0, pad), (0, 0)))
-        scores = _score_chunk(forest, chunk, num_samples, strategy)
+        scores = run_chunk(chunk)
         outs.append(np.asarray(scores[: chunk_size - pad] if pad else scores))
     return np.concatenate(outs)

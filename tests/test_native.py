@@ -1,0 +1,145 @@
+"""Native IO accelerator tests: build the C++ library and pin its snappy and
+Avro record decoders against the pure-Python codec on the reference's own
+Spark-written fixtures."""
+
+import pathlib
+import zlib
+
+import numpy as np
+import pytest
+
+from isoforest_tpu import native
+from isoforest_tpu.io import avro
+
+_FIXTURES = pathlib.Path("/root/reference/isolation-forest/src/test/resources")
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="C++ toolchain unavailable"
+)
+
+
+def _fixture_blocks(name: str):
+    """(schema, codec, [(count, compressed_block, crc)]) of a fixture file."""
+    path = next((_FIXTURES / name / "data").glob("*.avro"))
+    data = open(path, "rb").read()
+    reader = avro._Reader(data, 4)
+    meta = {}
+    while True:
+        count = reader.read_long()
+        if count == 0:
+            break
+        for _ in range(abs(count)):
+            key = reader.read_bytes().decode()
+            meta[key] = reader.read_bytes()
+    reader.read_raw(avro.SYNC_SIZE)
+    blocks = []
+    while reader.pos < len(data):
+        count = reader.read_long()
+        size = reader.read_long()
+        blocks.append((count, reader.read_raw(size)))
+        reader.read_raw(avro.SYNC_SIZE)
+    return meta, blocks
+
+
+class TestNativeSnappy:
+    def test_fixture_blocks_roundtrip(self):
+        if not (_FIXTURES / "savedIsolationForestModel").exists():
+            pytest.skip("reference fixture unavailable")
+        meta, blocks = _fixture_blocks("savedIsolationForestModel")
+        assert meta["avro.codec"] == b"snappy"
+        for count, block in blocks:
+            native_out = native.snappy_decompress(block[:-4])
+            python_out = avro.snappy_decompress(block[:-4])
+            assert native_out == python_out
+            crc = int.from_bytes(block[-4:], "big")
+            assert zlib.crc32(native_out) & 0xFFFFFFFF == crc
+
+    def test_corrupt_stream_raises(self):
+        with pytest.raises(ValueError):
+            native.snappy_decompress(b"\xff\xff\xff\xff\xff\x00\x01\x02")
+
+
+class TestNativeRecordDecoders:
+    def test_standard_matches_python(self):
+        if not (_FIXTURES / "savedIsolationForestModel").exists():
+            pytest.skip("reference fixture unavailable")
+        path = next((_FIXTURES / "savedIsolationForestModel" / "data").glob("*.avro"))
+        _, records = avro.read_container(str(path))
+        _, blocks = _fixture_blocks("savedIsolationForestModel")
+        decoded = 0
+        for count, block in blocks:
+            body = avro.snappy_decompress(block[:-4])
+            cols = native.decode_standard_block(body, count)
+            for i in range(count):
+                want = records[decoded + i]
+                assert cols["treeID"][i] == want["treeID"]
+                nd = want["nodeData"]
+                assert cols["id"][i] == nd["id"]
+                assert cols["leftChild"][i] == nd["leftChild"]
+                assert cols["splitAttribute"][i] == nd["splitAttribute"]
+                assert cols["splitValue"][i] == nd["splitValue"]
+                assert cols["numInstances"][i] == nd["numInstances"]
+            decoded += count
+        assert decoded == len(records)
+
+    def test_extended_matches_python(self):
+        if not (_FIXTURES / "savedExtendedIsolationForestModel").exists():
+            pytest.skip("reference fixture unavailable")
+        path = next(
+            (_FIXTURES / "savedExtendedIsolationForestModel" / "data").glob("*.avro")
+        )
+        _, records = avro.read_container(str(path))
+        _, blocks = _fixture_blocks("savedExtendedIsolationForestModel")
+        decoded = 0
+        for count, block in blocks:
+            body = avro.snappy_decompress(block[:-4])
+            cols, flat_idx, flat_w, lens = native.decode_extended_block(body, count)
+            pos = 0
+            for i in range(count):
+                want = records[decoded + i]["extendedNodeData"]
+                assert cols["id"][i] == want["id"]
+                assert cols["offset"][i] == want["offset"]
+                assert cols["numInstances"][i] == want["numInstances"]
+                n = lens[i]
+                assert list(flat_idx[pos : pos + n]) == want["indices"]
+                np.testing.assert_array_equal(
+                    flat_w[pos : pos + n], np.asarray(want["weights"], np.float32)
+                )
+                pos += n
+            decoded += count
+        assert decoded == len(records)
+
+    def test_deflate_written_by_us(self, tmp_path):
+        """Native decoder also reads blocks our writer produces."""
+        schema = __import__(
+            "isoforest_tpu.io.persistence", fromlist=["STANDARD_SCHEMA"]
+        ).STANDARD_SCHEMA
+        records = [
+            {"treeID": 0, "nodeData": {"id": 0, "leftChild": 1, "rightChild": 2,
+                                       "splitAttribute": 1, "splitValue": 0.25,
+                                       "numInstances": -1}},
+            {"treeID": 0, "nodeData": {"id": 1, "leftChild": -1, "rightChild": -1,
+                                       "splitAttribute": -1, "splitValue": 0.0,
+                                       "numInstances": 5}},
+            {"treeID": 0, "nodeData": {"id": 2, "leftChild": -1, "rightChild": -1,
+                                       "splitAttribute": -1, "splitValue": 0.0,
+                                       "numInstances": 7}},
+        ]
+        p = tmp_path / "t.avro"
+        avro.write_container(str(p), schema, records, codec="null")
+        data = open(p, "rb").read()
+        reader = avro._Reader(data, 4)
+        while True:
+            c = reader.read_long()
+            if c == 0:
+                break
+            for _ in range(abs(c)):
+                reader.read_bytes()
+                reader.read_bytes()
+        reader.read_raw(avro.SYNC_SIZE)
+        count = reader.read_long()
+        size = reader.read_long()
+        body = reader.read_raw(size)
+        cols = native.decode_standard_block(body, count)
+        assert list(cols["id"]) == [0, 1, 2]
+        assert list(cols["numInstances"]) == [-1, 5, 7]

@@ -1,0 +1,84 @@
+"""sklearn-adapter tests: protocol conformance and pipeline composition —
+the analogue of the reference's spark.ml Pipeline integration."""
+
+import numpy as np
+import pytest
+
+from isoforest_tpu.sklearn import TpuIsolationForest
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(3000, 5)).astype(np.float32)
+    X[:60] += 6.0
+    y = np.zeros(3000)
+    y[:60] = 1
+    return X, y
+
+
+class TestSklearnProtocol:
+    def test_fit_returns_self_and_predict_signs(self, data):
+        X, y = data
+        est = TpuIsolationForest(n_estimators=20, contamination=0.02)
+        assert est.fit(X) is est
+        pred = est.predict(X)
+        assert set(np.unique(pred)) <= {-1, 1}
+        # outlier cluster should be flagged -1 overwhelmingly
+        assert (pred[:60] == -1).mean() > 0.8
+
+    def test_score_samples_negated(self, data):
+        X, _ = data
+        est = TpuIsolationForest(n_estimators=20).fit(X)
+        s = est.score_samples(X)
+        assert np.all(s <= 0)
+        # outliers have LOWER (more negative) score_samples, like sklearn
+        assert s[:60].mean() < s[60:].mean()
+
+    def test_decision_function_threshold(self, data):
+        X, _ = data
+        est = TpuIsolationForest(n_estimators=20, contamination=0.02).fit(X)
+        d = est.decision_function(X)
+        np.testing.assert_array_equal(est.predict(X), np.where(d < 0, -1, 1))
+
+    def test_extension_level_routes_to_extended(self, data):
+        X, _ = data
+        est = TpuIsolationForest(n_estimators=10, extension_level=2).fit(X)
+        from isoforest_tpu import ExtendedIsolationForestModel
+
+        assert isinstance(est.model_, ExtendedIsolationForestModel)
+        assert est.model_.extension_level == 2
+
+    def test_unfitted_raises(self):
+        with pytest.raises(RuntimeError):
+            TpuIsolationForest().score_samples(np.zeros((2, 2), np.float32))
+
+    def test_get_set_params(self):
+        est = TpuIsolationForest(n_estimators=7)
+        params = est.get_params()
+        assert params["n_estimators"] == 7
+        est.set_params(n_estimators=9)
+        assert est.n_estimators == 9
+
+
+class TestPipelineComposition:
+    def test_inside_sklearn_pipeline(self, data):
+        from sklearn.pipeline import Pipeline
+        from sklearn.preprocessing import StandardScaler
+
+        X, y = data
+        pipe = Pipeline(
+            [
+                ("scale", StandardScaler()),
+                ("forest", TpuIsolationForest(n_estimators=20, contamination=0.02)),
+            ]
+        )
+        pred = pipe.fit_predict(X)
+        assert (pred[:60] == -1).mean() > 0.8
+
+    def test_clone(self):
+        from sklearn.base import clone
+
+        est = TpuIsolationForest(n_estimators=5, extension_level=1)
+        c = clone(est)
+        assert c.n_estimators == 5 and c.extension_level == 1
