@@ -27,24 +27,10 @@ CONTAMINATION = 0.004  # ~attack rate of the http subset
 
 
 def make_data(n: int = NUM_ROWS, seed: int = 7) -> tuple[np.ndarray, np.ndarray]:
-    """KDDCup99-HTTP-like synthetic: log-scaled duration/src/dst bytes with a
-    small dense anomaly cluster."""
-    rng = np.random.default_rng(seed)
-    n_out = int(n * CONTAMINATION)
-    normal = rng.multivariate_normal(
-        mean=[0.0, 5.2, 8.0],
-        cov=[[0.6, 0.1, 0.0], [0.1, 1.2, 0.3], [0.0, 0.3, 1.5]],
-        size=n - n_out,
-    )
-    attacks = rng.multivariate_normal(
-        mean=[4.5, 9.5, 2.0],
-        cov=[[1.0, 0.0, 0.0], [0.0, 1.0, 0.0], [0.0, 0.0, 1.0]],
-        size=n_out,
-    )
-    X = np.vstack([normal, attacks]).astype(np.float32)
-    y = np.concatenate([np.zeros(n - n_out), np.ones(n_out)])
-    perm = rng.permutation(n)
-    return X[perm], y[perm]
+    """KDDCup99-HTTP-like synthetic mixture (see isoforest_tpu.data)."""
+    from isoforest_tpu.data import kddcup_http_like
+
+    return kddcup_http_like(n=n, contamination=CONTAMINATION, seed=seed)
 
 
 def auroc(scores: np.ndarray, labels: np.ndarray) -> float:
@@ -182,5 +168,75 @@ def main() -> None:
     )
 
 
+def full_sweep() -> None:
+    """The BASELINE.json stress configurations, one JSON line each
+    (``python bench.py --full``; the default invocation keeps the single-line
+    contract the driver expects)."""
+    import pathlib
+
+    from isoforest_tpu import ExtendedIsolationForest, IsolationForest
+    from isoforest_tpu.data import (
+        high_dim_blobs,
+        kddcup_http_like,
+        load_labeled_csv,
+        sinusoid,
+        two_blobs,
+    )
+
+    fixtures = pathlib.Path("/root/reference/isolation-forest/src/test/resources")
+
+    def run(name, estimator, X, y):
+        estimator.fit(X).score(X)  # warm-up: compile growth AND scoring
+        start = time.perf_counter()
+        model = estimator.fit(X)
+        scores = model.score(X)
+        elapsed = time.perf_counter() - start
+        print(
+            json.dumps(
+                {
+                    "metric": name,
+                    "value": round(len(X) / elapsed, 1),
+                    "unit": "rows/s",
+                    "auroc": round(auroc(scores, y), 4),
+                    "seconds": round(elapsed, 3),
+                }
+            )
+        )
+
+    if (fixtures / "shuttle.csv").exists():
+        Xs, ys = load_labeled_csv(str(fixtures / "shuttle.csv"))
+        run("shuttle_std_100trees", IsolationForest(num_estimators=100), Xs, ys)
+    if (fixtures / "mammography.csv").exists():
+        Xm, ym = load_labeled_csv(str(fixtures / "mammography.csv"))
+        run(
+            "mammography_bootstrap_256",
+            IsolationForest(num_estimators=100, max_samples=256.0, bootstrap=True),
+            Xm,
+            ym,
+        )
+    Xb, yb = two_blobs(n=8192)
+    run("two_blobs_eif_full", ExtendedIsolationForest(num_estimators=100), Xb, yb)
+    Xw, yw = sinusoid(n=8192)
+    run("sinusoid_eif_full", ExtendedIsolationForest(num_estimators=100), Xw, yw)
+    Xk, yk = kddcup_http_like(n=567_000)
+    run(
+        "kddcup_http_567k_1000trees",
+        IsolationForest(num_estimators=1000),
+        Xk,
+        yk,
+    )
+    Xh, yh = high_dim_blobs(n=20000, f=274)
+    run(
+        "high_dim_274f_maxfeatures_0.5",
+        IsolationForest(num_estimators=100, max_features=0.5),
+        Xh,
+        yh,
+    )
+
+
 if __name__ == "__main__":
-    main()
+    if "--full" in sys.argv:
+        _ensure_live_backend()
+        full_sweep()
+    else:
+        main()

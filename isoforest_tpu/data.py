@@ -1,0 +1,96 @@
+"""Dataset utilities: labeled-CSV loading and the benchmark generators.
+
+The reference's data plumbing is Spark DataFrames + committed ODDS CSVs with
+explicit schemas and a VectorAssembler (core/TestUtils.scala:58-135). The
+analogues here: a numpy CSV loader with the same ``f1,...,fk,label`` row
+contract, and synthetic generators for the BASELINE.json stress
+configurations (two-blobs / sinusoid — the Extended Isolation Forest paper's
+canonical shapes — and a KDDCup99-HTTP-like mixture).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def load_labeled_csv(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Load ``f1,...,fk,label`` rows (``#`` comments) -> (f32[N,F], labels[N])."""
+    data = np.loadtxt(path, delimiter=",", comments="#").astype(np.float32)
+    if data.ndim != 2 or data.shape[1] < 2:
+        raise ValueError(f"{path}: expected rows of features plus a label column")
+    return data[:, :-1], data[:, -1].astype(np.float64)
+
+
+def two_blobs(
+    n: int = 4096, contamination: float = 0.02, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Two dense Gaussian blobs + sparse background anomalies (EIF paper fig. 2:
+    the shape where axis-aligned score maps show 'ghost' artifacts that
+    hyperplane splits remove)."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    n_in = n - n_out
+    a = rng.normal(loc=(0.0, 10.0), scale=1.0, size=(n_in // 2, 2))
+    b = rng.normal(loc=(10.0, 0.0), scale=1.0, size=(n_in - n_in // 2, 2))
+    outliers = rng.uniform(low=-5.0, high=15.0, size=(n_out, 2))
+    X = np.vstack([a, b, outliers]).astype(np.float32)
+    y = np.concatenate([np.zeros(n_in), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
+def sinusoid(
+    n: int = 4096, contamination: float = 0.02, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Points along a sine curve + uniform anomalies (EIF paper fig. 3)."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    n_in = n - n_out
+    x = rng.uniform(0.0, 10.0, size=n_in)
+    y_coord = np.sin(x) + rng.normal(scale=0.15, size=n_in)
+    inliers = np.stack([x, y_coord], axis=1)
+    outliers = rng.uniform(low=(0.0, -4.0), high=(10.0, 4.0), size=(n_out, 2))
+    X = np.vstack([inliers, outliers]).astype(np.float32)
+    y = np.concatenate([np.zeros(n_in), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
+def kddcup_http_like(
+    n: int = 1_000_000, contamination: float = 0.004, seed: int = 7
+) -> Tuple[np.ndarray, np.ndarray]:
+    """KDDCup99-HTTP-like 3-feature mixture (log-scaled duration/src/dst
+    bytes) with a dense attack cluster."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    normal = rng.multivariate_normal(
+        mean=[0.0, 5.2, 8.0],
+        cov=[[0.6, 0.1, 0.0], [0.1, 1.2, 0.3], [0.0, 0.3, 1.5]],
+        size=n - n_out,
+    )
+    attacks = rng.multivariate_normal(
+        mean=[4.5, 9.5, 2.0], cov=np.eye(3).tolist(), size=n_out
+    )
+    X = np.vstack([normal, attacks]).astype(np.float32)
+    y = np.concatenate([np.zeros(n - n_out), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
+def high_dim_blobs(
+    n: int = 20000, f: int = 274, contamination: float = 0.02, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """High-dimensional correlated blobs (Arrhythmia-274-like shape) for the
+    maxFeatures < 1.0 column-subsampling stress config."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    basis = rng.normal(size=(16, f))
+    inliers = rng.normal(size=(n - n_out, 16)) @ basis
+    outliers = rng.normal(scale=4.0, size=(n_out, 16)) @ basis
+    X = np.vstack([inliers, outliers]).astype(np.float32)
+    X += rng.normal(scale=0.1, size=X.shape).astype(np.float32)
+    y = np.concatenate([np.zeros(n - n_out), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]

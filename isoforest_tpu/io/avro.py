@@ -333,8 +333,11 @@ def write_container(
         flush(batch)
 
 
-def read_container(path: str) -> Tuple[Any, List[dict]]:
-    """Read an Avro object-container file -> (parsed schema, records)."""
+def read_blocks(path: str) -> Tuple[Any, List[Tuple[int, bytes]]]:
+    """Read an Avro container -> (parsed schema, [(record_count, plaintext
+    block body)]). Codec (null/deflate/snappy) handled here; record decoding
+    is the caller's choice (generic :func:`decode_value`, or the native
+    columnar decoders in :mod:`isoforest_tpu.native`)."""
     data = open(path, "rb").read()
     if data[:4] != MAGIC:
         raise ValueError(f"{path}: not an Avro object container file")
@@ -354,7 +357,7 @@ def read_container(path: str) -> Tuple[Any, List[dict]]:
     schema = json.loads(meta["avro.schema"].decode())
     codec = meta.get("avro.codec", b"null").decode()
 
-    records: List[dict] = []
+    blocks: List[Tuple[int, bytes]] = []
     n = len(data)
     while reader.pos < n:
         count = reader.read_long()
@@ -377,9 +380,18 @@ def read_container(path: str) -> Tuple[Any, List[dict]]:
                 raise ValueError(f"{path}: snappy block CRC mismatch")
         elif codec != "null":
             raise ValueError(f"unsupported read codec {codec!r}")
+        blocks.append((count, block))
+        if reader.read_raw(SYNC_SIZE) != sync:
+            raise ValueError(f"{path}: sync marker mismatch")
+    return schema, blocks
+
+
+def read_container(path: str) -> Tuple[Any, List[dict]]:
+    """Read an Avro object-container file -> (parsed schema, records)."""
+    schema, blocks = read_blocks(path)
+    records: List[dict] = []
+    for count, block in blocks:
         block_reader = _Reader(block)
         for _ in range(count):
             records.append(decode_value(schema, block_reader))
-        if reader.read_raw(SYNC_SIZE) != sync:
-            raise ValueError(f"{path}: sync marker mismatch")
     return schema, records

@@ -329,6 +329,165 @@ def _read_data(path: str) -> List[dict]:
     return records
 
 
+# --------------------------------------------------------------------------- #
+# native columnar load fast path
+# --------------------------------------------------------------------------- #
+
+
+def _preorder_slots(is_internal_list: List[bool]) -> Tuple[List[int], int]:
+    """Heap slots for a tree's nodes given their pre-order internal flags.
+
+    Pre-order with contiguous ids makes child lookup unnecessary: walk the
+    sequence with an explicit slot stack (left child visited immediately
+    after its parent). Returns (slots, max_depth)."""
+    slots = [0] * len(is_internal_list)
+    stack = [0]
+    max_slot = 0
+    for i, internal in enumerate(is_internal_list):
+        slot = stack.pop()
+        slots[i] = slot
+        if slot > max_slot:
+            max_slot = slot
+        if internal:
+            stack.append(2 * slot + 2)  # right pops after the left subtree
+            stack.append(2 * slot + 1)
+    if stack:
+        raise ValueError("corrupt model data: pre-order walk did not consume tree")
+    depth = 0
+    while (1 << (depth + 1)) - 1 <= max_slot:
+        depth += 1
+    return slots, depth
+
+
+def _native_node_columns(path: str, kind: str):
+    """Decode the node table into numpy columns via the C++ accelerator;
+    None when the native library is unavailable. ``kind``: 'standard' |
+    'extended'."""
+    from .. import native
+
+    if not native.available():
+        return None
+    data_dir = os.path.join(path, "data")
+    col_parts = []
+    flat_parts = []
+    for fname in sorted(os.listdir(data_dir)):
+        if not fname.endswith(".avro"):
+            continue
+        _, blocks = avro.read_blocks(os.path.join(data_dir, fname))
+        for count, body in blocks:
+            if kind == "standard":
+                cols = native.decode_standard_block(body, count)
+                col_parts.append(cols)
+            else:
+                cols, flat_idx, flat_w, lens = native.decode_extended_block(body, count)
+                cols = dict(cols)
+                cols["_hyper_len"] = lens
+                col_parts.append(cols)
+                flat_parts.append((flat_idx, flat_w))
+    if not col_parts:
+        raise FileNotFoundError(f"no avro data files under {data_dir}")
+    merged = {
+        k: np.concatenate([c[k] for c in col_parts]) for k in col_parts[0]
+    }
+    if np.any(merged["id"] == -2):
+        raise ValueError("corrupt model data: null nodeData rows")
+    if kind == "extended":
+        merged["_flat_indices"] = np.concatenate([f for f, _ in flat_parts])
+        merged["_flat_weights"] = np.concatenate([w for _, w in flat_parts])
+    return merged
+
+
+def _column_tree_ranges(tree_id: np.ndarray, node_id: np.ndarray):
+    """Sort columns by (treeID, id); validate contiguity; return sorted order
+    and per-tree [start, end) ranges."""
+    order = np.lexsort((node_id, tree_id))
+    tid = tree_id[order]
+    nid = node_id[order]
+    tree_ids = np.unique(tid)
+    if not np.array_equal(tree_ids, np.arange(len(tree_ids))):
+        raise ValueError("corrupt model data: treeIDs are not contiguous 0..T-1")
+    starts = np.searchsorted(tid, np.arange(len(tree_ids) + 1))
+    for t in range(len(tree_ids)):
+        s, e = starts[t], starts[t + 1]
+        if not np.array_equal(nid[s:e], np.arange(e - s)):
+            raise ValueError("corrupt model data: node ids are not 0..N-1")
+    return order, starts
+
+
+def columns_to_standard_forest(cols, threshold_dtype=np.float32) -> StandardForest:
+    order, starts = _column_tree_ranges(cols["treeID"], cols["id"])
+    lc = cols["leftChild"][order]
+    sa = cols["splitAttribute"][order]
+    sv = cols["splitValue"][order]
+    ni = cols["numInstances"][order]
+    T = len(starts) - 1
+    internal = (lc >= 0).tolist()
+    all_slots = np.empty(len(lc), np.int64)
+    height = 0
+    for t in range(T):
+        s, e = starts[t], starts[t + 1]
+        slots, depth = _preorder_slots(internal[s:e])
+        all_slots[s:e] = slots
+        height = max(height, depth)
+    M = 2 ** (height + 1) - 1
+    feature = np.full((T, M), -1, np.int32)
+    threshold = np.zeros((T, M), threshold_dtype)
+    num_instances = np.full((T, M), -1, np.int32)
+    tree_of = np.repeat(np.arange(T), np.diff(starts))
+    is_int = lc >= 0
+    feature[tree_of[is_int], all_slots[is_int]] = sa[is_int]
+    threshold[tree_of[is_int], all_slots[is_int]] = sv[is_int]
+    num_instances[tree_of[~is_int], all_slots[~is_int]] = ni[~is_int]
+    return StandardForest(
+        feature=feature, threshold=threshold, num_instances=num_instances
+    )
+
+
+def columns_to_extended_forest(cols, offset_dtype=np.float32) -> ExtendedForest:
+    order, starts = _column_tree_ranges(cols["treeID"], cols["id"])
+    lc = cols["leftChild"][order]
+    off = cols["offset"][order]
+    ni = cols["numInstances"][order]
+    lens = cols["_hyper_len"][order]
+    # flat hyperplane buffers are in original record order
+    flat_starts = np.zeros(len(lc) + 1, np.int64)
+    np.cumsum(cols["_hyper_len"], out=flat_starts[1:])
+    T = len(starts) - 1
+    internal = (lc >= 0).tolist()
+    all_slots = np.empty(len(lc), np.int64)
+    height = 0
+    for t in range(T):
+        s, e = starts[t], starts[t + 1]
+        slots, depth = _preorder_slots(internal[s:e])
+        all_slots[s:e] = slots
+        height = max(height, depth)
+    M = 2 ** (height + 1) - 1
+    k = int(lens.max()) if len(lens) else 1
+    k = max(k, 1)
+    indices = np.full((T, M, k), -1, np.int32)
+    weights = np.zeros((T, M, k), np.float32)
+    offset = np.zeros((T, M), offset_dtype)
+    num_instances = np.full((T, M), -1, np.int32)
+    tree_of = np.repeat(np.arange(T), np.diff(starts))
+    flat_idx = cols["_flat_indices"]
+    flat_w = cols["_flat_weights"]
+    for pos in range(len(lc)):
+        orig = order[pos]
+        t = tree_of[pos]
+        slot = all_slots[pos]
+        if lc[pos] >= 0:
+            n_k = int(cols["_hyper_len"][orig])
+            fs = flat_starts[orig]
+            indices[t, slot, :n_k] = flat_idx[fs : fs + n_k]
+            weights[t, slot, :n_k] = flat_w[fs : fs + n_k]
+            offset[t, slot] = off[pos]
+        else:
+            num_instances[t, slot] = ni[pos]
+    return ExtendedForest(
+        indices=indices, weights=weights, offset=offset, num_instances=num_instances
+    )
+
+
 def _group_trees(records: List[dict], payload_field: str) -> List[List[dict]]:
     """groupByKey(treeID) + sortByKey equivalent
     (IsolationForestModelReadWrite.scala:282-288)."""
@@ -426,8 +585,15 @@ def load_standard_model(path: str):
 
     metadata, total_num_features = _load_common(path, STANDARD_MODEL_CLASS)
     params = IsolationForestParams.from_param_map(metadata["paramMap"])
-    trees = _group_trees(_read_data(path), "nodeData")
-    forest = records_to_standard_forest(trees)
+    try:  # native columnar fast path (~5x on 1000-tree models)
+        cols = _native_node_columns(path, "standard")
+    except (ImportError, OSError):
+        cols = None
+    if cols is not None:
+        forest = columns_to_standard_forest(cols)
+    else:
+        trees = _group_trees(_read_data(path), "nodeData")
+        forest = records_to_standard_forest(trees)
     model = IsolationForestModel(
         forest=forest,
         params=params,
@@ -447,8 +613,15 @@ def load_extended_model(path: str):
 
     metadata, total_num_features = _load_common(path, EXTENDED_MODEL_CLASS)
     params = ExtendedIsolationForestParams.from_param_map(metadata["paramMap"])
-    trees = _group_trees(_read_data(path), "extendedNodeData")
-    forest = records_to_extended_forest(trees)
+    try:
+        cols = _native_node_columns(path, "extended")
+    except (ImportError, OSError):
+        cols = None
+    if cols is not None:
+        forest = columns_to_extended_forest(cols)
+    else:
+        trees = _group_trees(_read_data(path), "extendedNodeData")
+        forest = records_to_extended_forest(trees)
     model = ExtendedIsolationForestModel(
         forest=forest,
         params=params,

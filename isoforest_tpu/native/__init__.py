@@ -125,14 +125,16 @@ def decode_standard_block(body: bytes, count: int):
     if lib is None:
         return None
     src = np.frombuffer(body, np.uint8)
+    # pre-fill with sentinels: null-union rows only write id (= -2), so every
+    # sibling column must hold defined values, not uninitialised memory
     cols = {
-        "treeID": np.empty(count, np.int32),
-        "id": np.empty(count, np.int32),
-        "leftChild": np.empty(count, np.int32),
-        "rightChild": np.empty(count, np.int32),
-        "splitAttribute": np.empty(count, np.int32),
-        "splitValue": np.empty(count, np.float64),
-        "numInstances": np.empty(count, np.int64),
+        "treeID": np.full(count, -1, np.int32),
+        "id": np.full(count, -2, np.int32),
+        "leftChild": np.full(count, -1, np.int32),
+        "rightChild": np.full(count, -1, np.int32),
+        "splitAttribute": np.full(count, -1, np.int32),
+        "splitValue": np.zeros(count, np.float64),
+        "numInstances": np.full(count, -1, np.int64),
     }
     consumed = lib.if_decode_standard(
         _u8ptr(src), len(body), count,
@@ -158,14 +160,14 @@ def decode_extended_block(body: bytes, count: int):
     src = np.frombuffer(body, np.uint8)
     flat_cap = max(len(body), 16)  # safe upper bound: >= total array items
     cols = {
-        "treeID": np.empty(count, np.int32),
-        "id": np.empty(count, np.int32),
-        "leftChild": np.empty(count, np.int32),
-        "rightChild": np.empty(count, np.int32),
-        "offset": np.empty(count, np.float64),
-        "numInstances": np.empty(count, np.int64),
+        "treeID": np.full(count, -1, np.int32),
+        "id": np.full(count, -2, np.int32),
+        "leftChild": np.full(count, -1, np.int32),
+        "rightChild": np.full(count, -1, np.int32),
+        "offset": np.zeros(count, np.float64),
+        "numInstances": np.full(count, -1, np.int64),
     }
-    hyper_len = np.empty(count, np.int32)
+    hyper_len = np.zeros(count, np.int32)
     flat_indices = np.empty(flat_cap, np.int32)
     flat_weights = np.empty(flat_cap, np.float32)
     consumed = lib.if_decode_extended(

@@ -1,4 +1,14 @@
-from .converter import IsolationForestConverter, convert_and_save
+from .converter import (
+    ExtendedIsolationForestConverter,
+    IsolationForestConverter,
+    convert_and_save,
+)
 from . import proto, runtime
 
-__all__ = ["IsolationForestConverter", "convert_and_save", "proto", "runtime"]
+__all__ = [
+    "ExtendedIsolationForestConverter",
+    "IsolationForestConverter",
+    "convert_and_save",
+    "proto",
+    "runtime",
+]

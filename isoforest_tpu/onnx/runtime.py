@@ -164,7 +164,11 @@ def run_model(model_bytes: bytes, feeds: Dict[str, np.ndarray]) -> List[np.ndarr
     for nd in parsed["nodes"]:
         op = nd["op_type"]
         ins = [env[i] for i in nd["inputs"]]
-        if op == "TreeEnsembleRegressor":
+        if op == "MatMul":
+            out = (np.asarray(ins[0], np.float32) @ np.asarray(ins[1], np.float32)).astype(
+                np.float32
+            )
+        elif op == "TreeEnsembleRegressor":
             out = _eval_tree_ensemble(nd["attrs"], np.asarray(ins[0], np.float32))
         elif op == "Div":
             out = (ins[0] / ins[1]).astype(np.float32)

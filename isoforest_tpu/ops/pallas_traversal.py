@@ -157,22 +157,23 @@ def _extended_pallas(X, W_dense, offset, internal, leaf_value, interpret=False):
 
 # The forest is immutable once trained/loaded, but the kernel needs host-side
 # prep (leaf-value tables; densified hyperplanes for EIF — O(T*M*F)). Cache
-# prep per forest, keyed by the identity of its first array; holding a strong
-# reference to that key array prevents id() reuse. Bounded FIFO.
+# prep per forest, keyed by the identities of ALL its arrays (a _replace of
+# any single field must miss); holding strong references to the keyed arrays
+# prevents id() reuse. Bounded FIFO.
 _PREP_CACHE: dict = {}
 _PREP_CACHE_MAX = 8
 
 
 def _cached_prep(forest, build, extra_key=()):
-    key_array = forest[0]
-    key = (id(key_array), tuple(forest[0].shape), extra_key)
+    arrays = tuple(forest)
+    key = (tuple(id(a) for a in arrays), tuple(forest[0].shape), extra_key)
     hit = _PREP_CACHE.get(key)
-    if hit is not None and hit[0] is key_array:
+    if hit is not None and all(a is b for a, b in zip(hit[0], arrays)):
         return hit[1]
     prep = build()
     if len(_PREP_CACHE) >= _PREP_CACHE_MAX:
         _PREP_CACHE.pop(next(iter(_PREP_CACHE)))
-    _PREP_CACHE[key] = (key_array, prep)
+    _PREP_CACHE[key] = (arrays, prep)
     return prep
 
 

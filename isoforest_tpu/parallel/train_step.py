@@ -41,14 +41,23 @@ def make_train_step(
     num_features: int,
     bootstrap: bool = False,
     contamination: float = 0.0,
+    contamination_error: float = 0.0,
     extended: bool = False,
     extension_level: int = 0,
 ):
     """Build a jitted ``(key, X) -> TrainStepResult`` over ``mesh``.
 
-    Requires ``num_trees`` and ``num_rows`` divisible by the total device
-    count (pad upstream otherwise — see
+    ``num_trees`` and ``num_rows`` must divide the total device count (the
+    whole pipeline is shape-fused; pad upstream otherwise — see
     :func:`isoforest_tpu.parallel.sharded._pad_axis`).
+
+    Threshold computation (``contamination > 0``): with
+    ``contamination_error == 0`` an exact rank pick over the globally sorted
+    scores (GSPMD all-gathers — fine up to tens of millions of rows); with an
+    error budget, a fixed-range histogram whose counts reduce with a single
+    ``psum``-shaped collective per refinement pass — the ICI-native
+    replacement for Spark's distributed approxQuantile (SURVEY.md §5.8) that
+    never materialises the global score vector on one device.
     """
     n_devices = mesh.shape[DATA_AXIS] * mesh.shape[TREES_AXIS]
     if num_trees % n_devices or num_rows % n_devices:
@@ -96,7 +105,12 @@ def make_train_step(
         tree_keys = per_tree_keys(k_grow, num_trees)
         forest = grow_sharded(tree_keys, X, bag, fidx)
         scores = score_sharded(forest, X)
-        if contamination > 0.0:
+        if contamination > 0.0 and contamination_error > 0.0:
+            # psum-able histogram sketch: scores stay row-sharded
+            from ..ops.quantile import histogram_quantile_jit
+
+            threshold = histogram_quantile_jit(scores, 1.0 - contamination)
+        elif contamination > 0.0:
             # exact rank pick == approxQuantile with error budget 0
             # (SharedTrainLogic.scala:187-197); GSPMD all-gathers the sharded
             # score vector for the sort.

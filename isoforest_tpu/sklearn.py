@@ -21,11 +21,15 @@ import numpy as np
 
 try:
     from sklearn.base import BaseEstimator, OutlierMixin
+    from sklearn.exceptions import NotFittedError
 except Exception:  # pragma: no cover - sklearn is in the base image
     class BaseEstimator:  # type: ignore
         pass
 
     class OutlierMixin:  # type: ignore
+        pass
+
+    class NotFittedError(Exception):  # type: ignore
         pass
 
 from .models import ExtendedIsolationForest, IsolationForest
@@ -106,4 +110,6 @@ class TpuIsolationForest(BaseEstimator, OutlierMixin):
 
     def _check_fitted(self):
         if not hasattr(self, "model_"):
-            raise RuntimeError("this estimator is not fitted yet; call fit first")
+            raise NotFittedError(
+                "This TpuIsolationForest instance is not fitted yet; call fit first"
+            )

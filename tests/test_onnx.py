@@ -140,12 +140,83 @@ class TestScoreParity:
         scores, _ = run_model(onnx_bytes, {"features": X})
         assert auroc_fn(scores[:, 0], y) == pytest.approx(0.8596, abs=0.02)
 
-    def test_extended_model_rejected(self):
+    def test_extended_model_rejected_by_standard_converter(self):
         path = _FIXTURES / "savedExtendedIsolationForestModel"
         if not path.exists():
             pytest.skip("reference fixture unavailable")
         with pytest.raises(ValueError, match="standard"):
             IsolationForestConverter(str(path))
+
+
+class TestExtendedConverter:
+    """Beyond-reference: EIF export via the lifted dot-product space
+    (MatMul + standard TreeEnsembleRegressor)."""
+
+    @pytest.fixture(scope="class")
+    def ext_saved(self, tmp_path_factory):
+        from isoforest_tpu import ExtendedIsolationForest
+
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(3000, 5)).astype(np.float32)
+        X[:60] += 4.0
+        model = ExtendedIsolationForest(
+            num_estimators=20, contamination=0.02, extension_level=2, random_seed=5
+        ).fit(X)
+        path = str(tmp_path_factory.mktemp("onnx_ext") / "model")
+        model.save(path)
+        return model, X, path
+
+    def test_parity_vs_jax_scorer(self, ext_saved):
+        from isoforest_tpu.onnx import ExtendedIsolationForestConverter
+
+        model, X, path = ext_saved
+        onnx_bytes = ExtendedIsolationForestConverter(path).convert()
+        scores, labels = run_model(onnx_bytes, {"features": X})
+        jax_scores = model.score(X)
+        assert np.abs(scores[:, 0] - jax_scores).max() < 1e-5
+        disagree = labels[:, 0] != model.predict(jax_scores)
+        if disagree.any():
+            assert np.all(
+                np.abs(jax_scores[disagree] - model.outlier_score_threshold) < 1e-5
+            )
+
+    def test_graph_shape(self, ext_saved):
+        from isoforest_tpu.onnx import ExtendedIsolationForestConverter
+
+        _, _, path = ext_saved
+        parsed = parse_model(ExtendedIsolationForestConverter(path).convert())
+        ops = [n["op_type"] for n in parsed["nodes"]]
+        assert ops[0] == "MatMul" and ops[1] == "TreeEnsembleRegressor"
+        assert "liftedWeights" in parsed["initializers"]
+
+    def test_reference_extended_fixture(self, mammography):
+        from isoforest_tpu import ExtendedIsolationForestModel
+        from isoforest_tpu.onnx import ExtendedIsolationForestConverter
+
+        path = _FIXTURES / "savedExtendedIsolationForestModel"
+        if not path.exists():
+            pytest.skip("reference fixture unavailable")
+        onnx_bytes = ExtendedIsolationForestConverter(str(path)).convert()
+        X, _ = mammography
+        scores, _ = run_model(onnx_bytes, {"features": X[:2000]})
+        jax_scores = ExtendedIsolationForestModel.load(str(path)).score(X[:2000])
+        assert np.abs(scores[:, 0] - jax_scores).max() < 1e-5
+
+    def test_auto_dispatch(self, ext_saved, tmp_path):
+        from isoforest_tpu.onnx import convert_and_save
+
+        _, X, path = ext_saved
+        out = tmp_path / "m.onnx"
+        convert_and_save(path, str(out))
+        scores, _ = run_model(out.read_bytes(), {"features": X[:100]})
+        assert scores.shape == (100, 1)
+
+    def test_standard_dir_rejected(self, saved_model):
+        from isoforest_tpu.onnx import ExtendedIsolationForestConverter
+
+        _, _, path = saved_model
+        with pytest.raises(ValueError, match="Extended"):
+            ExtendedIsolationForestConverter(path)
 
 
 class TestProtoCodec:

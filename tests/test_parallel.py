@@ -142,6 +142,28 @@ class TestFusedTrainStep:
         assert float(result.threshold) == -1.0
         assert result.forest.k == 3
 
+    def test_histogram_threshold_path(self, mesh, data):
+        """contamination_error > 0 routes through the psum-able histogram
+        sketch; threshold must agree with the exact-sort path to float noise."""
+        kw = dict(
+            num_rows=len(data),
+            num_features_total=5,
+            num_trees=16,
+            num_samples=64,
+            num_features=5,
+            contamination=0.1,
+        )
+        exact = make_train_step(mesh, **kw)(jax.random.PRNGKey(0), data)
+        sketch = make_train_step(mesh, contamination_error=0.01, **kw)(
+            jax.random.PRNGKey(0), data
+        )
+        assert float(sketch.threshold) == pytest.approx(
+            float(exact.threshold), abs=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(sketch.scores), np.asarray(exact.scores), rtol=1e-6
+        )
+
     def test_indivisible_counts_rejected(self, mesh, data):
         with pytest.raises(ValueError):
             make_train_step(

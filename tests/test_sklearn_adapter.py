@@ -49,8 +49,10 @@ class TestSklearnProtocol:
         assert isinstance(est.model_, ExtendedIsolationForestModel)
         assert est.model_.extension_level == 2
 
-    def test_unfitted_raises(self):
-        with pytest.raises(RuntimeError):
+    def test_unfitted_raises_not_fitted_error(self):
+        from sklearn.exceptions import NotFittedError
+
+        with pytest.raises(NotFittedError):
             TpuIsolationForest().score_samples(np.zeros((2, 2), np.float32))
 
     def test_get_set_params(self):
