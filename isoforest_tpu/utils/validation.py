@@ -63,12 +63,32 @@ def extract_features(
             np.stack(data[features_col].to_numpy()) if len(data) else np.zeros((0, 0)),
             dtype=np.float32,
         )
+        _warn_non_finite(X)
         return X, data
 
     X = np.asarray(data, dtype=np.float32)
     if X.ndim != 2:
         raise ValueError(f"expected a 2-D [num_rows, num_features] matrix, got shape {X.shape}")
+    _warn_non_finite(X)
     return X, None
+
+
+def _warn_non_finite(X: np.ndarray) -> None:
+    """Non-finite features silently poison per-node min/max statistics during
+    growth (NaN comparisons are all-false, like the JVM's) — surface it once
+    per call instead of producing quietly degraded trees."""
+    if not X.size:
+        return
+    finite = np.isfinite(X)
+    if not finite.all():
+        from .logging import logger
+
+        bad = int(X.size - finite.sum())
+        logger.warning(
+            "input contains %d non-finite feature values (nan/inf); isolation "
+            "trees treat them as incomparable and scores may be degraded",
+            bad,
+        )
 
 
 def validate_feature_vector_size(num_features: int, expected: int) -> None:

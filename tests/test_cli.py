@@ -1,0 +1,103 @@
+"""CLI tests: fit / score / convert / inspect end-to-end over CSV files."""
+
+import json
+
+import numpy as np
+import pytest
+
+from isoforest_tpu.__main__ import main
+
+
+@pytest.fixture(scope="module")
+def csv_file(tmp_path_factory):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2000, 4)).astype(np.float32)
+    X[:40] += 6.0
+    y = np.zeros(2000)
+    y[:40] = 1
+    path = tmp_path_factory.mktemp("cli") / "data.csv"
+    np.savetxt(path, np.column_stack([X, y]), delimiter=",")
+    return str(path)
+
+
+class TestCli:
+    def test_fit_score_convert_inspect(self, csv_file, tmp_path, capsys):
+        model_dir = str(tmp_path / "model")
+        rc = main(
+            [
+                "fit", "--input", csv_file, "--labeled", "--output", model_dir,
+                "--num-estimators", "20", "--contamination", "0.02",
+            ]
+        )
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert summary["numTrees"] == 20
+        assert summary["auroc"] > 0.9
+
+        scores_csv = str(tmp_path / "scores.csv")
+        rc = main(
+            ["score", "--model", model_dir, "--input", csv_file, "--labeled",
+             "--output", scores_csv]
+        )
+        assert rc == 0
+        out = np.loadtxt(scores_csv, delimiter=",", skiprows=1)
+        assert out.shape == (2000, 2)
+        assert set(np.unique(out[:, 1])) <= {0.0, 1.0}
+
+        onnx_path = str(tmp_path / "m.onnx")
+        rc = main(["convert", "--model", model_dir, "--output", onnx_path])
+        assert rc == 0
+        from isoforest_tpu.onnx.runtime import run_model
+
+        s, _ = run_model(
+            open(onnx_path, "rb").read(), {"features": np.zeros((5, 4), np.float32)}
+        )
+        assert s.shape == (5, 1)
+
+        rc = main(["inspect", "--model", model_dir])
+        assert rc == 0
+        info = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert info["numTrees"] == 20
+        assert info["params"]["numEstimators"] == 20
+
+    def test_inspect_tree_structure(self, csv_file, tmp_path, capsys):
+        model_dir = str(tmp_path / "m2")
+        main(["fit", "--input", csv_file, "--labeled", "--output", model_dir,
+              "--num-estimators", "3", "--max-samples", "32"])
+        capsys.readouterr()
+        rc = main(["inspect", "--model", model_dir, "--tree", "0"])
+        assert rc == 0
+        s = capsys.readouterr().out.strip()
+        assert s.startswith(("InternalNode(", "ExternalNode("))
+
+    def test_extended_fit(self, csv_file, tmp_path, capsys):
+        model_dir = str(tmp_path / "ext")
+        rc = main(["fit", "--input", csv_file, "--labeled", "--output", model_dir,
+                   "--extended", "--extension-level", "2",
+                   "--num-estimators", "10"])
+        assert rc == 0
+        rc = main(["inspect", "--model", model_dir])
+        info = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert info["class"] == "ExtendedIsolationForestModel"
+        assert info["params"]["extensionLevel"] == 2
+
+    def test_fit_without_overwrite_fails(self, csv_file, tmp_path):
+        model_dir = str(tmp_path / "dup")
+        main(["fit", "--input", csv_file, "--output", model_dir,
+              "--num-estimators", "3", "--max-samples", "32"])
+        with pytest.raises(FileExistsError):
+            main(["fit", "--input", csv_file, "--output", model_dir,
+                  "--num-estimators", "3", "--max-samples", "32"])
+
+
+class TestNonFiniteWarning:
+    def test_warns_on_nan(self, caplog):
+        import logging
+
+        from isoforest_tpu.utils.validation import extract_features
+
+        X = np.ones((10, 3), np.float32)
+        X[0, 0] = np.nan
+        with caplog.at_level(logging.WARNING, logger="isoforest_tpu"):
+            extract_features(X)
+        assert any("non-finite" in r.message for r in caplog.records)
