@@ -17,7 +17,7 @@ from .validation import (
     extract_features,
     validate_feature_vector_size,
 )
-from .logging import logger, phase
+from .logging import logger, phase, trace
 
 __all__ = [
     "EULER_GAMMA",
@@ -35,4 +35,5 @@ __all__ = [
     "validate_feature_vector_size",
     "logger",
     "phase",
+    "trace",
 ]

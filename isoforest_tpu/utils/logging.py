@@ -23,6 +23,24 @@ if not logger.handlers:
 
 
 @contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a jax profiler trace (TensorBoard/XProf-viewable) around a
+    block — the deep-profiling layer the reference lacks (SURVEY.md §5.1):
+
+        with isoforest_tpu.utils.trace("/tmp/trace"):
+            model = IsolationForest().fit(X)
+    """
+    import jax.profiler as _prof
+
+    _prof.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        _prof.stop_trace()
+        logger.info("profiler trace written to %s", log_dir)
+
+
+@contextlib.contextmanager
 def phase(name: str, log_level: int = logging.INFO):
     """Time a named phase; annotate it in any active jax profiler trace."""
     try:

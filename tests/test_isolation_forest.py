@@ -118,6 +118,23 @@ class TestExtendedQualityGates:
         assert est.params.extension_level is None
 
 
+class TestNonDefaultShapes:
+    def test_max_samples_1024_deeper_trees(self, mammography, auroc_fn):
+        """Non-default height: maxSamples=1024 -> h=10, M=2047 heap slots."""
+        X, y = mammography
+        model = IsolationForest(
+            num_estimators=50, max_samples=1024.0, random_seed=1
+        ).fit(X)
+        assert model.forest.max_nodes == 2047
+        assert auroc_fn(model.score(X), y) > 0.8
+
+    def test_tiny_max_samples(self, mammography):
+        X, _ = mammography
+        model = IsolationForest(num_estimators=10, max_samples=4.0).fit(X)
+        assert model.forest.max_nodes == 7
+        assert np.isfinite(model.score(X[:100])).all()
+
+
 class TestTransformSemantics:
     def test_dataframe_in_dataframe_out(self, mammography):
         import pandas as pd

@@ -1,0 +1,87 @@
+"""Quantile/threshold unit tests — the approxQuantile-contract layer
+(SharedTrainLogic.scala:187-241 semantics)."""
+
+import numpy as np
+import pytest
+
+from isoforest_tpu.ops.quantile import (
+    contamination_threshold,
+    exact_quantile,
+    histogram_quantile,
+    histogram_quantile_jit,
+    observed_contamination,
+)
+
+
+@pytest.fixture(scope="module")
+def scores():
+    rng = np.random.default_rng(0)
+    return rng.uniform(0.2, 0.9, size=100001).astype(np.float32)
+
+
+class TestExactQuantile:
+    def test_returns_an_element_at_rank(self, scores):
+        q = exact_quantile(scores, 0.95)
+        assert q in scores
+        assert (scores < q).mean() <= 0.95 <= (scores <= q).mean() + 1e-9
+
+    def test_extremes(self, scores):
+        assert exact_quantile(scores, 1.0) == scores.max()
+        assert exact_quantile(scores, 0.0) == scores.min()
+
+    def test_tiny_input(self):
+        s = np.array([0.3, 0.7], np.float32)
+        assert exact_quantile(s, 0.5) == pytest.approx(0.3)
+        assert exact_quantile(s, 1.0) == pytest.approx(0.7)
+
+
+class TestHistogramQuantile:
+    @pytest.mark.parametrize("q", [0.5, 0.9, 0.98, 0.999])
+    def test_matches_exact_after_refinement(self, scores, q):
+        assert histogram_quantile(scores, q) == pytest.approx(
+            exact_quantile(scores, q), abs=2e-7
+        )
+
+    def test_heavy_ties(self):
+        s = np.full(50000, 0.437, np.float32)
+        s[:500] = 0.9
+        assert histogram_quantile(s, 0.5) == pytest.approx(0.437, abs=1e-6)
+        assert histogram_quantile(s, 0.995) == pytest.approx(0.9, abs=1e-6)
+
+    def test_jit_variant_matches(self, scores):
+        for q in [0.5, 0.98]:
+            assert float(histogram_quantile_jit(scores, q)) == pytest.approx(
+                exact_quantile(scores, q), abs=2e-7
+            )
+
+    def test_jit_variant_traceable(self, scores):
+        import jax
+
+        f = jax.jit(lambda s: histogram_quantile_jit(s, 0.98))
+        assert float(f(scores)) == pytest.approx(
+            exact_quantile(scores, 0.98), abs=2e-7
+        )
+
+
+class TestContaminationThreshold:
+    def test_exact_when_error_zero(self, scores):
+        thr = contamination_threshold(scores, 0.05, 0.0)
+        observed = observed_contamination(scores, thr)
+        # exact rank pick: observed within 1/N of the request
+        assert observed == pytest.approx(0.05, abs=2.0 / len(scores))
+
+    def test_sketch_when_budgeted(self, scores):
+        thr = contamination_threshold(scores, 0.05, 0.01)
+        assert observed_contamination(scores, thr) == pytest.approx(0.05, abs=0.01)
+
+    def test_estimator_level_approx_path(self):
+        """contaminationError > 0 through the public fit API."""
+        from isoforest_tpu import IsolationForest
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(5000, 4)).astype(np.float32)
+        m = IsolationForest(
+            num_estimators=20, contamination=0.1, contamination_error=0.02
+        ).fit(X)
+        labels = m.transform(X)["predictedLabel"]
+        assert labels.mean() == pytest.approx(0.1, abs=0.02)
