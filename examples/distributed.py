@@ -11,6 +11,11 @@ On a real TPU slice the same code uses all local chips; across hosts, call
 mesh spans the pod.
 """
 
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
 import numpy as np
 
 from isoforest_tpu import IsolationForest

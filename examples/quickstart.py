@@ -6,6 +6,11 @@
 persistent compilation cache if you configure one.)
 """
 
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
 import numpy as np
 
 from isoforest_tpu import IsolationForest, IsolationForestModel

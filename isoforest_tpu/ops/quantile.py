@@ -119,11 +119,14 @@ def contamination_threshold(
     scores,
     contamination: float,
     contamination_error: float,
+    exact_size_limit: int = 1 << 22,
 ) -> float:
     """Outlier-score threshold for a contamination level; exact when the error
-    budget is 0 (SharedTrainLogic.scala:187-197 semantics)."""
+    budget is 0 (SharedTrainLogic.scala:187-197 semantics). An exact answer
+    always satisfies the approximate contract, so the sketch only engages
+    above ``exact_size_limit`` scores (injectable for tests)."""
     q = 1.0 - contamination
-    if contamination_error == 0.0 or np.size(scores) <= (1 << 22):
+    if contamination_error == 0.0 or np.size(scores) <= exact_size_limit:
         return exact_quantile(scores, q)
     return histogram_quantile(scores, q)
 

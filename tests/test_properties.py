@@ -1,0 +1,161 @@
+"""Property-based tests (hypothesis): the Avro codec and the tree kernels
+must hold their invariants for *arbitrary* inputs, not just the fixtures —
+the fuzzing layer the reference's example-based suite lacks."""
+
+import numpy as np
+import pytest
+from hypothesis import HealthCheck, given, settings, strategies as st
+
+from isoforest_tpu.io import avro
+from isoforest_tpu.io.persistence import (
+    records_to_standard_forest,
+    standard_tree_to_records,
+)
+
+_settings = settings(
+    max_examples=25,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+
+
+class TestAvroCodecProperties:
+    @given(
+        values=st.lists(
+            st.tuples(
+                st.integers(min_value=-(2**62), max_value=2**62),
+                st.floats(width=32, allow_nan=False),
+                st.floats(allow_nan=False),
+                st.text(max_size=40),
+                st.booleans(),
+                st.lists(st.integers(min_value=-(2**31), max_value=2**31 - 1), max_size=8),
+            ),
+            min_size=0,
+            max_size=50,
+        ),
+        codec=st.sampled_from(["null", "deflate"]),
+    )
+    @_settings
+    def test_round_trip_any_records(self, tmp_path_factory, values, codec):
+        schema = {
+            "type": "record",
+            "name": "r",
+            "fields": [
+                {"name": "l", "type": "long"},
+                {"name": "f", "type": "float"},
+                {"name": "d", "type": "double"},
+                {"name": "s", "type": "string"},
+                {"name": "b", "type": "boolean"},
+                {"name": "arr", "type": {"type": "array", "items": "int"}},
+            ],
+        }
+        records = [
+            {"l": l, "f": float(np.float32(f)), "d": d, "s": s, "b": b, "arr": arr}
+            for l, f, d, s, b, arr in values
+        ]
+        path = tmp_path_factory.mktemp("prop") / "t.avro"
+        avro.write_container(str(path), schema, records, codec=codec)
+        _, back = avro.read_container(str(path))
+        assert len(back) == len(records)
+        for got, want in zip(back, records):
+            assert got["l"] == want["l"]
+            assert got["s"] == want["s"]
+            assert got["b"] == want["b"]
+            assert got["arr"] == want["arr"]
+            np.testing.assert_equal(np.float32(got["f"]), np.float32(want["f"]))
+            np.testing.assert_equal(got["d"], want["d"])
+
+    @given(value=st.integers(min_value=-(2**63), max_value=2**63 - 1))
+    @_settings
+    def test_zigzag_long_any(self, value):
+        r = avro._Reader(avro.encode_long(value))
+        assert r.read_long() == value
+
+
+def _random_tree_records(rng, max_depth=6):
+    """Generate a random valid pre-order NodeData list."""
+    records = []
+
+    def grow(depth):
+        my_id = len(records)
+        records.append(None)
+        if depth < max_depth and rng.random() < 0.6:
+            left = grow(depth + 1)
+            right = grow(depth + 1)
+            records[my_id] = {
+                "id": my_id,
+                "leftChild": left,
+                "rightChild": right,
+                "splitAttribute": int(rng.integers(0, 5)),
+                "splitValue": float(rng.normal()),
+                "numInstances": -1,
+            }
+        else:
+            records[my_id] = {
+                "id": my_id,
+                "leftChild": -1,
+                "rightChild": -1,
+                "splitAttribute": -1,
+                "splitValue": 0.0,
+                "numInstances": int(rng.integers(0, 100)),
+            }
+        return my_id
+
+    grow(0)
+    return records
+
+
+class TestTreeConversionProperties:
+    @given(seed=st.integers(min_value=0, max_value=10_000))
+    @_settings
+    def test_records_heap_records_identity(self, seed):
+        """pre-order -> heap -> pre-order is the identity for arbitrary trees."""
+        rng = np.random.default_rng(seed)
+        records = _random_tree_records(rng)
+        forest = records_to_standard_forest([records], threshold_dtype=np.float64)
+        back = standard_tree_to_records(
+            np.asarray(forest.feature[0]),
+            np.asarray(forest.threshold[0]),
+            np.asarray(forest.num_instances[0]),
+        )
+        assert len(back) == len(records)
+        for b, w in zip(back, records):
+            assert (b["id"], b["leftChild"], b["rightChild"]) == (
+                w["id"], w["leftChild"], w["rightChild"],
+            )
+            assert b["splitAttribute"] == w["splitAttribute"]
+            assert b["numInstances"] == w["numInstances"]
+            assert b["splitValue"] == pytest.approx(w["splitValue"])
+
+    @given(seed=st.integers(min_value=0, max_value=10_000))
+    @_settings
+    def test_columns_path_matches_records_path(self, seed):
+        """The native-format columnar reconstruction equals the dict path for
+        arbitrary trees (exercised without the C++ lib: columns built in
+        numpy)."""
+        from isoforest_tpu.io.persistence import columns_to_standard_forest
+
+        rng = np.random.default_rng(seed)
+        trees = [_random_tree_records(rng) for _ in range(3)]
+        flat = [
+            (t, r)
+            for t, records in enumerate(trees)
+            for r in records
+        ]
+        cols = {
+            "treeID": np.asarray([t for t, _ in flat], np.int32),
+            "id": np.asarray([r["id"] for _, r in flat], np.int32),
+            "leftChild": np.asarray([r["leftChild"] for _, r in flat], np.int32),
+            "rightChild": np.asarray([r["rightChild"] for _, r in flat], np.int32),
+            "splitAttribute": np.asarray(
+                [r["splitAttribute"] for _, r in flat], np.int32
+            ),
+            "splitValue": np.asarray([r["splitValue"] for _, r in flat], np.float64),
+            "numInstances": np.asarray(
+                [r["numInstances"] for _, r in flat], np.int64
+            ),
+        }
+        a = columns_to_standard_forest(cols)
+        b = records_to_standard_forest(trees)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))

@@ -71,11 +71,26 @@ class TestContaminationThreshold:
         assert observed == pytest.approx(0.05, abs=2.0 / len(scores))
 
     def test_sketch_when_budgeted(self, scores):
-        thr = contamination_threshold(scores, 0.05, 0.01)
+        # force the sketch path regardless of N (exact_size_limit=0)
+        thr = contamination_threshold(scores, 0.05, 0.01, exact_size_limit=0)
         assert observed_contamination(scores, thr) == pytest.approx(0.05, abs=0.01)
+        # and it genuinely routed through the histogram: breaking the sketch
+        # must break this call
+        import isoforest_tpu.ops.quantile as q
+
+        orig = q.histogram_quantile
+        calls = []
+        q.histogram_quantile = lambda *a, **k: calls.append(1) or orig(*a, **k)
+        try:
+            contamination_threshold(scores, 0.05, 0.01, exact_size_limit=0)
+        finally:
+            q.histogram_quantile = orig
+        assert calls
 
     def test_estimator_level_approx_path(self):
-        """contaminationError > 0 through the public fit API."""
+        """contaminationError > 0 through the public fit API (small-N fits
+        legitimately use the exact path — the contract is the observed
+        contamination, not the algorithm)."""
         from isoforest_tpu import IsolationForest
 
         rng = np.random.default_rng(1)
