@@ -183,6 +183,23 @@ def extended_tree_to_records(indices, weights, offset, num_instances) -> List[di
     return records
 
 
+
+# A tree of depth d occupies 2^(d+1)-1 heap slots. Reference-conformant trees
+# have depth <= ceil(log2(maxSamples)) (IsolationTree.scala:60-61), so even
+# maxSamples = 10^6 stays under 21. A corrupt or adversarial node table
+# encoding a deep chain would otherwise force a 2^depth allocation.
+_MAX_TREE_DEPTH = 24
+
+
+def _check_depth(depth: int) -> None:
+    if depth > _MAX_TREE_DEPTH:
+        raise ValueError(
+            f"refusing to materialise a tree of depth {depth} (> {_MAX_TREE_DEPTH}): "
+            f"the implicit-heap layout would need 2^{depth + 1} slots; "
+            "the node table is corrupt or not a valid isolation-forest model"
+        )
+
+
 def _assign_heap_slots(records: List[dict]) -> Tuple[dict, int]:
     """Pre-order records -> {node id: heap slot}; validates contiguous ids
     (the reference's buildTreeFromNodes contract,
@@ -195,6 +212,7 @@ def _assign_heap_slots(records: List[dict]) -> Tuple[dict, int]:
     stack = [(0, 0, 0)]  # (node id, heap slot, depth)
     while stack:
         rid, slot, depth = stack.pop()
+        _check_depth(depth)  # in-loop: terminates cycles and deep chains alike
         slots[rid] = slot
         max_depth = max(max_depth, depth)
         r = by_id[rid]
@@ -216,6 +234,7 @@ def records_to_standard_forest(
         slot_maps.append(slots)
         depths.append(depth)
     height = max(depths) if depths else 0
+    _check_depth(height)
     M = 2 ** (height + 1) - 1
     T = len(trees)
     feature = np.full((T, M), -1, np.int32)
@@ -249,6 +268,7 @@ def records_to_extended_forest(
             if r["leftChild"] >= 0:
                 k = max(k, len(r["indices"]))
     height = max(depths) if depths else 0
+    _check_depth(height)
     M = 2 ** (height + 1) - 1
     T = len(trees)
     indices = np.full((T, M, k), -1, np.int32)
@@ -343,8 +363,11 @@ def _preorder_slots(is_internal_list: List[bool]) -> Tuple[List[int], int]:
     slots = [0] * len(is_internal_list)
     stack = [0]
     max_slot = 0
+    slot_cap = (1 << (_MAX_TREE_DEPTH + 2)) - 1  # in-loop depth enforcement
     for i, internal in enumerate(is_internal_list):
         slot = stack.pop()
+        if slot > slot_cap:
+            _check_depth(_MAX_TREE_DEPTH + 1)
         slots[i] = slot
         if slot > max_slot:
             max_slot = slot
@@ -429,6 +452,7 @@ def columns_to_standard_forest(cols, threshold_dtype=np.float32) -> StandardFore
         slots, depth = _preorder_slots(internal[s:e])
         all_slots[s:e] = slots
         height = max(height, depth)
+    _check_depth(height)
     M = 2 ** (height + 1) - 1
     feature = np.full((T, M), -1, np.int32)
     threshold = np.zeros((T, M), threshold_dtype)
@@ -461,6 +485,7 @@ def columns_to_extended_forest(cols, offset_dtype=np.float32) -> ExtendedForest:
         slots, depth = _preorder_slots(internal[s:e])
         all_slots[s:e] = slots
         height = max(height, depth)
+    _check_depth(height)
     M = 2 ** (height + 1) - 1
     k = int(lens.max()) if len(lens) else 1
     k = max(k, 1)

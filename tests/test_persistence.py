@@ -237,3 +237,30 @@ class TestReferenceFixtureCompat:
         assert model.forest.k == 6
         X, y = mammography
         assert auroc_fn(model.score(X), y) == pytest.approx(0.86, abs=0.02)
+
+
+
+class TestDepthGuard:
+    def test_deep_chain_rejected(self):
+        """A corrupt node table encoding a depth-30 chain must be refused, not
+        allocate 2^31 heap slots."""
+        from isoforest_tpu.io.persistence import records_to_standard_forest
+
+        depth = 30
+        records = []
+        for i in range(depth):
+            records.append(
+                {"id": 2 * i, "leftChild": 2 * i + 1, "rightChild": 2 * i + 2,
+                 "splitAttribute": 0, "splitValue": 0.5, "numInstances": -1}
+            )
+            records.append(
+                {"id": 2 * i + 1, "leftChild": -1, "rightChild": -1,
+                 "splitAttribute": -1, "splitValue": 0.0, "numInstances": 1}
+            )
+        records.append(
+            {"id": 2 * depth, "leftChild": -1, "rightChild": -1,
+             "splitAttribute": -1, "splitValue": 0.0, "numInstances": 1}
+        )
+        records.sort(key=lambda r: r["id"])
+        with pytest.raises(ValueError, match="depth"):
+            records_to_standard_forest([records])
