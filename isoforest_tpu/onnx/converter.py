@@ -22,7 +22,6 @@ Opsets: ``ai.onnx.ml`` v1 + core v14, ``ir_version`` 10 (:156-166).
 
 from __future__ import annotations
 
-import json
 import math
 from typing import Dict, List
 

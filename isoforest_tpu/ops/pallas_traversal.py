@@ -30,7 +30,6 @@ except Exception:  # pragma: no cover
     _VMEM = None
 
 from ..utils.math import avg_path_length, height_of as _height_of
-from .ext_growth import ExtendedForest
 from .tree_growth import StandardForest
 
 _ROW_BLOCK = 1024

@@ -4,7 +4,6 @@ exact same-seed reproducibility."""
 
 import jax
 import numpy as np
-import pytest
 
 from isoforest_tpu.ops.bagging import (
     bagged_indices,

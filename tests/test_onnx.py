@@ -2,7 +2,6 @@
 the Scala->ONNX score-parity integration gate
 (test_isolation_forest_onnx_integration.py:86-89: max |score diff| < 1e-5)."""
 
-import math
 import pathlib
 
 import numpy as np

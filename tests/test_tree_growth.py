@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from isoforest_tpu.ops.bagging import bagged_indices, feature_subsets, per_tree_keys
-from isoforest_tpu.ops.ext_growth import ExtendedForest, grow_extended_forest
+from isoforest_tpu.ops.ext_growth import grow_extended_forest
 from isoforest_tpu.ops.traversal import (
     extended_path_lengths,
     standard_path_lengths,
