@@ -230,6 +230,51 @@ class IsolationForestModel:
             return sharded_score(mesh, self.forest, X, self.num_samples)
         return score_matrix(self.forest, X, self.num_samples)
 
+    def warmup(
+        self,
+        batch_sizes=(1024,),
+        strategy: str = "auto",
+        width: Optional[int] = None,
+        mesh=None,
+    ) -> "IsolationForestModel":
+        """Pre-compile the scoring programs for the given batch sizes so
+        latency-sensitive serving never pays XLA compilation on a live
+        request. Returns self.
+
+        Warm with the SAME configuration the serving path will use: the
+        default ``strategy="auto"`` resolves identically here and in
+        :meth:`score` (env var / gather), and pass ``mesh`` if serving scores
+        through a mesh (the sharded program is compiled separately). Batch
+        sizes dedupe to their power-of-two buckets, matching
+        :func:`~isoforest_tpu.ops.traversal.score_matrix` bucketing. Legacy
+        models with unknown ``totalNumFeatures`` must pass ``width`` (the
+        serving input's feature count) explicitly.
+        """
+        if width is None:
+            if self.total_num_features == UNKNOWN_TOTAL_NUM_FEATURES:
+                raise ValueError(
+                    "this model does not record totalNumFeatures (legacy); "
+                    "pass width=<serving feature count> to warmup"
+                )
+            width = self.total_num_features
+        buckets = sorted(
+            {
+                max(1024, 1 << int(np.ceil(np.log2(max(int(n), 1)))))
+                for n in batch_sizes
+            }
+        )
+        for bucket in buckets:
+            dummy = np.zeros((bucket, max(width, 1)), np.float32)
+            if mesh is not None:
+                from ..parallel.sharded import sharded_score
+
+                sharded_score(mesh, self.forest, dummy, self.num_samples)
+            else:
+                score_matrix(
+                    self.forest, dummy, self.num_samples, strategy=strategy
+                )
+        return self
+
     def predict(self, scores: np.ndarray) -> np.ndarray:
         """Labels from scores: ``score >= threshold`` when a threshold is set,
         else all zeros (IsolationForestModel.scala:142-148)."""

@@ -172,3 +172,40 @@ class TestTransformSemantics:
         )
         with pytest.raises(ValueError):
             model.set_outlier_score_threshold(1.5)
+
+
+class TestWarmup:
+    def test_warmup_populates_jit_cache(self, mammography):
+        from isoforest_tpu.ops.traversal import _score_chunk
+
+        X, _ = mammography
+        model = IsolationForest(num_estimators=10, max_samples=64.0).fit(X[:2000])
+        model.warmup(batch_sizes=(100, 5000))
+        cached = _score_chunk._cache_size()
+        scores = model.score(X[:100])
+        model.score(X[:5000])
+        # no new compilation happened at the warmed buckets
+        assert _score_chunk._cache_size() == cached
+        assert np.isfinite(scores).all()
+
+    def test_warmup_dedupes_buckets_and_returns_self(self, mammography):
+        X, _ = mammography
+        model = IsolationForest(num_estimators=5, max_samples=32.0).fit(X[:1000])
+        # 100, 512, 1000 all share the 1024 bucket; 0 clamps to the minimum
+        assert model.warmup(batch_sizes=(100, 512, 1000, 0)) is model
+
+    def test_warmup_legacy_model_requires_width(self, mammography):
+        X, _ = mammography
+        model = IsolationForest(num_estimators=5, max_samples=32.0).fit(X[:1000])
+        model.total_num_features = -1
+        with pytest.raises(ValueError, match="width"):
+            model.warmup()
+        model.warmup(batch_sizes=(64,), width=6)
+
+    def test_warmup_on_extended_model(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(1000, 4)).astype(np.float32)
+        model = ExtendedIsolationForest(
+            num_estimators=5, max_samples=64.0, extension_level=1
+        ).fit(X)
+        assert model.warmup(batch_sizes=(64,)) is model

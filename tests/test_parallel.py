@@ -174,3 +174,11 @@ class TestFusedTrainStep:
                 num_samples=64,
                 num_features=5,
             )
+
+
+class TestMeshWarmup:
+    def test_warmup_compiles_sharded_program(self, mesh, data):
+        model = IsolationForest(num_estimators=8, max_samples=64.0).fit(data)
+        assert model.warmup(batch_sizes=(64,), mesh=mesh) is model
+        scores = model.score(data[:64], mesh=mesh)
+        assert np.isfinite(scores).all()
