@@ -22,11 +22,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-# Exact without-replacement sampling costs a full N-row permutation per tree
-# (O(T*N)); when N >> S the expected duplicate count of plain uniform draws is
-# ~S^2/(2N) per tree — under 1% of the bag at N > 50*S — so the approximate
-# path is statistically indistinguishable and keeps bagging O(T*S).
-_EXACT_SAMPLING_ROWS_PER_SAMPLE = 50
+# Below this many transient elements the full per-tree permutation is cheap;
+# above it, an N-independent sampler must take over.
+_PERMUTATION_MAX_ELEMS = 1 << 26
+# Floyd's algorithm is O(S^2) per tree as a sequential scan of length S —
+# unbeatable for the reference-default S=256 but pathological for huge bags;
+# beyond this S the chunked top-k sampler (O(N log S), bounded transient) wins.
+_FLOYD_MAX_SAMPLES = 1 << 12
 
 
 def per_tree_keys(key: jax.Array, num_trees: int) -> jax.Array:
@@ -37,6 +39,67 @@ def per_tree_keys(key: jax.Array, num_trees: int) -> jax.Array:
     return jax.vmap(lambda t: jax.random.fold_in(key, t))(
         jnp.arange(num_trees, dtype=jnp.uint32)
     )
+
+
+def _floyd_sample(key: jax.Array, num_rows: int, num_samples: int) -> jax.Array:
+    """Exact uniform ``num_samples``-subset of ``[0, num_rows)`` via Floyd's
+    algorithm (Bentley & Floyd 1987): for j = N-S .. N-1 draw t ~ U[0, j]; keep
+    t unless already drawn, else keep j. Every S-subset is equally likely,
+    distinctness is guaranteed by construction, and cost is O(S^2) per tree
+    with O(S) memory — independent of N, so it stays exact in the large-N
+    regime where a full permutation would materialise [T, N] in HBM."""
+    start = num_rows - num_samples
+
+    def step(buf, i):
+        j = start + i
+        t = jax.random.randint(
+            jax.random.fold_in(key, i), (), 0, j + 1, dtype=jnp.int32
+        )
+        val = jnp.where(jnp.any(buf == t), j, t)
+        return buf.at[i].set(val), None
+
+    buf0 = jnp.full((num_samples,), -1, dtype=jnp.int32)
+    buf, _ = jax.lax.scan(step, buf0, jnp.arange(num_samples, dtype=jnp.int32))
+    return buf
+
+
+def _topk_sample(
+    tree_keys: jax.Array, num_rows: int, num_samples: int
+) -> jax.Array:
+    """Exact uniform subsets for the large-S regime: per tree, rank rows by a
+    64-bit random key (two uint32 draws compared lexicographically via a
+    two-key ``lax.sort``) and keep the ``num_samples`` highest-ranked — a
+    symmetric function of i.i.d. draws, so every S-subset is equally likely
+    (to within the ~2^-64 chance of a full 64-bit boundary tie) and indices
+    are distinct by construction. float32 keys would NOT work here: they take
+    only ~2^23 distinct values, and deterministic tie-breaking would bias
+    bags toward low row indices at exactly these row counts. Trees are
+    processed in ``lax.map`` chunks so the ``[chunk, N]`` transient stays
+    bounded instead of materialising [T, N]."""
+
+    def chunk_sample(keys_c):
+        def one(k):
+            k1, k2 = jax.random.split(k)
+            r1 = jax.random.bits(k1, (num_rows,), dtype=jnp.uint32)
+            r2 = jax.random.bits(k2, (num_rows,), dtype=jnp.uint32)
+            idx = jnp.arange(num_rows, dtype=jnp.int32)
+            _, _, sorted_idx = jax.lax.sort((r1, r2, idx), num_keys=2)
+            return sorted_idx[num_rows - num_samples :]
+
+        return jax.vmap(one)(keys_c)
+
+    num_trees = tree_keys.shape[0]
+    chunk = max(1, min(num_trees, _PERMUTATION_MAX_ELEMS // max(num_rows, 1)))
+    if chunk >= num_trees:
+        return chunk_sample(tree_keys)
+    pad = (-num_trees) % chunk
+    keys_p = (
+        jnp.concatenate([tree_keys, tree_keys[:pad]], axis=0) if pad else tree_keys
+    )
+    out = jax.lax.map(
+        chunk_sample, keys_p.reshape(-1, chunk, *tree_keys.shape[1:])
+    )
+    return out.reshape(-1, num_samples)[:num_trees]
 
 
 def bagged_indices(
@@ -51,17 +114,28 @@ def bagged_indices(
     ``bootstrap=True`` samples with replacement (Poisson branch,
     BaggedPoint.scala:122-129); ``bootstrap=False`` without replacement
     (Binomial(1, rate) branch + shuffle/slice, BaggedPoint.scala:130-139 and
-    SharedTrainLogic.scala:283-287).
+    SharedTrainLogic.scala:283-287) — **exact at every N**: rows within a bag
+    are guaranteed distinct, matching the reference's Binomial(1, rate)
+    semantics, with no large-N approximation.
     """
+    if not bootstrap and num_samples > num_rows:
+        raise ValueError(
+            f"cannot draw {num_samples} distinct rows from {num_rows} without "
+            "replacement (bootstrap=False)"
+        )
     tree_keys = per_tree_keys(key, num_trees)
-    if bootstrap or num_rows > _EXACT_SAMPLING_ROWS_PER_SAMPLE * num_samples:
+    if bootstrap:
         sample = lambda k: jax.random.randint(
             k, (num_samples,), 0, num_rows, dtype=jnp.int32
         )
-    else:
+    elif num_rows * num_trees <= _PERMUTATION_MAX_ELEMS:
         sample = lambda k: jax.random.permutation(k, num_rows)[:num_samples].astype(
             jnp.int32
         )
+    elif num_samples <= _FLOYD_MAX_SAMPLES:
+        sample = lambda k: _floyd_sample(k, num_rows, num_samples)
+    else:
+        return _topk_sample(tree_keys, num_rows, num_samples)
     return jax.vmap(sample)(tree_keys)
 
 

@@ -31,30 +31,50 @@ def exact_quantile(scores, q: float) -> float:
     return float(jnp.sort(scores)[rank])
 
 
+def _f32_resolution(lo: float, hi: float) -> float:
+    """Width below which a ``[lo, hi)`` interval cannot separate two distinct
+    float32 values — further refinement is a no-op (any remaining bin
+    population is a single representable value, i.e. rank error 0)."""
+    scale = max(abs(lo), abs(hi), np.finfo(np.float32).tiny)
+    return float(scale * 2.0 ** (-24))
+
+
 def histogram_quantile(
     scores,
     q: float,
     num_bins: int = 1 << 14,
-    lo: float = 0.0,
-    hi: float = 1.0,
-    refine_passes: int = 3,
+    lo: float | None = None,
+    hi: float | None = None,
+    eps: float = 1e-3,
+    max_passes: int = 12,
 ) -> float:
-    """Iteratively-refined histogram quantile over a known value range.
+    """Iteratively-refined histogram quantile returning an **actual element**.
 
-    Isolation-forest scores live in ``(0, 1]``. Each pass histograms the
-    scores over the current ``[lo, hi)`` range, locates the bin containing the
-    target rank, and narrows the range to that bin — after ``P`` passes the
-    returned lower edge is within ``(hi - lo) / B**P`` of the true quantile
-    *value* (for the defaults, ~1e-13: below float32 resolution, i.e. exact in
-    value even for heavily tied score distributions). Each pass's ``counts``
-    reduction is a ``psum`` when run under ``shard_map``, so this serves as
-    the multi-host replacement for Spark's distributed approxQuantile
-    (SURVEY.md §5.8) at ``refine_passes`` collective rounds.
+    Matches the Greenwald-Khanna contract of Spark's ``approxQuantile``
+    (``core/SharedTrainLogic.scala:195-197``): the result is a member of
+    ``scores`` whose rank is within ``eps * N`` of ``ceil(q*N)``, over an
+    **arbitrary value range** — ``[lo, hi]`` defaults to the observed
+    min/max. Each pass histograms the scores over the current range, locates
+    the bin containing the target rank, and narrows to that bin. The pass
+    count is adaptive: refinement continues until the target bin's population
+    is within the rank budget (so even a range inflated by a lone extreme
+    outlier — heavy-tailed score columns are the norm in anomaly detection —
+    converges; each pass shrinks the bin ``num_bins``-fold) or the bin is below
+    float32 resolution (tie-heavy data; rank error 0). The final answer snaps
+    to the smallest score ≥ the bin's lower edge, so the returned value is
+    always an element of the input. This is the eager/host-driven variant
+    (Python loop, host scalars) — it cannot run under jit/shard_map; use
+    :func:`histogram_quantile_jit` inside compiled or distributed programs.
     """
     scores = jnp.asarray(scores, jnp.float32)
     n = scores.shape[0]
+    if lo is None:
+        lo = float(jnp.min(scores))
+    if hi is None:
+        hi = float(jnp.max(scores))
     target = max(int(np.ceil(q * n)), 1)
-    for _ in range(refine_passes):
+    rank_budget = max(int(eps * n), 1)
+    for _ in range(max_passes):
         width = hi - lo
         if width <= 0:
             break
@@ -65,54 +85,85 @@ def histogram_quantile(
             .at[jnp.where(bins < 0, num_bins, bins)]
             .add(1, mode="drop")
         )
-        below = int(np.sum(np.asarray(bins) < 0))  # scores strictly below lo
+        below = int(jnp.sum(bins < 0))  # scores strictly below lo (scalar xfer)
         cum = below + np.cumsum(counts)
         idx = min(int(np.searchsorted(cum, target)), num_bins - 1)
         lo, hi = lo + idx * width / num_bins, lo + (idx + 1) * width / num_bins
-    return float(lo)
+        # Adaptive stop: once the target bin holds <= eps*N elements every
+        # element in it satisfies the rank budget; the float-resolution check
+        # stops tie-heavy bins that can never thin out (rank error 0 there).
+        if counts[idx] <= rank_budget or (hi - lo) <= _f32_resolution(lo, hi):
+            break
+    # Snap to an actual element: smallest score >= the refined lower edge.
+    return float(jnp.min(jnp.where(scores >= lo, scores, jnp.inf)))
 
 
 def histogram_quantile_jit(
     scores,
     q: float,
     num_bins: int = 8192,
-    refine_passes: int = 3,
-    lo: float = 0.0,
-    hi: float = 1.0,
+    eps: float = 1e-3,
+    max_passes: int = 12,
+    lo=None,
+    hi=None,
 ):
     """Traceable (jit/shard_map-friendly) refined histogram quantile.
 
-    Same algorithm as :func:`histogram_quantile`, but every step is a jax op
-    so it composes into a fused distributed program: under GSPMD, each pass's
-    scatter-add histogram reduces with one psum-shaped collective while the
-    score vector stays row-sharded — no global gather/sort. Resolution after
-    ``P`` passes: ``(hi - lo) / num_bins**P`` (defaults ~2e-12, below f32 ulp).
+    Same adaptive algorithm and element-of-input contract as
+    :func:`histogram_quantile`, but every step is a jax op so it composes into
+    a fused distributed program: under GSPMD, the initial min/max, each pass's
+    scatter-add histogram, and the final element snap reduce with
+    psum/pmin-shaped collectives while the score vector stays row-sharded —
+    no global gather/sort. The refinement runs as a ``while_loop`` bounded by
+    ``max_passes``, exiting early once the target bin's population fits the
+    ``eps * N`` rank budget or the bin width falls below float32 resolution,
+    so outlier-inflated ranges converge instead of exhausting a fixed pass
+    count.
     """
     import jax.lax as lax
 
     scores = jnp.asarray(scores, jnp.float32)
     n = scores.shape[0]
     target = jnp.maximum(jnp.ceil(q * n), 1.0).astype(jnp.int32)
+    rank_budget = jnp.maximum(jnp.int32(eps * n), 1)
+    lo0 = jnp.min(scores) if lo is None else jnp.float32(lo)
+    hi0 = jnp.max(scores) if hi is None else jnp.float32(hi)
 
-    def one_pass(carry, _):
-        lo_c, hi_c = carry
-        width = hi_c - lo_c
+    def resolution(lo_c, hi_c):
+        scale = jnp.maximum(
+            jnp.maximum(jnp.abs(lo_c), jnp.abs(hi_c)),
+            jnp.float32(np.finfo(np.float32).tiny),
+        )
+        return scale * jnp.float32(2.0 ** (-24))
+
+    def cond(state):
+        lo_c, hi_c, bin_count, passes = state
+        return (
+            (passes < max_passes)
+            & (bin_count > rank_budget)
+            & ((hi_c - lo_c) > resolution(lo_c, hi_c))
+        )
+
+    def body(state):
+        lo_c, hi_c, _, passes = state
+        width = jnp.maximum(hi_c - lo_c, jnp.float32(np.finfo(np.float32).tiny))
         rel = jnp.floor((scores - lo_c) / width * num_bins)
         bins = jnp.clip(rel, -1, num_bins).astype(jnp.int32)
         counts = jnp.zeros((num_bins + 2,), jnp.int32).at[bins + 1].add(1)
         cum = counts[0] + jnp.cumsum(counts[1 : num_bins + 1])
-        idx = jnp.clip(jnp.searchsorted(cum, target), 0, num_bins - 1).astype(
-            jnp.float32
+        idx = jnp.clip(jnp.searchsorted(cum, target), 0, num_bins - 1)
+        idx_f = idx.astype(jnp.float32)
+        return (
+            lo_c + idx_f * width / num_bins,
+            lo_c + (idx_f + 1.0) * width / num_bins,
+            counts[idx + 1],
+            passes + 1,
         )
-        return (lo_c + idx * width / num_bins, lo_c + (idx + 1.0) * width / num_bins), None
 
-    (lo_f, _), _ = lax.scan(
-        one_pass,
-        (jnp.float32(lo), jnp.float32(hi)),
-        None,
-        length=refine_passes,
+    lo_f, _, _, _ = lax.while_loop(
+        cond, body, (lo0, hi0, jnp.int32(n), jnp.int32(0))
     )
-    return lo_f
+    return jnp.min(jnp.where(scores >= lo_f, scores, jnp.inf))
 
 
 def contamination_threshold(
@@ -128,7 +179,7 @@ def contamination_threshold(
     q = 1.0 - contamination
     if contamination_error == 0.0 or np.size(scores) <= exact_size_limit:
         return exact_quantile(scores, q)
-    return histogram_quantile(scores, q)
+    return histogram_quantile(scores, q, eps=contamination_error)
 
 
 def observed_contamination(scores, threshold: float) -> float:

@@ -109,7 +109,9 @@ def make_train_step(
             # psum-able histogram sketch: scores stay row-sharded
             from ..ops.quantile import histogram_quantile_jit
 
-            threshold = histogram_quantile_jit(scores, 1.0 - contamination)
+            threshold = histogram_quantile_jit(
+                scores, 1.0 - contamination, eps=contamination_error
+            )
         elif contamination > 0.0:
             # exact rank pick == approxQuantile with error budget 0
             # (SharedTrainLogic.scala:187-197); GSPMD all-gathers the sharded

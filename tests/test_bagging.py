@@ -59,13 +59,81 @@ class TestBaggedIndices:
         b = np.asarray(bagged_indices(jax.random.PRNGKey(8), 1000, 128, 8, False))
         assert not np.array_equal(a, b)
 
-    def test_large_n_path(self):
-        # > 2^20 rows switches to the approximate (collision-negligible) path
-        idx = np.asarray(
-            bagged_indices(jax.random.PRNGKey(0), (1 << 20) + 5, 256, 4, False)
-        )
-        assert idx.shape == (4, 256)
-        assert idx.max() < (1 << 20) + 5
+    def test_large_n_exact_unique(self):
+        # N*T over the permutation budget routes to Floyd's algorithm —
+        # still exactly without replacement (reference's Binomial(1, rate)
+        # semantics, BaggedPoint.scala:130-139); uniqueness must hold at N=1M+
+        from isoforest_tpu.ops import bagging as bg
+
+        N = (1 << 20) + 5
+        old = bg._PERMUTATION_MAX_ELEMS
+        bg._PERMUTATION_MAX_ELEMS = 1  # force the Floyd branch at this N
+        try:
+            idx = np.asarray(bagged_indices(jax.random.PRNGKey(0), N, 256, 8, False))
+        finally:
+            bg._PERMUTATION_MAX_ELEMS = old
+        assert idx.shape == (8, 256)
+        assert idx.min() >= 0 and idx.max() < N
+        for t in range(8):
+            assert len(np.unique(idx[t])) == 256
+        # and the production dispatch at this N*T (> 2^26) must also be exact
+        idx2 = np.asarray(bagged_indices(jax.random.PRNGKey(0), N, 256, 128, False))
+        for t in range(0, 128, 17):
+            assert len(np.unique(idx2[t])) == 256
+
+    def test_without_replacement_rejects_oversized_bag(self):
+        # S > N without replacement must fail loudly, not fill bags with
+        # garbage (the Floyd branch would otherwise silently emit index 0
+        # and negative ids)
+        import pytest
+
+        with pytest.raises(ValueError, match="distinct rows"):
+            bagged_indices(jax.random.PRNGKey(0), 100, 200, 4, False)
+        # bootstrap may oversample freely
+        idx = np.asarray(bagged_indices(jax.random.PRNGKey(0), 100, 200, 4, True))
+        assert idx.shape == (4, 200)
+
+    def test_large_samples_topk_path(self):
+        # S above the Floyd budget routes to the chunked top-k sampler;
+        # exactness and uniformity must hold there too
+        from isoforest_tpu.ops import bagging as bg
+
+        N, S, T = 5000, 2500, 12
+        old_perm, old_floyd = bg._PERMUTATION_MAX_ELEMS, bg._FLOYD_MAX_SAMPLES
+        bg._PERMUTATION_MAX_ELEMS = 1  # forbid permutation
+        bg._FLOYD_MAX_SAMPLES = 1  # forbid Floyd -> top-k with chunking
+        try:
+            idx = np.asarray(bagged_indices(jax.random.PRNGKey(5), N, S, T, False))
+        finally:
+            bg._PERMUTATION_MAX_ELEMS, bg._FLOYD_MAX_SAMPLES = old_perm, old_floyd
+        assert idx.shape == (T, S)
+        assert idx.min() >= 0 and idx.max() < N
+        for t in range(T):
+            assert len(np.unique(idx[t])) == S
+        counts = np.bincount(idx.ravel(), minlength=N)
+        expected = S * T / N
+        sigma = np.sqrt(T * (S / N) * (1 - S / N))
+        assert np.all(np.abs(counts - expected) < 6 * sigma)
+
+    def test_floyd_uniform_coverage(self):
+        # the Floyd path must still be uniform over rows: force it by using
+        # a row count just over the permutation-path budget per tree
+        from isoforest_tpu.ops import bagging as bg
+
+        N, S, T = 700, 350, 400
+        old = bg._PERMUTATION_MAX_ELEMS
+        bg._PERMUTATION_MAX_ELEMS = 0
+        try:
+            idx = np.asarray(bagged_indices(jax.random.PRNGKey(3), N, S, T, False))
+        finally:
+            bg._PERMUTATION_MAX_ELEMS = old
+        for t in range(0, T, 37):
+            assert len(np.unique(idx[t])) == S
+        counts = np.bincount(idx.ravel(), minlength=N)
+        expected = S * T / N
+        sigma = np.sqrt(T * (S / N) * (1 - S / N))
+        assert abs(counts.mean() - expected) < 1e-9
+        assert np.all(np.abs(counts - expected) < 6 * sigma)
 
 
 class TestFeatureSubsets:

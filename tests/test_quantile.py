@@ -37,10 +37,18 @@ class TestExactQuantile:
 
 class TestHistogramQuantile:
     @pytest.mark.parametrize("q", [0.5, 0.9, 0.98, 0.999])
-    def test_matches_exact_after_refinement(self, scores, q):
-        assert histogram_quantile(scores, q) == pytest.approx(
+    def test_matches_exact_with_tight_eps(self, scores, q):
+        # eps below 1/N forces refinement to a single-element bin — the
+        # result must be (value-)equal to the exact rank pick
+        assert histogram_quantile(scores, q, eps=1e-9) == pytest.approx(
             exact_quantile(scores, q), abs=2e-7
         )
+
+    @pytest.mark.parametrize("q", [0.5, 0.9, 0.98, 0.999])
+    def test_default_eps_rank_budget(self, scores, q):
+        v = histogram_quantile(scores, q)
+        assert v in scores
+        assert _rank_error(scores, v, q) <= 1e-3 * len(scores)
 
     def test_heavy_ties(self):
         s = np.full(50000, 0.437, np.float32)
@@ -50,17 +58,66 @@ class TestHistogramQuantile:
 
     def test_jit_variant_matches(self, scores):
         for q in [0.5, 0.98]:
-            assert float(histogram_quantile_jit(scores, q)) == pytest.approx(
+            assert float(histogram_quantile_jit(scores, q, eps=1e-9)) == pytest.approx(
                 exact_quantile(scores, q), abs=2e-7
             )
 
     def test_jit_variant_traceable(self, scores):
         import jax
 
-        f = jax.jit(lambda s: histogram_quantile_jit(s, 0.98))
+        f = jax.jit(lambda s: histogram_quantile_jit(s, 0.98, eps=1e-9))
         assert float(f(scores)) == pytest.approx(
             exact_quantile(scores, 0.98), abs=2e-7
         )
+
+
+def _rank_error(scores, value, q):
+    """min |rank(value) - target_rank| over the value's positions (GK metric)."""
+    s = np.sort(scores)
+    target = max(int(np.ceil(q * len(s))), 1) - 1
+    lo = np.searchsorted(s, value, side="left")
+    hi = np.searchsorted(s, value, side="right") - 1
+    if lo > hi:  # not an element — infinite error
+        return np.inf
+    return 0 if lo <= target <= hi else min(abs(lo - target), abs(hi - target))
+
+
+class TestGreenwaldKhannaContract:
+    """approxQuantile semantics (SharedTrainLogic.scala:195-197): the result
+    is an actual element of the column, rank error <= eps*N, over arbitrary
+    value ranges — not just scores in [0, 1]."""
+
+    CASES = [
+        ("normal_1e6", lambda rng: rng.normal(1e6, 1e3, 40001)),
+        ("exponential", lambda rng: rng.exponential(5.0, 40001)),
+        ("negative_range", lambda rng: rng.uniform(-300.0, -7.0, 40001)),
+        ("heavy_ties", lambda rng: rng.choice([1.5, 2.5, 99.0], 40001)),
+        ("single_value", lambda rng: np.full(1001, 42.0)),
+        # a lone extreme outlier inflates the histogram range a billion-fold;
+        # the adaptive pass count must still land within the rank budget
+        ("outlier_inflated", lambda rng: np.r_[rng.uniform(0, 1, 40000), [1e9]]),
+        ("outlier_both_tails", lambda rng: np.r_[rng.uniform(0, 1, 40000), [-1e8, 1e9]]),
+    ]
+
+    @pytest.mark.parametrize("name,gen", CASES, ids=[c[0] for c in CASES])
+    @pytest.mark.parametrize("q", [0.1, 0.5, 0.95, 0.999])
+    def test_element_and_rank_error(self, name, gen, q):
+        import zlib
+
+        rng = np.random.default_rng(zlib.crc32(name.encode()))
+        s = gen(rng).astype(np.float32)
+        eps = 0.001
+        for impl in (histogram_quantile, lambda a, b: float(histogram_quantile_jit(a, b))):
+            v = impl(s, q)
+            assert v in s, f"{name}: result {v} is not an element of the input"
+            assert _rank_error(s, v, q) <= eps * len(s)
+
+    def test_exact_is_also_element(self):
+        rng = np.random.default_rng(9)
+        s = rng.normal(-50.0, 10.0, 9999).astype(np.float32)
+        v = exact_quantile(s, 0.73)
+        assert v in s
+        assert _rank_error(s, v, 0.73) == 0
 
 
 class TestContaminationThreshold:
