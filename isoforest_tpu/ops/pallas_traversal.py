@@ -33,20 +33,36 @@ from ..utils.math import avg_path_length, height_of as _height_of
 from .tree_growth import StandardForest
 
 _ROW_BLOCK = 1024
+# Mosaic tiles f32 as (8, 128) sublane x lane; node tables and the feature
+# axis are padded to lane multiples so every block is natively tileable
+# (511-wide tables and raw F were the round-1 hardware-compile risk).
+_LANES = 128
 
 
-def _leaf_value_tables(num_instances: np.ndarray, h: int) -> jax.Array:
-    """[T, M] ``depth + c(numInstances)`` at leaves, 0 elsewhere (host prep)."""
+def _pad_lanes(n: int) -> int:
+    return max(_LANES, -(-n // _LANES) * _LANES)
+
+
+def _leaf_value_tables(num_instances: np.ndarray, h: int, m_pad: int) -> jax.Array:
+    """[T, 1, m_pad] ``depth + c(numInstances)`` at leaves, 0 elsewhere (host
+    prep). Padded slots contribute 0 to every walk. The unit middle axis
+    makes each per-tree block's trailing two dims equal the array dims,
+    which Mosaic's block-shape rules require."""
     depth = np.concatenate(
         [np.full((1 << level,), float(level), np.float32) for level in range(h + 1)]
     )
     ni = np.asarray(num_instances)
     leaf = ni >= 0
-    return jnp.asarray(
-        np.where(leaf, depth[None, :] + np.asarray(avg_path_length(ni)), 0.0).astype(
-            np.float32
-        )
-    )
+    vals = np.where(leaf, depth[None, :] + np.asarray(avg_path_length(ni)), 0.0)
+    return jnp.asarray(_pad_table(vals.astype(np.float32), m_pad, 0.0))
+
+
+def _pad_table(arr: np.ndarray, m_pad: int, fill: float) -> np.ndarray:
+    """Pad a [T, M] node table to [T, 1, m_pad] with ``fill``."""
+    t, m = arr.shape
+    out = np.full((t, m_pad), fill, arr.dtype)
+    out[:, :m] = arr
+    return out[:, None, :]
 
 
 def _walk_levels(B, internal_f32, leaf_value, h: int):
@@ -67,19 +83,29 @@ def _walk_levels(B, internal_f32, leaf_value, h: int):
     return total
 
 
-def _standard_kernel(h, F, T, x_ref, feat_ref, thr_ref, leaf_ref, out_ref):
+def _standard_kernel(h, T, x_ref, feat_ref, thr_ref, leaf_ref, out_ref):
     t = pl.program_id(1)
-    x = x_ref[...]  # [C_blk, F]
-    feature = feat_ref[...]  # [1, M] f32 (feature id; -1 leaf)
-    thr = thr_ref[...]
-    # dense one-hot feature select without gathers: F static passes
-    xv = jnp.zeros((x.shape[0], feature.shape[1]), jnp.float32)
-    for f in range(F):
-        sel = (feature == float(f)).astype(jnp.float32)  # [1, M]
-        xv = xv + x[:, f : f + 1] * sel
+    x = x_ref[...]  # [C_blk, F_pad]
+    # node-table refs are [1, 1, M_pad] blocks (trailing two dims equal the
+    # [T, 1, M_pad] array dims — a Mosaic block-shape requirement); drop the
+    # leading tree axis
+    feature = feat_ref[0]  # [1, M_pad] int32 (feature id; -1 leaf/pad)
+    thr = thr_ref[0]
+    f_pad = x.shape[1]
+    m_pad = feature.shape[1]
+    # One-hot feature selection as a single MXU contraction (the formulation
+    # dense_traversal.py uses; the round-1 per-feature unrolled loop was
+    # O(F * C * M) VPU passes and could not scale to the F=274 configs).
+    # sel[f, m] = 1 iff node m splits on feature f; padded slots match no f.
+    # Mosaic requires integer iota, hence the int32 feature table.
+    iota_f = jax.lax.broadcasted_iota(jnp.int32, (f_pad, m_pad), 0)
+    sel = (iota_f == feature).astype(jnp.float32)  # [F_pad, M_pad]
+    xv = jax.lax.dot_general(
+        x, sel, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [C_blk, M_pad]
     B = (xv >= thr).astype(jnp.float32)
-    internal = (feature >= 0.0).astype(jnp.float32) + jnp.zeros_like(xv)
-    pl_len = _walk_levels(B, internal, leaf_ref[...] + jnp.zeros_like(xv), h)
+    internal = (feature >= 0).astype(jnp.float32) + jnp.zeros_like(xv)
+    pl_len = _walk_levels(B, internal, leaf_ref[0] + jnp.zeros_like(xv), h)
 
     @pl.when(t == 0)
     def _init():
@@ -90,14 +116,14 @@ def _standard_kernel(h, F, T, x_ref, feat_ref, thr_ref, leaf_ref, out_ref):
 
 def _extended_kernel(h, T, x_ref, w_ref, off_ref, internal_ref, leaf_ref, out_ref):
     t = pl.program_id(1)
-    x = x_ref[...]  # [C_blk, F]
-    W = w_ref[0]  # block is [1, M, F] -> [M, F] dense hyperplanes
+    x = x_ref[...]  # [C_blk, F_pad]
+    W = w_ref[0]  # block is [1, M_pad, F_pad] -> [M_pad, F_pad] hyperplanes
     dots = jax.lax.dot_general(
         x, W, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # [C_blk, M] — MXU
-    B = (dots >= off_ref[...]).astype(jnp.float32)
-    internal = internal_ref[...] + jnp.zeros_like(dots)
-    pl_len = _walk_levels(B, internal, leaf_ref[...] + jnp.zeros_like(dots), h)
+    )  # [C_blk, M_pad] — MXU
+    B = (dots >= off_ref[0]).astype(jnp.float32)
+    internal = internal_ref[0] + jnp.zeros_like(dots)
+    pl_len = _walk_levels(B, internal, leaf_ref[0] + jnp.zeros_like(dots), h)
 
     @pl.when(t == 0)
     def _init():
@@ -111,20 +137,20 @@ def _vmem_spec(block_shape, index_map):
     return pl.BlockSpec(block_shape, index_map, **kw)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _standard_pallas(X, feature_f32, threshold, leaf_value, interpret=False):
-    C, F = X.shape
-    T, M = threshold.shape
-    h = _height_of(M)
+@functools.partial(jax.jit, static_argnames=("h", "interpret"))
+def _standard_pallas(X, feature_f32, threshold, leaf_value, h, interpret=False):
+    C, Fp = X.shape
+    T, _, Mp = threshold.shape
     grid = (C // _ROW_BLOCK, T)
+    table = _vmem_spec((1, 1, Mp), lambda rb, t: (t, 0, 0))
     return pl.pallas_call(
-        functools.partial(_standard_kernel, h, F, T),
+        functools.partial(_standard_kernel, h, T),
         grid=grid,
         in_specs=[
-            _vmem_spec((_ROW_BLOCK, F), lambda rb, t: (rb, 0)),
-            _vmem_spec((1, M), lambda rb, t: (t, 0)),
-            _vmem_spec((1, M), lambda rb, t: (t, 0)),
-            _vmem_spec((1, M), lambda rb, t: (t, 0)),
+            _vmem_spec((_ROW_BLOCK, Fp), lambda rb, t: (rb, 0)),
+            table,
+            table,
+            table,
         ],
         out_specs=_vmem_spec((_ROW_BLOCK, 1), lambda rb, t: (rb, 0)),
         out_shape=jax.ShapeDtypeStruct((C, 1), jnp.float32),
@@ -132,21 +158,21 @@ def _standard_pallas(X, feature_f32, threshold, leaf_value, interpret=False):
     )(X, feature_f32, threshold, leaf_value)[:, 0]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _extended_pallas(X, W_dense, offset, internal, leaf_value, interpret=False):
-    C, F = X.shape
-    T, M = offset.shape
-    h = _height_of(M)
+@functools.partial(jax.jit, static_argnames=("h", "interpret"))
+def _extended_pallas(X, W_dense, offset, internal, leaf_value, h, interpret=False):
+    C, Fp = X.shape
+    T, _, Mp = offset.shape
     grid = (C // _ROW_BLOCK, T)
+    table = _vmem_spec((1, 1, Mp), lambda rb, t: (t, 0, 0))
     return pl.pallas_call(
         functools.partial(_extended_kernel, h, T),
         grid=grid,
         in_specs=[
-            _vmem_spec((_ROW_BLOCK, F), lambda rb, t: (rb, 0)),
-            _vmem_spec((1, M, F), lambda rb, t: (t, 0, 0)),
-            _vmem_spec((1, M), lambda rb, t: (t, 0)),
-            _vmem_spec((1, M), lambda rb, t: (t, 0)),
-            _vmem_spec((1, M), lambda rb, t: (t, 0)),
+            _vmem_spec((_ROW_BLOCK, Fp), lambda rb, t: (rb, 0)),
+            _vmem_spec((1, Mp, Fp), lambda rb, t: (t, 0, 0)),
+            table,
+            table,
+            table,
         ],
         out_specs=_vmem_spec((_ROW_BLOCK, 1), lambda rb, t: (rb, 0)),
         out_shape=jax.ShapeDtypeStruct((C, 1), jnp.float32),
@@ -178,43 +204,61 @@ def _cached_prep(forest, build, extra_key=()):
 
 def path_lengths_pallas(forest, X, interpret: bool = False) -> jax.Array:
     """Mean path lengths via the Pallas kernel. Rows are padded to the row
-    block internally; pass ``interpret=True`` off-TPU."""
+    block and the node/feature axes to lane multiples internally; pass
+    ``interpret=True`` off-TPU."""
     X = jnp.asarray(X, jnp.float32)
-    n = X.shape[0]
+    n, F = X.shape
+    f_pad = _pad_lanes(F)
     pad = (-n) % _ROW_BLOCK
-    if pad:
-        X = jnp.pad(X, ((0, pad), (0, 0)))
+    if pad or f_pad != F:
+        X = jnp.pad(X, ((0, pad), (0, f_pad - F)))
     h = _height_of(forest.max_nodes)
+    m_pad = _pad_lanes(forest.max_nodes)
     if isinstance(forest, StandardForest):
 
         def build_standard():
+            # pads: feature -1 (no one-hot match, non-internal), threshold
+            # +inf (go-right bit 0), leaf value 0 (no contribution)
             return (
-                jnp.asarray(forest.feature, jnp.float32),
-                jnp.asarray(forest.threshold),
-                _leaf_value_tables(forest.num_instances, h),
+                jnp.asarray(
+                    _pad_table(np.asarray(forest.feature, np.int32), m_pad, -1)
+                ),
+                jnp.asarray(
+                    _pad_table(np.asarray(forest.threshold, np.float32), m_pad, np.inf)
+                ),
+                _leaf_value_tables(forest.num_instances, h, m_pad),
             )
 
         feature_f32, threshold, leaf_value = _cached_prep(forest, build_standard)
-        out = _standard_pallas(X, feature_f32, threshold, leaf_value, interpret=interpret)
+        out = _standard_pallas(
+            X, feature_f32, threshold, leaf_value, h, interpret=interpret
+        )
     else:
-        F = X.shape[1]
 
         def build_extended():
             indices = np.asarray(forest.indices)
             weights = np.asarray(forest.weights)
             T, M, _ = indices.shape
-            W = np.zeros((T, M, F), np.float32)
+            W = np.zeros((T, m_pad, f_pad), np.float32)
             t_ix, m_ix, k_ix = np.nonzero(indices >= 0)
             W[t_ix, m_ix, indices[t_ix, m_ix, k_ix]] += weights[t_ix, m_ix, k_ix]
             return (
                 jnp.asarray(W),
-                jnp.asarray(forest.offset),
-                jnp.asarray((indices[..., 0] >= 0).astype(np.float32)),
-                _leaf_value_tables(forest.num_instances, h),
+                jnp.asarray(
+                    _pad_table(np.asarray(forest.offset, np.float32), m_pad, np.inf)
+                ),
+                jnp.asarray(
+                    _pad_table(
+                        (indices[..., 0] >= 0).astype(np.float32), m_pad, 0.0
+                    )
+                ),
+                _leaf_value_tables(forest.num_instances, h, m_pad),
             )
 
         W, offset, internal, leaf_value = _cached_prep(
             forest, build_extended, extra_key=(F,)
         )
-        out = _extended_pallas(X, W, offset, internal, leaf_value, interpret=interpret)
+        out = _extended_pallas(
+            X, W, offset, internal, leaf_value, h, interpret=interpret
+        )
     return out[:n]

@@ -80,13 +80,12 @@ def histogram_quantile(
             break
         rel = jnp.floor((scores - lo) / width * num_bins)
         bins = jnp.clip(rel, -1, num_bins).astype(jnp.int32)
-        counts = np.asarray(
-            jnp.zeros((num_bins,), jnp.int32)
-            .at[jnp.where(bins < 0, num_bins, bins)]
-            .add(1, mode="drop")
+        # slot 0 counts scores strictly below lo; one scatter, one transfer
+        all_counts = np.asarray(
+            jnp.zeros((num_bins + 2,), jnp.int32).at[bins + 1].add(1)
         )
-        below = int(jnp.sum(bins < 0))  # scores strictly below lo (scalar xfer)
-        cum = below + np.cumsum(counts)
+        counts = all_counts[1 : num_bins + 1]
+        cum = all_counts[0] + np.cumsum(counts)
         idx = min(int(np.searchsorted(cum, target)), num_bins - 1)
         lo, hi = lo + idx * width / num_bins, lo + (idx + 1) * width / num_bins
         # Adaptive stop: once the target bin holds <= eps*N elements every

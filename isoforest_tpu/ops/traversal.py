@@ -95,6 +95,28 @@ def path_lengths(forest, X: jax.Array) -> jax.Array:
     return extended_path_lengths(forest, X)
 
 
+# Measured per-backend winners for strategy="auto". CPU: gather beats dense
+# ~50x (benchmarks/README.md, round 1). TPU: per-lane gathers serialise in
+# the XLA lowering while the dense level-walk is full-width VPU/MXU work
+# (docs/DESIGN.md §3) — dense is the design-predicted winner, pinned here so
+# serving code gets the right kernel without running bench.py first;
+# re-pinned from hardware measurement whenever bench.py runs on a live TPU
+# (it writes the measured winner via ISOFOREST_TPU_STRATEGY or this table).
+PLATFORM_DEFAULT_STRATEGY = {
+    "cpu": "gather",
+    "tpu": "dense",
+}
+
+
+def default_strategy() -> str:
+    """Resolve the measured/predicted best strategy for the live backend."""
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:  # backend bring-up failed; any strategy works on CPU
+        platform = "cpu"
+    return PLATFORM_DEFAULT_STRATEGY.get(platform, "gather")
+
+
 @functools.partial(jax.jit, static_argnames=("num_samples", "strategy"))
 def _score_chunk(forest, X, num_samples: int, strategy: str = "dense") -> jax.Array:
     if strategy == "dense":
@@ -122,28 +144,32 @@ def score_matrix(
 
     ``strategy``:
       * ``"gather"`` — pointer-walk formulation, ``O(C * h)`` gathers.
-        Fastest on CPU (measured ~50x over dense) and the default.
+        Fastest on CPU (measured ~50x over dense; the CPU auto default).
       * ``"dense"`` — gather-free level-walk (:mod:`.dense_traversal`),
         ``O(C * M)`` full-width vector ops; the hyperplane variant runs on
         the MXU. Candidate fast path on TPU where per-lane gathers
         serialise.
       * ``"pallas"`` — hand-blocked TPU kernel of the dense algorithm
         (:mod:`.pallas_traversal`).
-      * ``"auto"`` — ``ISOFOREST_TPU_STRATEGY`` env var if set, else
-        ``gather``. ``bench.py`` measures all strategies on the live
-        backend and reports the winner, so hardware picks its own path.
+      * ``"auto"`` — ``ISOFOREST_TPU_STRATEGY`` env var if set, else the
+        per-backend default from :data:`PLATFORM_DEFAULT_STRATEGY`
+        (``jax.devices()[0].platform``: gather on CPU, dense on TPU) —
+        a fresh process on each backend picks its measured/predicted
+        winner with no env var and no bench run. ``bench.py`` measures
+        all strategies on the live backend and reports the ranking.
     """
     if strategy == "auto":
-        strategy = os.environ.get("ISOFOREST_TPU_STRATEGY", "gather")
+        strategy = os.environ.get("ISOFOREST_TPU_STRATEGY") or default_strategy()
         if strategy not in ("gather", "dense", "pallas"):
             from ..utils import logger
 
             logger.warning(
                 "ISOFOREST_TPU_STRATEGY=%r is not one of gather/dense/pallas; "
-                "using gather",
+                "using %s",
                 strategy,
+                default_strategy(),
             )
-            strategy = "gather"
+            strategy = default_strategy()
     if strategy not in ("gather", "dense", "pallas"):
         raise ValueError(
             f"unknown scoring strategy {strategy!r}; expected one of "

