@@ -79,6 +79,37 @@ def kddcup_http_like(
     return X[perm], y[perm]
 
 
+def kddcup_http_hard(
+    n: int = 1_000_000, contamination: float = 0.004, seed: int = 7
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Harder KDDCup99-HTTP-like mixture whose AUROC can actually fail.
+
+    :func:`kddcup_http_like` saturates at AUROC 1.0000 for every reasonable
+    implementation (VERDICT r1: a benchmark that cannot detect a quality
+    regression). Here half the attacks are 'stealth': drawn from the normal
+    cloud's own covariance at ~2 Mahalanobis-sigma offset, so they overlap
+    the inlier tail and perfect separation is impossible. A healthy isolation
+    forest lands at AUROC ~0.93-0.97 on this mixture; degraded tree growth,
+    broken bagging, or a mis-set threshold moves the number measurably.
+    """
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    n_loud = n_out // 2
+    n_stealth = n_out - n_loud
+    cov = [[0.6, 0.1, 0.0], [0.1, 1.2, 0.3], [0.0, 0.3, 1.5]]
+    normal = rng.multivariate_normal(mean=[0.0, 5.2, 8.0], cov=cov, size=n - n_out)
+    loud = rng.multivariate_normal(
+        mean=[4.5, 9.5, 2.0], cov=(2.0 * np.eye(3)).tolist(), size=n_loud
+    )
+    stealth = rng.multivariate_normal(
+        mean=[1.4, 6.9, 9.9], cov=cov, size=n_stealth
+    )
+    X = np.vstack([normal, loud, stealth]).astype(np.float32)
+    y = np.concatenate([np.zeros(n - n_out), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
 def high_dim_blobs(
     n: int = 20000, f: int = 274, contamination: float = 0.02, seed: int = 0
 ) -> Tuple[np.ndarray, np.ndarray]:

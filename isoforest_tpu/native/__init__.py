@@ -18,8 +18,11 @@ from typing import Optional
 import numpy as np
 
 _HERE = pathlib.Path(__file__).parent
-_SRC = _HERE / "isoforest_io.cpp"
-_SO = _HERE / "_isoforest_io.so"
+_SRCS = (_HERE / "isoforest_io.cpp", _HERE / "scorer.cpp")
+# Versioned output name: dlopen dedupes by pathname within a process, so a
+# stale cached .so CANNOT be fixed by rebuilding to the same path — bump the
+# version whenever the exported C symbol set changes.
+_SO = _HERE / "_isoforest_native_v2.so"
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -31,10 +34,14 @@ def _build() -> Optional[ctypes.CDLL]:
     cmd = [
         compiler,
         "-O3",
+        # no FMA contraction: the scorer's hyperplane dot must round exactly
+        # like XLA's separate mul+add, or near-tie nodes route differently
+        # and e2e score parity (ONNX gate, strategy equivalence) breaks
+        "-ffp-contract=off",
         "-shared",
         "-fPIC",
         "-std=c++17",
-        str(_SRC),
+        *map(str, _SRCS),
         "-o",
         str(_SO),
     ]
@@ -65,6 +72,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.if_decode_extended.argtypes = [
         i8p, i64, i64, i32p, i32p, i32p, i32p, f64p, i64p, i32p, i32p, f32p, i64,
     ]
+    i32 = ctypes.c_int32
+    lib.if_score_standard.restype = None
+    lib.if_score_standard.argtypes = [
+        f32p, i64, i32, i32p, f32p, f32p, i64, i64, i32, f32p,
+    ]
+    lib.if_score_extended.restype = None
+    lib.if_score_extended.argtypes = [
+        f32p, i64, i32, i32p, f32p, f32p, f32p, i64, i64, i32, i32, f32p,
+    ]
     return lib
 
 
@@ -89,7 +105,11 @@ def get_library() -> Optional[ctypes.CDLL]:
         if lib is None:
             _build_failed = True
             return None
-        _lib = _bind(lib)
+        try:
+            _lib = _bind(lib)
+        except AttributeError:  # symbol set mismatch: treat as unavailable
+            _build_failed = True
+            return None
     return _lib
 
 
@@ -187,3 +207,87 @@ def decode_extended_block(body: bytes, count: int):
         raise ValueError("corrupt Avro block (extended node records)")
     total = int(hyper_len.sum())
     return cols, flat_indices[:total], flat_weights[:total], hyper_len
+
+
+def _f32ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _i32ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+# Per-forest host-side prep (contiguous copies + leaf-value table) cached by
+# array identities, same policy as the Pallas prep cache: serving loops that
+# score many small batches must not re-copy the forest per call. Holding the
+# keyed arrays prevents id() reuse; bounded FIFO.
+_PREP_CACHE: dict = {}
+_PREP_CACHE_MAX = 8
+
+
+def _cached(arrays: tuple, build):
+    key = tuple(id(a) for a in arrays)
+    hit = _PREP_CACHE.get(key)
+    if hit is not None and all(a is b for a, b in zip(hit[0], arrays)):
+        return hit[1]
+    prep = build()
+    if len(_PREP_CACHE) >= _PREP_CACHE_MAX:
+        _PREP_CACHE.pop(next(iter(_PREP_CACHE)))
+    _PREP_CACHE[key] = (arrays, prep)
+    return prep
+
+
+def score_standard(feature, threshold, num_instances, X, height: int):
+    """Mean path length f32[N] via the native walker; None if unavailable.
+
+    Arrays follow ops/tree_growth.StandardForest layout ([T, M] i32/f32/i32).
+    """
+    lib = get_library()
+    if lib is None:
+        return None
+    from ..utils.math import leaf_value_table
+
+    X = np.ascontiguousarray(X, np.float32)
+    feature, threshold, leaf_value = _cached(
+        (feature, threshold, num_instances),
+        lambda: (
+            np.ascontiguousarray(feature, np.int32),
+            np.ascontiguousarray(threshold, np.float32),
+            leaf_value_table(num_instances, height),
+        ),
+    )
+    n, f = X.shape
+    t, m = feature.shape
+    out = np.empty(n, np.float32)
+    lib.if_score_standard(
+        _f32ptr(X), n, f, _i32ptr(feature), _f32ptr(threshold),
+        _f32ptr(leaf_value), t, m, height, _f32ptr(out),
+    )
+    return out
+
+
+def score_extended(indices, weights, offset, num_instances, X, height: int):
+    """Extended-forest variant ([T, M, k] hyperplanes); None if unavailable."""
+    lib = get_library()
+    if lib is None:
+        return None
+    from ..utils.math import leaf_value_table
+
+    X = np.ascontiguousarray(X, np.float32)
+    indices, weights, offset, leaf_value = _cached(
+        (indices, weights, offset, num_instances),
+        lambda: (
+            np.ascontiguousarray(indices, np.int32),
+            np.ascontiguousarray(weights, np.float32),
+            np.ascontiguousarray(offset, np.float32),
+            leaf_value_table(num_instances, height),
+        ),
+    )
+    n, f = X.shape
+    t, m, k = indices.shape
+    out = np.empty(n, np.float32)
+    lib.if_score_extended(
+        _f32ptr(X), n, f, _i32ptr(indices), _f32ptr(weights), _f32ptr(offset),
+        _f32ptr(leaf_value), t, m, k, height, _f32ptr(out),
+    )
+    return out

@@ -29,7 +29,7 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-from ..utils.math import avg_path_length, height_of as _height_of
+from ..utils.math import height_of as _height_of
 from .tree_growth import StandardForest
 
 _ROW_BLOCK = 1024
@@ -44,17 +44,13 @@ def _pad_lanes(n: int) -> int:
 
 
 def _leaf_value_tables(num_instances: np.ndarray, h: int, m_pad: int) -> jax.Array:
-    """[T, 1, m_pad] ``depth + c(numInstances)`` at leaves, 0 elsewhere (host
-    prep). Padded slots contribute 0 to every walk. The unit middle axis
-    makes each per-tree block's trailing two dims equal the array dims,
-    which Mosaic's block-shape rules require."""
-    depth = np.concatenate(
-        [np.full((1 << level,), float(level), np.float32) for level in range(h + 1)]
-    )
-    ni = np.asarray(num_instances)
-    leaf = ni >= 0
-    vals = np.where(leaf, depth[None, :] + np.asarray(avg_path_length(ni)), 0.0)
-    return jnp.asarray(_pad_table(vals.astype(np.float32), m_pad, 0.0))
+    """[T, 1, m_pad] leaf-value table (:func:`..utils.math.leaf_value_table`
+    padded; pad slots contribute 0 to every walk). The unit middle axis makes
+    each per-tree block's trailing two dims equal the array dims, which
+    Mosaic's block-shape rules require."""
+    from ..utils.math import leaf_value_table
+
+    return jnp.asarray(_pad_table(leaf_value_table(num_instances, h), m_pad, 0.0))
 
 
 def _pad_table(arr: np.ndarray, m_pad: int, fill: float) -> np.ndarray:

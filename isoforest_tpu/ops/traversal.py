@@ -95,17 +95,20 @@ def path_lengths(forest, X: jax.Array) -> jax.Array:
     return extended_path_lengths(forest, X)
 
 
-# Measured per-backend winners for strategy="auto". CPU: gather beats dense
-# ~50x (benchmarks/README.md, round 1). TPU: per-lane gathers serialise in
+# Measured per-backend winners for strategy="auto". CPU: the hand-scheduled
+# C++ walker beats the XLA gather path ~4x single-core, which itself beats
+# dense ~50x (benchmarks/README.md). TPU: per-lane gathers serialise in
 # the XLA lowering while the dense level-walk is full-width VPU/MXU work
 # (docs/DESIGN.md §3) — dense is the design-predicted winner, pinned here so
 # serving code gets the right kernel without running bench.py first;
 # re-pinned from hardware measurement whenever bench.py runs on a live TPU
 # (it writes the measured winner via ISOFOREST_TPU_STRATEGY or this table).
 PLATFORM_DEFAULT_STRATEGY = {
-    "cpu": "gather",
+    "cpu": "native",
     "tpu": "dense",
 }
+
+STRATEGIES = ("gather", "dense", "pallas", "native")
 
 
 def default_strategy() -> str:
@@ -114,7 +117,39 @@ def default_strategy() -> str:
         platform = jax.devices()[0].platform
     except Exception:  # backend bring-up failed; any strategy works on CPU
         platform = "cpu"
-    return PLATFORM_DEFAULT_STRATEGY.get(platform, "gather")
+    choice = PLATFORM_DEFAULT_STRATEGY.get(platform, "gather")
+    if choice == "native":
+        from .. import native
+
+        if not native.available():  # no C++ toolchain: portable jax path
+            return "gather"
+    return choice
+
+
+def _score_native(forest, X, num_samples: int):
+    """C++ walker path: pure numpy in/out, no jax, no chunking/padding.
+    Returns None when the native library is unavailable."""
+    from .. import native
+
+    h = _height_of(forest.max_nodes)
+    X = np.ascontiguousarray(X, np.float32)
+    if isinstance(forest, StandardForest):
+        pl = native.score_standard(
+            forest.feature, forest.threshold, forest.num_instances, X, h
+        )
+    else:
+        pl = native.score_extended(
+            forest.indices,
+            forest.weights,
+            forest.offset,
+            forest.num_instances,
+            X,
+            h,
+        )
+    if pl is None:
+        return None
+    c = float(avg_path_length(num_samples))
+    return np.exp2(-pl / c).astype(np.float32)
 
 
 @functools.partial(jax.jit, static_argnames=("num_samples", "strategy"))
@@ -151,30 +186,37 @@ def score_matrix(
         serialise.
       * ``"pallas"`` — hand-blocked TPU kernel of the dense algorithm
         (:mod:`.pallas_traversal`).
+      * ``"native"`` — hand-scheduled C++ walker (:mod:`..native` scorer),
+        the CPU fast path; no jax involvement at all.
       * ``"auto"`` — ``ISOFOREST_TPU_STRATEGY`` env var if set, else the
         per-backend default from :data:`PLATFORM_DEFAULT_STRATEGY`
-        (``jax.devices()[0].platform``: gather on CPU, dense on TPU) —
+        (``jax.devices()[0].platform``: native C++ on CPU, dense on TPU) —
         a fresh process on each backend picks its measured/predicted
         winner with no env var and no bench run. ``bench.py`` measures
         all strategies on the live backend and reports the ranking.
     """
     if strategy == "auto":
         strategy = os.environ.get("ISOFOREST_TPU_STRATEGY") or default_strategy()
-        if strategy not in ("gather", "dense", "pallas"):
+        if strategy not in STRATEGIES:
             from ..utils import logger
 
             logger.warning(
-                "ISOFOREST_TPU_STRATEGY=%r is not one of gather/dense/pallas; "
-                "using %s",
+                "ISOFOREST_TPU_STRATEGY=%r is not one of %s; using %s",
                 strategy,
+                "/".join(STRATEGIES),
                 default_strategy(),
             )
             strategy = default_strategy()
-    if strategy not in ("gather", "dense", "pallas"):
+    if strategy not in STRATEGIES:
         raise ValueError(
             f"unknown scoring strategy {strategy!r}; expected one of "
-            "'auto', 'gather', 'dense', 'pallas'"
+            f"'auto', {', '.join(repr(s) for s in STRATEGIES)}"
         )
+    if strategy == "native":
+        out = _score_native(forest, X, num_samples)
+        if out is not None:
+            return out
+        strategy = "gather"  # toolchain unavailable: portable fallback
     if strategy == "pallas":
         from .pallas_traversal import path_lengths_pallas
 

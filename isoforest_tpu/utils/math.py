@@ -70,3 +70,20 @@ def score_from_path_length(mean_path_length, num_samples) -> jnp.ndarray:
     """Anomaly score ``s = 2^(-E[h(x)] / c(n))`` (IsolationForestModel.scala:135-138)."""
     c = avg_path_length(num_samples)
     return jnp.exp2(-jnp.asarray(mean_path_length, jnp.float32) / c)
+
+
+def leaf_value_table(num_instances, height: int) -> np.ndarray:
+    """Per-heap-slot ``depth + c(numInstances)`` at leaves, 0 elsewhere —
+    ``f32[T, M]`` (numpy, host-side).
+
+    The shared precompute of the dense/Pallas/native scorers: a walk that
+    ends at slot ``m`` contributes exactly this table entry (slot depth is
+    static in the implicit heap; IsolationTree.scala:213-229 leaf semantics).
+    """
+    depth = np.concatenate(
+        [np.full((1 << lv,), float(lv), np.float32) for lv in range(height + 1)]
+    )
+    ni = np.asarray(num_instances)
+    return np.where(
+        ni >= 0, depth[None, :] + np.asarray(avg_path_length(ni)), 0.0
+    ).astype(np.float32)

@@ -21,7 +21,7 @@ def models():
     return X, std, ext
 
 
-@pytest.mark.parametrize("strategy", ["dense", "pallas"])
+@pytest.mark.parametrize("strategy", ["dense", "pallas", "native"])
 class TestStrategyEquivalence:
     def test_standard(self, models, strategy):
         X, std, _ = models
@@ -52,11 +52,18 @@ class TestAutoStrategy:
         base = score_matrix(std.forest, X[:512], std.num_samples, strategy="gather")
         np.testing.assert_allclose(got, base, atol=3e-6)
 
-    def test_default_is_gather(self, models, monkeypatch):
+    def test_default_matches_backend_winner(self, models, monkeypatch):
+        # on CPU, auto resolves to the native C++ walker (gather if no
+        # toolchain); outputs must be bitwise-identical to an explicit call
+        import isoforest_tpu.native as native
+        from isoforest_tpu.ops.traversal import default_strategy
+
         X, std, _ = models
         monkeypatch.delenv("ISOFOREST_TPU_STRATEGY", raising=False)
+        expected = "native" if native.available() else "gather"
+        assert default_strategy() == expected
         got = score_matrix(std.forest, X[:512], std.num_samples, strategy="auto")
-        base = score_matrix(std.forest, X[:512], std.num_samples, strategy="gather")
+        base = score_matrix(std.forest, X[:512], std.num_samples, strategy=expected)
         np.testing.assert_array_equal(got, base)
 
     def test_auto_dispatch_is_per_backend(self, monkeypatch):
@@ -71,10 +78,14 @@ class TestAutoStrategy:
             def __init__(self, platform):
                 self.platform = platform
 
+        import isoforest_tpu.native as native
+
         monkeypatch.setattr(tv.jax, "devices", lambda: [_Dev("tpu")])
         assert tv.default_strategy() == "dense"
         monkeypatch.setattr(tv.jax, "devices", lambda: [_Dev("cpu")])
-        assert tv.default_strategy() == "gather"
+        assert tv.default_strategy() == ("native" if native.available() else "gather")
+        monkeypatch.setattr(native, "available", lambda: False)
+        assert tv.default_strategy() == "gather"  # no toolchain -> portable
         monkeypatch.setattr(tv.jax, "devices", lambda: [_Dev("gpu")])
         assert tv.default_strategy() == "gather"
 
@@ -100,7 +111,7 @@ class TestAutoStrategy:
         X = np.full((1100, 3), 2.0, np.float32)
         ext = ExtendedIsolationForest(num_estimators=4, max_samples=32.0).fit(X)
         base = score_matrix(ext.forest, X, ext.num_samples, strategy="gather")
-        for strategy in ["dense", "pallas"]:
+        for strategy in ["dense", "pallas", "native"]:
             got = score_matrix(ext.forest, X, ext.num_samples, strategy=strategy)
             np.testing.assert_allclose(got, base, atol=3e-6)
 
