@@ -280,6 +280,60 @@ def decode_value(schema: Any, reader: _Reader) -> Any:
 # --------------------------------------------------------------------------- #
 
 
+def _write_header(fh, schema_json: str, codec: str, sync: bytes) -> None:
+    header = bytearray()
+    header += MAGIC
+    meta = {"avro.schema": schema_json.encode(), "avro.codec": codec.encode()}
+    header += encode_long(len(meta))
+    for k, v in meta.items():
+        kb = k.encode()
+        header += encode_long(len(kb))
+        header += kb
+        header += encode_long(len(v))
+        header += v
+    header += encode_long(0)
+    header += sync
+    fh.write(bytes(header))
+
+
+def _compress_block(payload: bytes, codec: str, level: int = 9) -> bytes:
+    if codec == "deflate":
+        comp = zlib.compressobj(level, zlib.DEFLATED, -15)
+        return comp.compress(payload) + comp.flush()
+    if codec != "null":
+        raise ValueError(f"unsupported write codec {codec!r}")
+    return payload
+
+
+def write_container_raw(
+    path: str,
+    schema: Any,
+    blocks: Iterable[tuple],
+    codec: str = "deflate",
+    level: int = 1,
+) -> None:
+    """Write an Avro object-container file from pre-encoded block bodies.
+
+    ``blocks`` yields ``(record_count, plaintext_body_bytes)`` — the
+    write-side twin of :func:`read_blocks`, used by the native columnar
+    encoders (record encoding happens in C, container framing here).
+    Defaults to fast deflate (``level=1``): the save fast path trades a
+    slightly larger file for wall-clock.
+    """
+    schema_json = schema if isinstance(schema, str) else json.dumps(schema)
+    sync = os.urandom(SYNC_SIZE)
+    with open(path, "wb") as fh:
+        _write_header(fh, schema_json, codec, sync)
+        for count, body in blocks:
+            if not count:
+                continue
+            payload = _compress_block(body, codec, level)
+            fh.write(encode_long(count))
+            fh.write(encode_long(len(payload)))
+            fh.write(payload)
+            fh.write(sync)
+
+
 def write_container(
     path: str,
     schema: Any,
@@ -291,19 +345,7 @@ def write_container(
     schema_json = schema if isinstance(schema, str) else json.dumps(schema)
     sync = os.urandom(SYNC_SIZE)
     with open(path, "wb") as fh:
-        header = bytearray()
-        header += MAGIC
-        meta = {"avro.schema": schema_json.encode(), "avro.codec": codec.encode()}
-        header += encode_long(len(meta))
-        for k, v in meta.items():
-            kb = k.encode()
-            header += encode_long(len(kb))
-            header += kb
-            header += encode_long(len(v))
-            header += v
-        header += encode_long(0)
-        header += sync
-        fh.write(bytes(header))
+        _write_header(fh, schema_json, codec, sync)
 
         parsed = _normalise(schema_json)
         batch: List[dict] = []
@@ -314,12 +356,7 @@ def write_container(
             body = bytearray()
             for rec in batch:
                 encode_value(parsed, rec, body)
-            payload = bytes(body)
-            if codec == "deflate":
-                comp = zlib.compressobj(9, zlib.DEFLATED, -15)
-                payload = comp.compress(payload) + comp.flush()
-            elif codec != "null":
-                raise ValueError(f"unsupported write codec {codec!r}")
+            payload = _compress_block(bytes(body), codec)
             fh.write(encode_long(len(batch)))
             fh.write(encode_long(len(payload)))
             fh.write(payload)

@@ -184,6 +184,67 @@ def extended_tree_to_records(indices, weights, offset, num_instances) -> List[di
 
 
 
+def heap_preorder_columns(internal: np.ndarray):
+    """Vectorised heap -> pre-order conversion for a whole forest.
+
+    ``internal``: bool [T, M] (node at heap slot is internal). Returns
+    ``(trees, slots, pre_id, left_id, right_id)`` — flat arrays over all
+    existing nodes, ordered (tree, pre-order id), where ``left_id/right_id``
+    are pre-order child ids (-1 at leaves). This replaces the recursive
+    per-node Python walk of :func:`standard_tree_to_records` on the save
+    fast path: pre-order ids satisfy ``id(left) = id + 1`` and
+    ``id(right) = id + 1 + subtree_size(left)``, so subtree sizes (one
+    reverse level sweep) and ids (one forward level sweep) vectorise over
+    the whole [T, M] table.
+    """
+    t_n, m = internal.shape
+    h = int(np.log2(m + 1)) - 1
+    exists = np.zeros((t_n, m), bool)
+    exists[:, 0] = True
+    for level in range(h):
+        start, width = (1 << level) - 1, 1 << level
+        parent_int = exists[:, start : start + width] & internal[:, start : start + width]
+        child = 2 * start + 1
+        exists[:, child : child + 2 * width : 2] = parent_int
+        exists[:, child + 1 : child + 1 + 2 * width : 2] = parent_int
+    size = exists.astype(np.int64)
+    for level in range(h - 1, -1, -1):
+        start, width = (1 << level) - 1, 1 << level
+        child = 2 * start + 1
+        size[:, start : start + width] += (
+            size[:, child : child + 2 * width : 2]
+            + size[:, child + 1 : child + 1 + 2 * width : 2]
+        ) * internal[:, start : start + width]
+    pre_id = np.full((t_n, m), np.iinfo(np.int64).max, np.int64)
+    pre_id[:, 0] = 0
+    for level in range(h):
+        start, width = (1 << level) - 1, 1 << level
+        child = 2 * start + 1
+        base = pre_id[:, start : start + width]
+        left_sz = size[:, child : child + 2 * width : 2]
+        pre_id[:, child : child + 2 * width : 2] = base + 1
+        pre_id[:, child + 1 : child + 1 + 2 * width : 2] = base + 1 + left_sz
+    pre_id = np.where(exists, pre_id, np.iinfo(np.int64).max)
+    order = np.argsort(pre_id, axis=1, kind="stable")  # existing slots first
+    counts = exists.sum(axis=1)
+    keep = np.arange(m)[None, :] < counts[:, None]  # first count[t] of each row
+    trees = np.repeat(np.arange(t_n, dtype=np.int32), counts)
+    slots = order[keep]
+    flat = (np.arange(t_n)[:, None] * m + order)[keep]  # (t, slot) flat index
+    pre_flat = pre_id.reshape(-1)[flat].astype(np.int32)
+    int_flat = internal.reshape(-1)[flat]
+    left_slot = np.minimum(2 * (flat % m) + 1, m - 1)
+    right_slot = np.minimum(2 * (flat % m) + 2, m - 1)
+    base_flat = (flat // m) * m
+    left_id = np.where(
+        int_flat, pre_id.reshape(-1)[base_flat + left_slot], -1
+    ).astype(np.int32)
+    right_id = np.where(
+        int_flat, pre_id.reshape(-1)[base_flat + right_slot], -1
+    ).astype(np.int32)
+    return trees, slots.astype(np.int32), pre_flat, left_id, right_id
+
+
 # A tree of depth d occupies 2^(d+1)-1 heap slots. Reference-conformant trees
 # have depth <= ceil(log2(maxSamples)) (IsolationTree.scala:60-61), so even
 # maxSamples = 10^6 stays under 21. A corrupt or adversarial node table
@@ -329,12 +390,21 @@ def _read_metadata(path: str) -> dict:
         return json.loads(fh.readline())
 
 
-def _write_data(path: str, schema: dict, records: List[dict]) -> None:
+def _data_part_path(path: str) -> str:
+    """Spark-layout framing shared by both save paths: data dir + single
+    part file; caller writes it, then :func:`_mark_success` seals it."""
     data_dir = os.path.join(path, "data")
     os.makedirs(data_dir, exist_ok=True)
-    fname = f"part-00000-{uuid.uuid4()}-c000.avro"
-    avro.write_container(os.path.join(data_dir, fname), schema, records)
-    open(os.path.join(data_dir, "_SUCCESS"), "w").close()
+    return os.path.join(data_dir, f"part-00000-{uuid.uuid4()}-c000.avro")
+
+
+def _mark_success(path: str) -> None:
+    open(os.path.join(path, "data", "_SUCCESS"), "w").close()
+
+
+def _write_data(path: str, schema: dict, records: List[dict]) -> None:
+    avro.write_container(_data_part_path(path), schema, records)
+    _mark_success(path)
 
 
 def _read_data(path: str) -> List[dict]:
@@ -555,9 +625,46 @@ def _model_metadata(model, class_name: str) -> dict:
     }
 
 
+def _write_data_raw(path: str, schema: dict, body: bytes, count: int) -> None:
+    avro.write_container_raw(_data_part_path(path), schema, [(count, body)])
+    _mark_success(path)
+
+
+def _fast_standard_body(forest):
+    """Vectorised pre-order + native columnar encode; None if unavailable."""
+    from .. import native
+
+    if not native.available():
+        return None
+    feature = np.asarray(forest.feature)
+    threshold = np.asarray(forest.threshold)
+    num_instances = np.asarray(forest.num_instances)
+    m = feature.shape[1]
+    trees, slots, pre, left, right = heap_preorder_columns(feature >= 0)
+    flat = trees.astype(np.int64) * m + slots
+    attr = feature.reshape(-1)[flat]
+    is_int = attr >= 0
+    # leaf sentinels per IsolationForestModelReadWrite.scala:36-67
+    val = np.where(is_int, threshold.reshape(-1)[flat].astype(np.float64), 0.0)
+    ni = np.where(is_int, -1, num_instances.reshape(-1)[flat]).astype(np.int64)
+    body = native.encode_standard_records(trees, pre, left, right, attr, val, ni)
+    if body is None:
+        return None
+    return body, len(trees)
+
+
 def save_standard_model(model, path: str, overwrite: bool = False) -> None:
     _prepare_dir(path, overwrite)
     _write_metadata(path, _model_metadata(model, STANDARD_MODEL_CLASS))
+    fast = _fast_standard_body(model.forest)
+    if fast is not None:
+        _write_data_raw(path, STANDARD_SCHEMA, *fast)
+        logger.info(
+            "saved IsolationForestModel (%d trees) to %s (native encoder)",
+            model.forest.num_trees,
+            path,
+        )
+        return
     feature = np.asarray(model.forest.feature)
     threshold = np.asarray(model.forest.threshold)
     num_instances = np.asarray(model.forest.num_instances)
@@ -569,6 +676,36 @@ def save_standard_model(model, path: str, overwrite: bool = False) -> None:
     logger.info("saved IsolationForestModel (%d trees) to %s", len(feature), path)
 
 
+def _fast_extended_body(forest):
+    """EIF variant of :func:`_fast_standard_body`."""
+    from .. import native
+
+    if not native.available():
+        return None
+    indices = np.asarray(forest.indices)
+    weights = np.asarray(forest.weights)
+    offset = np.asarray(forest.offset)
+    num_instances = np.asarray(forest.num_instances)
+    t_n, m, k = indices.shape
+    trees, slots, pre, left, right = heap_preorder_columns(indices[:, :, 0] >= 0)
+    flat = trees.astype(np.int64) * m + slots
+    idx_rows = indices.reshape(-1, k)[flat]  # [n, k]
+    w_rows = weights.reshape(-1, k)[flat]
+    valid = idx_rows >= 0
+    hyper_len = valid.sum(axis=1).astype(np.int32)
+    flat_idx = idx_rows[valid].astype(np.int32)
+    flat_w = w_rows[valid].astype(np.float32)
+    is_int = idx_rows[:, 0] >= 0
+    off = np.where(is_int, offset.reshape(-1)[flat].astype(np.float64), 0.0)
+    ni = np.where(is_int, -1, num_instances.reshape(-1)[flat]).astype(np.int64)
+    body = native.encode_extended_records(
+        trees, pre, left, right, off, ni, hyper_len, flat_idx, flat_w
+    )
+    if body is None:
+        return None
+    return body, len(trees)
+
+
 def save_extended_model(model, path: str, overwrite: bool = False) -> None:
     _prepare_dir(path, overwrite)
     meta = _model_metadata(model, EXTENDED_MODEL_CLASS)
@@ -576,6 +713,15 @@ def save_extended_model(model, path: str, overwrite: bool = False) -> None:
     # estimator left it unset — ExtendedIsolationForest.scala:102)
     meta["paramMap"]["extensionLevel"] = int(model.extension_level)
     _write_metadata(path, meta)
+    fast = _fast_extended_body(model.forest)
+    if fast is not None:
+        _write_data_raw(path, EXTENDED_SCHEMA, *fast)
+        logger.info(
+            "saved ExtendedIsolationForestModel (%d trees) to %s (native encoder)",
+            model.forest.num_trees,
+            path,
+        )
+        return
     indices = np.asarray(model.forest.indices)
     weights = np.asarray(model.forest.weights)
     offset = np.asarray(model.forest.offset)

@@ -18,11 +18,11 @@ from typing import Optional
 import numpy as np
 
 _HERE = pathlib.Path(__file__).parent
-_SRCS = (_HERE / "isoforest_io.cpp", _HERE / "scorer.cpp")
+_SRCS = (_HERE / "isoforest_io.cpp", _HERE / "scorer.cpp", _HERE / "encoder.cpp")
 # Versioned output name: dlopen dedupes by pathname within a process, so a
 # stale cached .so CANNOT be fixed by rebuilding to the same path — bump the
 # version whenever the exported C symbol set changes.
-_SO = _HERE / "_isoforest_native_v2.so"
+_SO = _HERE / "_isoforest_native_v3.so"
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -80,6 +80,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.if_score_extended.restype = None
     lib.if_score_extended.argtypes = [
         f32p, i64, i32, i32p, f32p, f32p, f32p, i64, i64, i32, i32, f32p,
+    ]
+    lib.if_encode_standard.restype = i64
+    lib.if_encode_standard.argtypes = [
+        i32p, i32p, i32p, i32p, i32p, f64p, i64p, i64, i8p, i64,
+    ]
+    lib.if_encode_extended.restype = i64
+    lib.if_encode_extended.argtypes = [
+        i32p, i32p, i32p, i32p, f64p, i64p, i32p, i32p, f32p, i64, i8p, i64,
     ]
     return lib
 
@@ -291,3 +299,62 @@ def score_extended(indices, weights, offset, num_instances, X, height: int):
         _f32ptr(leaf_value), t, m, k, height, _f32ptr(out),
     )
     return out
+
+
+def _i64ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f64ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def encode_standard_records(tree_id, node_id, left, right, attr, value, ni):
+    """Columns -> Avro binary body for (treeID, nodeData) rows; None if the
+    native library is unavailable."""
+    lib = get_library()
+    if lib is None:
+        return None
+    n = len(tree_id)
+    cap = 64 * n + 64
+    out = np.empty(cap, np.uint8)
+    written = lib.if_encode_standard(
+        _i32ptr(np.ascontiguousarray(tree_id, np.int32)),
+        _i32ptr(np.ascontiguousarray(node_id, np.int32)),
+        _i32ptr(np.ascontiguousarray(left, np.int32)),
+        _i32ptr(np.ascontiguousarray(right, np.int32)),
+        _i32ptr(np.ascontiguousarray(attr, np.int32)),
+        _f64ptr(np.ascontiguousarray(value, np.float64)),
+        _i64ptr(np.ascontiguousarray(ni, np.int64)),
+        n, _u8ptr(out), cap,
+    )
+    if written < 0:
+        return None
+    return out[:written].tobytes()
+
+
+def encode_extended_records(
+    tree_id, node_id, left, right, offset, ni, hyper_len, flat_idx, flat_w
+):
+    """Extended variant; hyperplanes flattened with per-record lengths."""
+    lib = get_library()
+    if lib is None:
+        return None
+    n = len(tree_id)
+    cap = 96 * n + 14 * len(flat_idx) + 64
+    out = np.empty(cap, np.uint8)
+    written = lib.if_encode_extended(
+        _i32ptr(np.ascontiguousarray(tree_id, np.int32)),
+        _i32ptr(np.ascontiguousarray(node_id, np.int32)),
+        _i32ptr(np.ascontiguousarray(left, np.int32)),
+        _i32ptr(np.ascontiguousarray(right, np.int32)),
+        _f64ptr(np.ascontiguousarray(offset, np.float64)),
+        _i64ptr(np.ascontiguousarray(ni, np.int64)),
+        _i32ptr(np.ascontiguousarray(hyper_len, np.int32)),
+        _i32ptr(np.ascontiguousarray(flat_idx, np.int32)),
+        _f32ptr(np.ascontiguousarray(flat_w, np.float32)),
+        n, _u8ptr(out), cap,
+    )
+    if written < 0:
+        return None
+    return out[:written].tobytes()

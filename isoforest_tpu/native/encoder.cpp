@@ -1,0 +1,117 @@
+// Native columnar Avro encoders for the model save path — the write-side
+// mirror of the columnar decoders in isoforest_io.cpp.
+//
+// The round-1 save path walked each tree recursively in Python and encoded
+// records one dict at a time (~2.25 s for a 1000-tree model). Here the
+// heap->pre-order conversion is vectorised numpy (io/persistence.py) and the
+// per-record Avro binary encoding is a single C pass over the columns.
+//
+// Wire format (spark-avro layout, IsolationForestModelReadWrite.scala:36-67):
+//   record topLevelRecord { int treeID; union { nodeData, null } }
+//   nodeData { int id, leftChild, rightChild, splitAttribute;
+//              double splitValue; long numInstances }
+// Extended variant (ExtendedIsolationForestModelReadWrite.scala:59-67):
+//   extendedNodeData { int id, leftChild, rightChild;
+//                      union { array<int>, null } indices;
+//                      union { array<float>, null } weights;
+//                      double offset; long numInstances }
+// Ints/longs are zigzag varints; doubles/floats little-endian; arrays are
+// (count, items..., 0). The unions always take branch 0 (present / actual
+// array — leaves persist EMPTY arrays, not null, matching the reference).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline uint8_t* put_varint(uint8_t* p, uint64_t v) {
+  while (v >= 0x80) {
+    *p++ = static_cast<uint8_t>(v) | 0x80;
+    v >>= 7;
+  }
+  *p++ = static_cast<uint8_t>(v);
+  return p;
+}
+
+inline uint8_t* put_long(uint8_t* p, int64_t v) {
+  return put_varint(p, (static_cast<uint64_t>(v) << 1) ^
+                           static_cast<uint64_t>(v >> 63));
+}
+
+inline uint8_t* put_double(uint8_t* p, double v) {
+  std::memcpy(p, &v, 8);
+  return p + 8;
+}
+
+inline uint8_t* put_float(uint8_t* p, float v) {
+  std::memcpy(p, &v, 4);
+  return p + 4;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Encode n standard (treeID, nodeData) rows. Returns bytes written, or -1 if
+// `cap` could be exceeded (caller sizes cap generously; checked per record).
+int64_t if_encode_standard(const int32_t* tree_id, const int32_t* id,
+                           const int32_t* left, const int32_t* right,
+                           const int32_t* attr, const double* value,
+                           const int64_t* ni, int64_t n, uint8_t* out,
+                           int64_t cap) {
+  uint8_t* p = out;
+  const uint8_t* end = out + cap;
+  for (int64_t i = 0; i < n; ++i) {
+    if (end - p < 64) return -1;  // max record size: 6 varints + 1 double
+    p = put_long(p, tree_id[i]);
+    p = put_long(p, 0);  // union branch 0: nodeData present
+    p = put_long(p, id[i]);
+    p = put_long(p, left[i]);
+    p = put_long(p, right[i]);
+    p = put_long(p, attr[i]);
+    p = put_double(p, value[i]);
+    p = put_long(p, ni[i]);
+  }
+  return p - out;
+}
+
+// Encode n extended rows. Hyperplane coordinates arrive flattened:
+// hyper_len[i] items per record, drawn sequentially from flat_idx /
+// flat_w (leaves have hyper_len == 0 -> empty arrays).
+int64_t if_encode_extended(const int32_t* tree_id, const int32_t* id,
+                           const int32_t* left, const int32_t* right,
+                           const double* offset, const int64_t* ni,
+                           const int32_t* hyper_len, const int32_t* flat_idx,
+                           const float* flat_w, int64_t n, uint8_t* out,
+                           int64_t cap) {
+  uint8_t* p = out;
+  const uint8_t* end = out + cap;
+  int64_t flat = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t k = hyper_len[i];
+    if (end - p < 96 + 14 * k) return -1;
+    p = put_long(p, tree_id[i]);
+    p = put_long(p, 0);  // union branch 0: extendedNodeData present
+    p = put_long(p, id[i]);
+    p = put_long(p, left[i]);
+    p = put_long(p, right[i]);
+    p = put_long(p, 0);  // indices union branch 0: array
+    if (k > 0) {
+      p = put_long(p, k);
+      for (int64_t q = 0; q < k; ++q) p = put_long(p, flat_idx[flat + q]);
+    }
+    p = put_long(p, 0);  // indices array terminator
+    p = put_long(p, 0);  // weights union branch 0: array
+    if (k > 0) {
+      p = put_long(p, k);
+      for (int64_t q = 0; q < k; ++q) p = put_float(p, flat_w[flat + q]);
+    }
+    p = put_long(p, 0);  // weights array terminator
+    p = put_double(p, offset[i]);
+    p = put_long(p, ni[i]);
+    flat += k;
+  }
+  return p - out;
+}
+
+}  // extern "C"

@@ -216,7 +216,13 @@ def score_matrix(
         out = _score_native(forest, X, num_samples)
         if out is not None:
             return out
-        strategy = "gather"  # toolchain unavailable: portable fallback
+        from ..utils import logger
+
+        logger.warning(
+            "native scoring strategy unavailable (no C++ toolchain?); "
+            "falling back to the ~4x-slower gather kernel"
+        )
+        strategy = "gather"
     if strategy == "pallas":
         from .pallas_traversal import path_lengths_pallas
 

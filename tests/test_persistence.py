@@ -121,6 +121,88 @@ class TestPreorderConversion:
                 assert b["numInstances"] == w["numInstances"]
 
 
+class TestNativeSaveFastPath:
+    """The vectorised-preorder + C columnar encoder save path must produce
+    records identical to the recursive reference-semantics walk (it is the
+    same on-disk contract, just 25x faster)."""
+
+    @pytest.fixture(scope="class")
+    def fitted(self):
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(3000, 4)).astype(np.float32)
+        from isoforest_tpu import ExtendedIsolationForest, IsolationForest
+
+        std = IsolationForest(num_estimators=30, max_samples=128.0).fit(X)
+        ext = ExtendedIsolationForest(
+            num_estimators=20, max_samples=64.0, extension_level=2
+        ).fit(X)
+        return std, ext
+
+    def _records(self, model, tmp, forcing_slow):
+        import isoforest_tpu.io.persistence as pers
+
+        path = str(tmp)
+        if forcing_slow:
+            originals = (pers._fast_standard_body, pers._fast_extended_body)
+            pers._fast_standard_body = lambda f: None
+            pers._fast_extended_body = lambda f: None
+            try:
+                model.save(path)
+            finally:
+                pers._fast_standard_body, pers._fast_extended_body = originals
+        else:
+            model.save(path)
+        return pers._read_data(path)
+
+    def test_standard_fast_equals_slow(self, fitted, tmp_path):
+        import isoforest_tpu.native as native
+
+        if not native.available():
+            pytest.skip("native encoder unavailable")
+        std, _ = fitted
+        fast = self._records(std, tmp_path / "fast", False)
+        slow = self._records(std, tmp_path / "slow", True)
+        assert fast == slow
+
+    def test_extended_fast_equals_slow(self, fitted, tmp_path):
+        import isoforest_tpu.native as native
+
+        if not native.available():
+            pytest.skip("native encoder unavailable")
+        _, ext = fitted
+        fast = self._records(ext, tmp_path / "fast", False)
+        slow = self._records(ext, tmp_path / "slow", True)
+        assert fast == slow
+
+    def test_heap_preorder_columns_matches_recursive(self):
+        from isoforest_tpu.io.persistence import (
+            heap_preorder_columns,
+            standard_tree_to_records,
+        )
+
+        rng = np.random.default_rng(0)
+        # random small forest shapes incl. root-leaf and full trees
+        m = 31
+        internal = np.zeros((8, m), bool)
+        internal[1, 0] = True  # root + two leaves
+        internal[2, :15] = True  # full depth-4 internal region
+        for t in range(3, 8):
+            # random valid topology: internal only where parent internal
+            for s in range(m // 2):
+                parent_ok = s == 0 or internal[t, (s - 1) // 2]
+                internal[t, s] = parent_ok and rng.random() < 0.6
+        feature = np.where(internal, 1, -1).astype(np.int32)
+        threshold = rng.normal(size=(8, m)).astype(np.float32)
+        ni = np.where(internal, -1, 5).astype(np.int32)
+        trees, slots, pre, left, right = heap_preorder_columns(internal)
+        for t in range(8):
+            recs = standard_tree_to_records(feature[t], threshold[t], ni[t])
+            mask = trees == t
+            assert list(pre[mask]) == [r["id"] for r in recs]
+            assert list(left[mask]) == [r["leftChild"] for r in recs]
+            assert list(right[mask]) == [r["rightChild"] for r in recs]
+
+
 class TestModelRoundTrip:
     def test_standard(self, std_model, small_data, tmp_path):
         path = str(tmp_path / "m")
