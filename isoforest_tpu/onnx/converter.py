@@ -161,7 +161,15 @@ def _build_score_model(
             proto.tensor_f32("scoreThreshold", [thr]),
         ],
     )
-    return proto.model(graph, opset_imports=[("ai.onnx.ml", 1), ("", 14)])
+    model_bytes = proto.model(graph, opset_imports=[("ai.onnx.ml", 1), ("", 14)])
+    # independent structural gate, the analogue of the reference's
+    # checker.check_model call (isolation_forest_converter.py:168-173): the
+    # checker re-parses the bytes with its own wire tables, so a writer
+    # field-number slip fails loudly here instead of round-tripping silently
+    from .checker import check_model
+
+    check_model(model_bytes)
+    return model_bytes
 
 
 

@@ -110,6 +110,8 @@ PLATFORM_DEFAULT_STRATEGY = {
 
 STRATEGIES = ("gather", "dense", "pallas", "native")
 
+_warned_native_fallback = False
+
 
 def default_strategy() -> str:
     """Resolve the measured/predicted best strategy for the live backend."""
@@ -216,12 +218,15 @@ def score_matrix(
         out = _score_native(forest, X, num_samples)
         if out is not None:
             return out
-        from ..utils import logger
+        global _warned_native_fallback
+        if not _warned_native_fallback:  # once, not per serving-loop call
+            _warned_native_fallback = True
+            from ..utils import logger
 
-        logger.warning(
-            "native scoring strategy unavailable (no C++ toolchain?); "
-            "falling back to the ~4x-slower gather kernel"
-        )
+            logger.warning(
+                "native scoring strategy unavailable (no C++ toolchain?); "
+                "falling back to the ~4x-slower gather kernel"
+            )
         strategy = "gather"
     if strategy == "pallas":
         from .pallas_traversal import path_lengths_pallas
