@@ -1,0 +1,22 @@
+# One-command validation of a fresh checkout — the analogue of the
+# reference's CI gates (.github/workflows/ci.yml: build + test matrix;
+# isolation-forest-onnx/setup.cfg: flake8/mypy/coverage). The image ships no
+# external linters, so lint is the in-repo AST gate (tools/lint.py).
+
+PY ?= python3
+
+.PHONY: check lint test bench dryrun
+
+check: lint test
+
+lint:
+	$(PY) tools/lint.py
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+dryrun:
+	$(PY) __graft_entry__.py dryrun 8

@@ -268,7 +268,7 @@ def full_sweep() -> None:
     from isoforest_tpu import ExtendedIsolationForest, IsolationForest
     from isoforest_tpu.data import (
         high_dim_blobs,
-        kddcup_http_like,
+        kddcup_http_hard,
         load_labeled_csv,
         sinusoid,
         two_blobs,
@@ -309,9 +309,9 @@ def full_sweep() -> None:
     run("two_blobs_eif_full", ExtendedIsolationForest(num_estimators=100), Xb, yb)
     Xw, yw = sinusoid(n=8192)
     run("sinusoid_eif_full", ExtendedIsolationForest(num_estimators=100), Xw, yw)
-    Xk, yk = kddcup_http_like(n=567_000)
+    Xk, yk = kddcup_http_hard(n=567_000)
     run(
-        "kddcup_http_567k_1000trees",
+        "kddcup_http_hard_567k_1000trees",
         IsolationForest(num_estimators=1000),
         Xk,
         yk,

@@ -379,6 +379,42 @@ def _check_tree_ensemble(attrs: dict) -> None:
     post = attrs.get("post_transform", "NONE")
     if post not in _POST_TRANSFORMS:
         raise CheckError(f"invalid post_transform {post!r}")
+    # acyclicity + reachability: every tree must be a rooted binary tree, not
+    # merely have in-range child ids — a back-edge would make any evaluator's
+    # walk diverge (the model loader already rejects cyclic node tables;
+    # the export gate must be at least as strict)
+    children: Dict[int, Dict[int, Tuple[int, int]]] = {}
+    for tid, nid, mode, true_id, false_id in zip(
+        attrs["nodes_treeids"],
+        attrs["nodes_nodeids"],
+        modes,
+        attrs["nodes_truenodeids"],
+        attrs["nodes_falsenodeids"],
+    ):
+        children.setdefault(tid, {})[nid] = (
+            (true_id, false_id) if mode != "LEAF" else None
+        )
+    for tid, table in children.items():
+        if 0 not in table:
+            raise CheckError(f"tree {tid} has no root node 0")
+        seen = set()
+        stack = [0]
+        while stack:
+            nid = stack.pop()
+            if nid in seen:
+                raise CheckError(
+                    f"tree {tid}: node {nid} reached twice — cyclic or "
+                    "converging node table"
+                )
+            seen.add(nid)
+            kids = table[nid]
+            if kids is not None:
+                stack.extend(kids)
+        if len(seen) != len(table):
+            raise CheckError(
+                f"tree {tid}: {len(table) - len(seen)} node(s) unreachable "
+                "from the root"
+            )
 
 
 def check_model(model_bytes: bytes) -> dict:
@@ -476,12 +512,13 @@ def _eval_tree_walk(attrs: dict, X: np.ndarray) -> np.ndarray:
     tree_ids = sorted(set(attrs["nodes_treeids"]))
     agg = attrs.get("aggregate_function", "SUM")
     out = np.zeros((X.shape[0], 1), np.float32)
+    max_steps = len(nodes) + 1  # acyclicity is checked, but stay bounded
     for r in range(X.shape[0]):
         row = X[r]
         total = 0.0
         for tid in tree_ids:
             nid = 0
-            while True:
+            for _ in range(max_steps):
                 node = nodes[(tid, nid)]
                 if node["mode"] == "LEAF":
                     total += leaf_weight.get((tid, nid), 0.0)
@@ -502,6 +539,8 @@ def _eval_tree_walk(attrs: dict, X: np.ndarray) -> np.ndarray:
                 else:
                     take_true = x != v
                 nid = node["true"] if take_true else node["false"]
+            else:
+                raise CheckError(f"tree {tid}: walk exceeded node count")
         if agg == "AVERAGE":
             total /= len(tree_ids)
         out[r, 0] = total

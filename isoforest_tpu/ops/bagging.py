@@ -19,6 +19,8 @@ acceptance gates are statistical, SURVEY.md §7.4.3).
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -102,6 +104,33 @@ def _topk_sample(
     return out.reshape(-1, num_samples)[:num_trees]
 
 
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
+def _bagged_indices_jit(
+    key, num_rows, num_samples, num_trees, bootstrap, perm_max, floyd_max
+):
+    # the dispatch thresholds are static args (not read as globals) so tests
+    # that override them can't hit a stale compiled cache entry.
+    # Cost model (measured, 1-core CPU): Floyd ~S^2 cheap ops per tree;
+    # XLA sort (permutation) ~200 ops per element per tree — so Floyd wins
+    # whenever S^2 < 200*N, i.e. everywhere except huge-bag regimes.
+    tree_keys = per_tree_keys(key, num_trees)
+    if bootstrap:
+        sample = lambda k: jax.random.randint(
+            k, (num_samples,), 0, num_rows, dtype=jnp.int32
+        )
+    elif num_samples <= floyd_max and num_samples * num_samples <= 200 * num_rows:
+        sample = lambda k: _floyd_sample(k, num_rows, num_samples)
+    elif num_rows * num_trees <= perm_max:
+        sample = lambda k: jax.random.permutation(k, num_rows)[:num_samples].astype(
+            jnp.int32
+        )
+    elif num_samples <= floyd_max:
+        sample = lambda k: _floyd_sample(k, num_rows, num_samples)
+    else:
+        return _topk_sample(tree_keys, num_rows, num_samples)
+    return jax.vmap(sample)(tree_keys)
+
+
 def bagged_indices(
     key: jax.Array,
     num_rows: int,
@@ -116,29 +145,27 @@ def bagged_indices(
     (Binomial(1, rate) branch + shuffle/slice, BaggedPoint.scala:130-139 and
     SharedTrainLogic.scala:283-287) — **exact at every N**: rows within a bag
     are guaranteed distinct, matching the reference's Binomial(1, rate)
-    semantics, with no large-N approximation.
+    semantics, with no large-N approximation. Jitted (shape-static args):
+    eager re-tracing of the vmapped samplers cost seconds per fit; compiled
+    programs land in the persistent compilation cache.
     """
     if not bootstrap and num_samples > num_rows:
         raise ValueError(
             f"cannot draw {num_samples} distinct rows from {num_rows} without "
             "replacement (bootstrap=False)"
         )
-    tree_keys = per_tree_keys(key, num_trees)
-    if bootstrap:
-        sample = lambda k: jax.random.randint(
-            k, (num_samples,), 0, num_rows, dtype=jnp.int32
-        )
-    elif num_rows * num_trees <= _PERMUTATION_MAX_ELEMS:
-        sample = lambda k: jax.random.permutation(k, num_rows)[:num_samples].astype(
-            jnp.int32
-        )
-    elif num_samples <= _FLOYD_MAX_SAMPLES:
-        sample = lambda k: _floyd_sample(k, num_rows, num_samples)
-    else:
-        return _topk_sample(tree_keys, num_rows, num_samples)
-    return jax.vmap(sample)(tree_keys)
+    return _bagged_indices_jit(
+        key,
+        num_rows,
+        num_samples,
+        num_trees,
+        bootstrap,
+        _PERMUTATION_MAX_ELEMS,
+        _FLOYD_MAX_SAMPLES,
+    )
 
 
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
 def feature_subsets(
     key: jax.Array,
     total_num_features: int,

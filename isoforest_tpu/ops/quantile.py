@@ -17,7 +17,6 @@ exact (``core/SharedTrainLogic.scala:187-197``). Two TPU-native paths:
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

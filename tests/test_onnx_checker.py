@@ -152,6 +152,21 @@ class TestCheckerRejects:
                 _tiny_valid_graph(ensemble_attrs={"target_nodeids": [1, 9]})
             )
 
+    def test_cyclic_node_table(self):
+        # root's false branch points back at itself: children are in-range,
+        # so only an acyclicity check catches it (an evaluator would hang)
+        with pytest.raises(CheckError, match="cyclic|reached twice"):
+            check_model(
+                _tiny_valid_graph(ensemble_attrs={"nodes_falsenodeids": [0, 0, 0]})
+            )
+
+    def test_unreachable_node(self):
+        # both branches of the root go left: node 2 exists but is orphaned
+        with pytest.raises(CheckError, match="reached twice|unreachable"):
+            check_model(
+                _tiny_valid_graph(ensemble_attrs={"nodes_falsenodeids": [1, 0, 0]})
+            )
+
     def test_bad_aggregate(self):
         with pytest.raises(CheckError, match="aggregate_function"):
             check_model(
