@@ -161,8 +161,18 @@ class TestCheckerRejects:
             )
 
     def test_unreachable_node(self):
-        # both branches of the root go left: node 2 exists but is orphaned
-        with pytest.raises(CheckError, match="reached twice|unreachable"):
+        # root is itself a leaf, so nodes 1 and 2 are orphaned — only the
+        # reachability check (not the revisit check) can catch this
+        with pytest.raises(CheckError, match="unreachable"):
+            check_model(
+                _tiny_valid_graph(
+                    ensemble_attrs={"nodes_modes": ["LEAF", "LEAF", "LEAF"]}
+                )
+            )
+
+    def test_converging_edges(self):
+        # both branches of the root reach node 1: not a tree
+        with pytest.raises(CheckError, match="reached twice"):
             check_model(
                 _tiny_valid_graph(ensemble_attrs={"nodes_falsenodeids": [1, 0, 0]})
             )
