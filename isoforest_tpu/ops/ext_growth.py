@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from . import level_window as lw
 from .bagging import gather_tree_data
 
 
@@ -67,9 +68,16 @@ class ExtendedForest(NamedTuple):
 
 
 def _grow_one_extended_tree(key: jax.Array, x: jax.Array, h: int, k_nonzero: int):
+    """EIF single-tree growth with bounded per-level memory (shared
+    :mod:`.level_window` scaffolding): the per-node uniform k-subset streams
+    across feature chunks via a running Gumbel top-k, and per-node statistics
+    are computed only at the k chosen coordinates via a per-sample gather ->
+    [W, k] scatter — no [M, F] (or even [W, F]) transient anywhere."""
     S, F = x.shape
     M = 2 ** (h + 1) - 1
-    slots = jnp.arange(M, dtype=jnp.int32)
+    W = 2**h
+    geom = lw.chunk_features(x)
+    x, Fc, pad, n_chunks = geom.x, geom.chunk, geom.pad, geom.n_chunks
     level_keys = jax.random.split(key, h + 1)
 
     state = dict(
@@ -84,58 +92,80 @@ def _grow_one_extended_tree(key: jax.Array, x: jax.Array, h: int, k_nonzero: int
 
     def level_step(l, st):
         k_sub, k_w, k_p = jax.random.split(level_keys[l], 3)
+        win = lw.level_window(l, W, st["node_id"], st["settled"])
+        idx_w = win.idx_of_sample
+        cnt = jnp.zeros((W,), jnp.int32).at[idx_w].add(1, mode="drop")
 
-        idx = jnp.where(st["settled"], M, st["node_id"])
-        cnt = jnp.zeros((M,), jnp.int32).at[idx].add(1, mode="drop")
-        minv = jnp.full((M, F), jnp.inf, jnp.float32).at[idx].min(x, mode="drop")
-        maxv = jnp.full((M, F), -jnp.inf, jnp.float32).at[idx].max(x, mode="drop")
+        # --- subspace choice per node: uniform k distinct coordinates
+        # (ExtendedIsolationTree.scala:157-160) as a streaming Gumbel top-k
+        # over feature chunks; padded columns draw -inf and are never picked
+        best_g = jnp.full((W, k_nonzero), -jnp.inf, jnp.float32)
+        best_i = jnp.zeros((W, k_nonzero), jnp.int32)
+        for c in range(n_chunks):
+            g = jax.random.gumbel(
+                jax.random.fold_in(k_sub, c), (W, Fc), jnp.float32
+            )
+            if pad and c == n_chunks - 1:
+                real = jnp.arange(Fc) < (F - c * Fc)
+                g = jnp.where(real[None, :], g, -jnp.inf)
+            cat_g = jnp.concatenate([best_g, g], axis=1)
+            cat_i = jnp.concatenate(
+                [
+                    best_i,
+                    jnp.broadcast_to(
+                        c * Fc + jnp.arange(Fc, dtype=jnp.int32), (W, Fc)
+                    ),
+                ],
+                axis=1,
+            )
+            best_g, top_pos = jax.lax.top_k(cat_g, k_nonzero)
+            best_i = jnp.take_along_axis(cat_i, top_pos, axis=1)
+        sub = jnp.sort(best_i, axis=1)  # canonical ascending (:220-226)
 
-        level_start = (jnp.int32(1) << l) - 1
-        in_level = (slots >= level_start) & (slots < 2 * level_start + 1)
+        # --- per-node stats ONLY at the chosen coordinates: gather each
+        # sample's k values for its node's subspace, scatter-min/max [W, k]
+        sub_of_sample = jnp.take(
+            sub, jnp.clip(idx_w, 0, W - 1), axis=0
+        )  # [S, k]
+        xv_s = jnp.take_along_axis(x, sub_of_sample, axis=1)  # [S, k]
+        mn = jnp.full((W, k_nonzero), jnp.inf, jnp.float32).at[idx_w].min(
+            xv_s, mode="drop"
+        )
+        mx = jnp.full((W, k_nonzero), -jnp.inf, jnp.float32).at[idx_w].max(
+            xv_s, mode="drop"
+        )
 
-        # --- hyperplane draw per node (ExtendedIsolationTree.scala:155-226) ---
-        node_keys = jax.random.split(k_sub, M)
-        perm = jax.vmap(lambda kk: jax.random.permutation(kk, F))(node_keys)
-        sub = jnp.sort(perm[:, :k_nonzero], axis=1).astype(jnp.int32)  # [M, k]
-
-        w = jax.random.normal(k_w, (M, k_nonzero), jnp.float32)
+        # --- hyperplane draw (ExtendedIsolationTree.scala:155-226) ---
+        w = jax.random.normal(k_w, (W, k_nonzero), jnp.float32)
         nrm = jnp.sqrt(jnp.sum(w * w, axis=1))
         zero_norm = nrm == 0.0
         w = w / jnp.maximum(nrm, jnp.float32(1e-37))[:, None]
 
-        mn = jnp.take_along_axis(minv, sub, axis=1)
-        mx = jnp.take_along_axis(maxv, sub, axis=1)
         # empty nodes have inf stats; mask so the offset math stays finite
         finite = cnt > 0
         mn = jnp.where(finite[:, None], mn, 0.0)
         mx = jnp.where(finite[:, None], mx, 0.0)
-        u = jax.random.uniform(k_p, (M, k_nonzero), jnp.float32)
+        u = jax.random.uniform(k_p, (W, k_nonzero), jnp.float32)
         p = mn + u * (mx - mn)
         off = jnp.sum(w * p, axis=1)
 
-        can_split = st["exists"] & in_level & (cnt > 1) & (l < h) & ~zero_norm
-        new_leaf = st["exists"] & in_level & ~can_split
+        exists_w = lw.window_slice(st["exists"], win.start, W)
+        can_split = exists_w & win.in_level & (cnt > 1) & (l < h) & ~zero_norm
+        new_leaf = exists_w & win.in_level & ~can_split
 
-        indices = jnp.where(can_split[:, None], sub, st["indices"])
-        weights = jnp.where(can_split[:, None], w, st["weights"])
-        offset = jnp.where(can_split, off, st["offset"])
-        num_instances = jnp.where(new_leaf, cnt, st["num_instances"])
+        indices = lw.patch(st["indices"], sub, can_split, win.start)
+        weights = lw.patch(st["weights"], w, can_split, win.start)
+        offset = lw.patch(st["offset"], off, can_split, win.start)
+        num_instances = lw.patch(st["num_instances"], cnt, new_leaf, win.start)
 
-        child_l = jnp.where(can_split, 2 * slots + 1, M)
-        child_r = jnp.where(can_split, 2 * slots + 2, M)
-        exists = (
-            st["exists"]
-            .at[child_l].set(True, mode="drop")
-            .at[child_r].set(True, mode="drop")
-        )
+        exists = lw.spawn_children(st["exists"], can_split, win.slots, M)
 
         # --- route: dot(x, w) < offset -> left (:230-232) ---
         nd = st["node_id"]
-        split_here = can_split[nd] & ~st["settled"]
-        sub_s = jnp.maximum(indices[nd], 0)  # [S, k]
-        xv = jnp.take_along_axis(x, sub_s, axis=1)
-        dot = jnp.sum(xv * weights[nd], axis=1)
-        go_right = dot >= offset[nd]
+        j_s = jnp.clip(nd - win.start, 0, W - 1)
+        split_here = jnp.take(can_split, j_s) & ~st["settled"]
+        dot = jnp.sum(xv_s * jnp.take(w, j_s, axis=0), axis=1)
+        go_right = dot >= jnp.take(off, j_s)
         node_id = jnp.where(split_here, 2 * nd + 1 + go_right.astype(jnp.int32), nd)
         settled = st["settled"] | ~split_here
 

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from . import level_window as lw
 from .bagging import gather_tree_data
 
 
@@ -75,27 +76,21 @@ class StandardForest(NamedTuple):
         return self.is_internal | self.is_leaf
 
 
-# Feature-chunk width for per-level statistics. Stats are [level_width,
-# chunk] instead of [max_nodes, F], bounding the transient to
-# T * 2^h * 64 * 8 bytes regardless of F — the r1 kernel allocated
-# [T, M, F] min/max per level (~1.1 GB/level at T=1000, F=274; VERDICT r1
-# weak-4). The uniform choice among non-constant features streams across
-# chunks via a running Gumbel-argmax, which is distributionally identical
-# to a single Gumbel-argmax over all F.
-_FEATURE_CHUNK = 64
-
-
 def _grow_one_tree(key: jax.Array, x: jax.Array, h: int):
-    """Grow one tree over ``x: f32[S, F]``; returns local-feature-indexed arrays."""
+    """Grow one tree over ``x: f32[S, F]``; returns local-feature-indexed arrays.
+
+    Per-level statistics are [level_width, feature_chunk] windows instead of
+    [max_nodes, F] (the r1 kernel's ~1.1 GB/level transient at T=1000,
+    F=274), using the shared scaffolding in :mod:`.level_window`. The
+    uniform choice among non-constant features streams across chunks via a
+    running Gumbel-argmax — distributionally identical to a single
+    Gumbel-argmax over all F.
+    """
     S, F = x.shape
     M = 2 ** (h + 1) - 1
     W = 2**h  # widest level; per-level stats never need more rows
-    Fc = min(F, _FEATURE_CHUNK)
-    pad = (-F) % Fc
-    if pad:
-        # zero-padded features are constant (min == max) -> never chosen
-        x = jnp.pad(x, ((0, 0), (0, pad)))
-    n_chunks = (F + pad) // Fc
+    geom = lw.chunk_features(x)
+    x, Fc, n_chunks = geom.x, geom.chunk, geom.n_chunks
     level_keys = jax.random.split(key, h + 1)
 
     state = dict(
@@ -109,13 +104,8 @@ def _grow_one_tree(key: jax.Array, x: jax.Array, h: int):
 
     def level_step(l, st):
         k_feat, k_thr = jax.random.split(level_keys[l])
-        level_start = (jnp.int32(1) << l) - 1
-        width = jnp.int32(1) << l
-        j_w = jnp.arange(W, dtype=jnp.int32)
-        in_level_w = j_w < width
-
-        # every unsettled sample sits exactly at level l; index within level
-        idx_w = jnp.where(st["settled"], W, st["node_id"] - level_start)
+        win = lw.level_window(l, W, st["node_id"], st["settled"])
+        idx_w = win.idx_of_sample
         cnt = jnp.zeros((W,), jnp.int32).at[idx_w].add(1, mode="drop")
 
         # --- streaming per-node statistics + feature choice, F in chunks ---
@@ -153,35 +143,22 @@ def _grow_one_tree(key: jax.Array, x: jax.Array, h: int):
             any_nc = any_nc | jnp.any(nc, axis=1)
 
         # --- split decision per level-l node (IsolationTree.scala:124-156) ---
-        exists_w = lax.dynamic_slice(st["exists"], (level_start,), (W,))
-        can_split = exists_w & in_level_w & (cnt > 1) & (l < h) & any_nc
+        exists_w = lw.window_slice(st["exists"], win.start, W)
+        can_split = exists_w & win.in_level & (cnt > 1) & (l < h) & any_nc
         u = jax.random.uniform(k_thr, (W,), jnp.float32)
         thr_w = best_mn + u * (best_mx - best_mn)
-        new_leaf = exists_w & in_level_w & ~can_split
+        new_leaf = exists_w & win.in_level & ~can_split
 
-        def patch(arr, new_w, mask):
-            old = lax.dynamic_slice(arr, (level_start,), (W,))
-            return lax.dynamic_update_slice(
-                arr, jnp.where(mask, new_w, old), (level_start,)
-            )
-
-        feature = patch(st["feature"], best_f, can_split)
-        threshold = patch(st["threshold"], thr_w, can_split)
-        num_instances = patch(st["num_instances"], cnt, new_leaf)
+        feature = lw.patch(st["feature"], best_f, can_split, win.start)
+        threshold = lw.patch(st["threshold"], thr_w, can_split, win.start)
+        num_instances = lw.patch(st["num_instances"], cnt, new_leaf, win.start)
 
         # children of split nodes materialise at the next level
-        slots_w = level_start + j_w
-        child_l = jnp.where(can_split, 2 * slots_w + 1, M)
-        child_r = jnp.where(can_split, 2 * slots_w + 2, M)
-        exists = (
-            st["exists"]
-            .at[child_l].set(True, mode="drop")
-            .at[child_r].set(True, mode="drop")
-        )
+        exists = lw.spawn_children(st["exists"], can_split, win.slots, M)
 
         # --- route unsettled samples one level down (x < t left / >= right) ---
         nd = st["node_id"]
-        j_s = jnp.clip(nd - level_start, 0, W - 1)
+        j_s = jnp.clip(nd - win.start, 0, W - 1)
         split_here = jnp.take(can_split, j_s) & ~st["settled"]
         f_s = jnp.take(best_f, j_s)
         go_right = (

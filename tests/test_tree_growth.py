@@ -151,6 +151,79 @@ def _numpy_extended_path(indices, weights, offset, num_instances, x):
     return depth + float(avg_path_length(num_instances[node]))
 
 
+class TestMultiChunkFeatures:
+    """F above level_window.FEATURE_CHUNK exercises the streaming chunk
+    paths (running Gumbel-argmax / top-k merges, per-chunk keys, zero-pad
+    masking) that single-chunk fixtures leave dead."""
+
+    def test_standard_invariants_and_coverage_f130(self):
+        X = _rng_data(600, 130, seed=2)
+        forest, S, _ = _grow(X, T=24, S=64)
+        feat = np.asarray(forest.feature)
+        thr = np.asarray(forest.threshold)
+        ni = np.asarray(forest.num_instances)
+        internal = feat >= 0
+        # chosen features stay within the real F (pad columns never chosen)
+        assert feat[internal].min() >= 0 and feat[internal].max() < 130
+        # both sides of every chunk boundary get picked across 24 trees
+        assert np.any(feat[internal] < 64) and np.any(feat[internal] >= 64)
+        # thresholds within the chosen feature's data range
+        for t in range(0, 24, 5):
+            for i in np.nonzero(internal[t])[0]:
+                f = feat[t, i]
+                assert X[:, f].min() <= thr[t, i] <= X[:, f].max()
+        sums = np.where(ni >= 0, ni, 0).sum(axis=1)
+        np.testing.assert_array_equal(sums, np.full(24, S))
+
+    def test_standard_constant_block_in_second_chunk(self):
+        # features 64..129 constant: the streaming non-constant mask must
+        # exclude the whole second chunk
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(500, 130)).astype(np.float32)
+        X[:, 64:] = 5.0
+        forest, _, _ = _grow(X, T=16, S=64)
+        feat = np.asarray(forest.feature)
+        chosen = feat[feat >= 0]
+        assert chosen.max() < 64
+
+    def test_standard_uniform_across_chunks(self):
+        # choice must be uniform over non-constant features, not biased by
+        # chunk position: with F=96 iid features, expect ~2/3 picks < 64
+        X = _rng_data(800, 96, seed=4)
+        forest, _, _ = _grow(X, T=64, S=64)
+        feat = np.asarray(forest.feature)
+        chosen = feat[feat >= 0]
+        frac_first = (chosen < 64).mean()
+        assert 0.58 < frac_first < 0.75, frac_first
+
+    def test_extended_subspace_f130(self):
+        X = _rng_data(600, 130, seed=5)
+        forest, S, _ = _grow_ext(X, T=16, S=64, level=7)
+        idx = np.asarray(forest.indices)
+        internal = idx[:, :, 0] >= 0
+        sub = idx[internal]
+        assert sub.shape[1] == 8
+        # sorted strictly ascending -> distinct; within real F
+        assert np.all(np.diff(sub, axis=1) > 0)
+        assert sub.min() >= 0 and sub.max() < 130
+        # coordinates drawn from both chunks
+        assert np.any(sub < 64) and np.any(sub >= 64)
+        ni = np.asarray(forest.num_instances)
+        sums = np.where(ni >= 0, ni, 0).sum(axis=1)
+        np.testing.assert_array_equal(sums, np.full(16, S))
+
+    def test_extended_tail_pad_never_drawn(self):
+        # F=70: last chunk is 6 real + 58 padded columns; the pad mask must
+        # keep every drawn coordinate < 70 across many trees
+        X = _rng_data(500, 70, seed=6)
+        forest, _, _ = _grow_ext(X, T=32, S=64, level=5)
+        idx = np.asarray(forest.indices)
+        sub = idx[idx >= 0]
+        assert sub.max() < 70
+        # and the tail's real columns are still reachable
+        assert np.any(sub >= 64)
+
+
 class TestTraversal:
     def test_differential_vs_numpy_oracle(self):
         X = _rng_data(200, 5)
