@@ -324,6 +324,46 @@ def full_sweep() -> None:
         yh,
     )
 
+    # measured per-strategy ranking for the EXTENDED family too (the
+    # standard-model ranking drives auto-tuning; this records whether the
+    # extended dispatch extrapolation holds on this backend)
+    import jax
+
+    from isoforest_tpu.ops.traversal import score_matrix
+
+    ext_model = ExtendedIsolationForest(num_estimators=100).fit(Xb)
+    candidates = ["gather", "dense"]
+    platform = jax.devices()[0].platform
+    if platform == "tpu":
+        candidates.append("pallas")
+    else:
+        from isoforest_tpu import native
+
+        if native.available():
+            candidates.append("native")
+    timings = {}
+    sl = Xb[: 1 << 13]
+    for strat in candidates:
+        try:
+            score_matrix(ext_model.forest, sl, ext_model.num_samples, strategy=strat)
+            start = time.perf_counter()
+            score_matrix(ext_model.forest, sl, ext_model.num_samples, strategy=strat)
+            timings[strat] = round(time.perf_counter() - start, 4)
+        except Exception as exc:
+            print(f"[bench] EIF strategy {strat} unavailable: {exc}", file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "eif_strategy_timings_8k_100trees",
+                "value": min(timings.values()) if timings else -1,
+                "unit": "s",
+                "timings": timings,
+                "winner": min(timings, key=timings.get) if timings else None,
+                "backend": platform,
+            }
+        )
+    )
+
 
 if __name__ == "__main__":
     if "--full" in sys.argv:
