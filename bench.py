@@ -43,14 +43,10 @@ def auroc(scores: np.ndarray, labels: np.ndarray) -> float:
     return float((ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0))
 
 
-def _pick_strategy(model, X: np.ndarray) -> str:
-    """Auto-tune the traversal strategy on the live backend: time each
-    candidate on a slice and pin the winner via ISOFOREST_TPU_STRATEGY."""
-    import os
-
+def _strategy_candidates() -> list:
+    """Backend-appropriate scoring strategies — the single source both the
+    headline auto-tune and the --full EIF ranking use."""
     import jax
-
-    from isoforest_tpu.ops.traversal import score_matrix
 
     candidates = ["gather", "dense"]
     if jax.devices()[0].platform == "tpu":
@@ -60,9 +56,15 @@ def _pick_strategy(model, X: np.ndarray) -> str:
 
         if native.available():
             candidates.append("native")
-    sl = X[: 1 << 17]
+    return candidates
+
+
+def _time_strategies(model, sl: np.ndarray) -> dict:
+    """Warm-up-then-time each candidate on a slice; {strategy: seconds}."""
+    from isoforest_tpu.ops.traversal import score_matrix
+
     timings = {}
-    for strat in candidates:
+    for strat in _strategy_candidates():
         try:
             score_matrix(model.forest, sl, model.num_samples, strategy=strat)  # compile
             start = time.perf_counter()
@@ -70,6 +72,15 @@ def _pick_strategy(model, X: np.ndarray) -> str:
             timings[strat] = time.perf_counter() - start
         except Exception as exc:
             print(f"[bench] strategy {strat} unavailable: {exc}", file=sys.stderr)
+    return timings
+
+
+def _pick_strategy(model, X: np.ndarray) -> str:
+    """Auto-tune the traversal strategy on the live backend: time each
+    candidate on a slice and pin the winner via ISOFOREST_TPU_STRATEGY."""
+    import os
+
+    timings = _time_strategies(model, X[: 1 << 17])
     if not timings:
         print("[bench] all strategies failed to time; defaulting to gather", file=sys.stderr)
         os.environ["ISOFOREST_TPU_STRATEGY"] = "gather"
@@ -329,28 +340,10 @@ def full_sweep() -> None:
     # extended dispatch extrapolation holds on this backend)
     import jax
 
-    from isoforest_tpu.ops.traversal import score_matrix
-
     ext_model = ExtendedIsolationForest(num_estimators=100).fit(Xb)
-    candidates = ["gather", "dense"]
-    platform = jax.devices()[0].platform
-    if platform == "tpu":
-        candidates.append("pallas")
-    else:
-        from isoforest_tpu import native
-
-        if native.available():
-            candidates.append("native")
-    timings = {}
-    sl = Xb[: 1 << 13]
-    for strat in candidates:
-        try:
-            score_matrix(ext_model.forest, sl, ext_model.num_samples, strategy=strat)
-            start = time.perf_counter()
-            score_matrix(ext_model.forest, sl, ext_model.num_samples, strategy=strat)
-            timings[strat] = round(time.perf_counter() - start, 4)
-        except Exception as exc:
-            print(f"[bench] EIF strategy {strat} unavailable: {exc}", file=sys.stderr)
+    timings = {
+        k: round(v, 4) for k, v in _time_strategies(ext_model, Xb[: 1 << 13]).items()
+    }
     print(
         json.dumps(
             {
@@ -359,7 +352,7 @@ def full_sweep() -> None:
                 "unit": "s",
                 "timings": timings,
                 "winner": min(timings, key=timings.get) if timings else None,
-                "backend": platform,
+                "backend": jax.devices()[0].platform,
             }
         )
     )

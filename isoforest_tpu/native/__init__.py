@@ -9,6 +9,7 @@ degrades gracefully to the portable path.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import pathlib
 import subprocess
@@ -19,10 +20,20 @@ import numpy as np
 
 _HERE = pathlib.Path(__file__).parent
 _SRCS = (_HERE / "isoforest_io.cpp", _HERE / "scorer.cpp", _HERE / "encoder.cpp")
-# Versioned output name: dlopen dedupes by pathname within a process, so a
-# stale cached .so CANNOT be fixed by rebuilding to the same path — bump the
-# version whenever the exported C symbol set changes.
-_SO = _HERE / "_isoforest_native_v3.so"
+
+
+def _source_digest() -> str:
+    h = hashlib.sha256()
+    for src in _SRCS:
+        h.update(src.read_bytes())
+    return h.hexdigest()[:12]
+
+
+# Output name derived from the source contents: dlopen dedupes by pathname
+# within a process, and get_library() trusts an existing file — so ANY source
+# change (not just the symbol set) must land at a fresh path or hosts with a
+# cached .so silently keep executing the old kernel.
+_SO = _HERE / f"_isoforest_native_{_source_digest()}.so"
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -49,6 +60,12 @@ def _build() -> Optional[ctypes.CDLL]:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except Exception:
         return None
+    for stale in _HERE.glob("_isoforest_native_*.so"):
+        if stale != _SO:
+            try:
+                stale.unlink()
+            except OSError:
+                pass
     return ctypes.CDLL(str(_SO))
 
 
