@@ -116,6 +116,23 @@ class TestAutoStrategy:
             np.testing.assert_allclose(got, base, atol=3e-6)
 
 
+class TestNativeTiledPath:
+    def test_large_forest_tiles_match_gather(self):
+        # 200 trees x 511 slots ~ 1.2 MB of tables exceeds the walker's
+        # 768 KB tile budget, so this exercises the tiled accumulator path;
+        # summation order is preserved, so parity tolerance is unchanged
+        import isoforest_tpu.native as native
+
+        if not native.available():
+            pytest.skip("native scorer unavailable")
+        rng = np.random.default_rng(11)
+        X = rng.normal(size=(2000, 5)).astype(np.float32)
+        model = IsolationForest(num_estimators=200, max_samples=128.0).fit(X)
+        got = score_matrix(model.forest, X, model.num_samples, strategy="native")
+        base = score_matrix(model.forest, X, model.num_samples, strategy="gather")
+        np.testing.assert_allclose(got, base, atol=3e-6)
+
+
 class TestPallasTpuLowering:
     """Cross-platform lowering to TPU runs the Pallas->Mosaic pass on CPU and
     catches block-shape/layout violations (the round-1 kernels failed exactly
