@@ -1,44 +1,15 @@
 """Worker script for the multi-host distributed test (spawned by
 tests/test_multihost.py): 2 processes x 4 virtual CPU devices = one 8-device
-global mesh over DCN(Gloo), running the fused distributed train step. The
-TPU-pod analogue is identical code with real hosts/ICI
-(parallel/mesh.py::initialize_distributed)."""
+global mesh over DCN(Gloo) collectives, running the fused distributed train
+step. The TPU-pod analogue is identical code with real hosts/ICI
+(parallel/mesh.py::initialize_distributed).
 
-import os
-import sys
+Importable for :data:`STEP_KWARGS` (the single source of the step config the
+host test must mirror); the distributed body only runs as ``__main__``.
+"""
 
-proc_id = int(sys.argv[1])
-nprocs = int(sys.argv[2])
-port = sys.argv[3]
-out_path = sys.argv[4]
-
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-jax.distributed.initialize(
-    coordinator_address=f"127.0.0.1:{port}",
-    num_processes=nprocs,
-    process_id=proc_id,
-)
-
-import numpy as np
-from jax.experimental import multihost_utils
-from jax.sharding import Mesh
-
-from isoforest_tpu.parallel import make_train_step
-from isoforest_tpu.parallel.mesh import DATA_AXIS, TREES_AXIS
-
-devices = jax.devices()
-assert len(devices) == 4 * nprocs, f"expected {4 * nprocs} global devices"
-mesh = Mesh(np.asarray(devices).reshape(2, 2 * nprocs), (DATA_AXIS, TREES_AXIS))
-
-rng = np.random.default_rng(0)
-X = rng.normal(size=(512, 4)).astype(np.float32)
-X[:8] += 6.0
-
-step = make_train_step(
-    mesh,
+# single source for the step config — the host test mirrors these exactly
+STEP_KWARGS = dict(
     num_rows=512,
     num_features_total=4,
     num_trees=16,
@@ -46,10 +17,68 @@ step = make_train_step(
     num_features=4,
     contamination=0.05,
 )
-result = step(jax.random.PRNGKey(0), X)
-scores = np.asarray(multihost_utils.process_allgather(result.scores, tiled=True))
-threshold = float(result.threshold)
 
-if proc_id == 0:
-    np.savez(out_path, scores=scores, threshold=threshold)
-    print(f"multihost worker 0: scores {scores.shape} threshold {threshold:.4f}", flush=True)
+
+def main() -> None:
+    import os
+    import sys
+
+    proc_id = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    port = sys.argv[3]
+    out_path = sys.argv[4]
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nprocs,
+        process_id=proc_id,
+    )
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh
+
+    from isoforest_tpu.parallel import make_train_step
+    from isoforest_tpu.parallel.mesh import DATA_AXIS, TREES_AXIS
+
+    devices = jax.devices()
+    assert len(devices) == 4 * nprocs, f"expected {4 * nprocs} global devices"
+    mesh = Mesh(np.asarray(devices).reshape(2, 2 * nprocs), (DATA_AXIS, TREES_AXIS))
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(512, 4)).astype(np.float32)
+    X[:8] += 6.0
+
+    step = make_train_step(mesh, **STEP_KWARGS)
+    result = step(jax.random.PRNGKey(0), X)
+    scores = np.asarray(multihost_utils.process_allgather(result.scores, tiled=True))
+    threshold = float(result.threshold)
+
+    # second step with an error budget: the threshold comes from the
+    # psum-able refined-histogram sketch, whose collectives here cross a
+    # REAL process boundary over Gloo — the multi-host approxQuantile
+    # replacement end to end
+    step_sketch = make_train_step(mesh, **STEP_KWARGS, contamination_error=0.02)
+    result_sketch = step_sketch(jax.random.PRNGKey(0), X)
+    threshold_sketch = float(result_sketch.threshold)
+
+    if proc_id == 0:
+        np.savez(
+            out_path,
+            scores=scores,
+            threshold=threshold,
+            threshold_sketch=threshold_sketch,
+        )
+        print(
+            f"multihost worker 0: scores {scores.shape} threshold "
+            f"{threshold:.4f} sketch {threshold_sketch:.4f}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

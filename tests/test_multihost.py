@@ -62,19 +62,23 @@ def test_two_process_train_step_matches_single_process(tmp_path):
     rng = np.random.default_rng(0)
     X = rng.normal(size=(512, 4)).astype(np.float32)
     X[:8] += 6.0
+    from multihost_worker import STEP_KWARGS  # config only; body is __main__
+
     mesh = create_mesh(devices=jax.devices())
-    step = make_train_step(
-        mesh,
-        num_rows=512,
-        num_features_total=4,
-        num_trees=16,
-        num_samples=64,
-        num_features=4,
-        contamination=0.05,
-    )
+    step = make_train_step(mesh, **STEP_KWARGS)
     local = step(jax.random.PRNGKey(0), X)
 
     np.testing.assert_allclose(
         dist["scores"], np.asarray(local.scores), rtol=1e-6, atol=1e-6
     )
     assert float(dist["threshold"]) == pytest.approx(float(local.threshold), abs=1e-6)
+
+    # sketch threshold (contamination_error > 0): the distributed
+    # refined-histogram result must match the same step run locally, and its
+    # element-of-scores contract must hold against the DISTRIBUTED scores
+    # (local scores only match to 1e-6, not bitwise)
+    step_sketch = make_train_step(mesh, **STEP_KWARGS, contamination_error=0.02)
+    local_sketch = step_sketch(jax.random.PRNGKey(0), X)
+    thr_sketch = float(dist["threshold_sketch"])
+    assert thr_sketch == pytest.approx(float(local_sketch.threshold), abs=1e-6)
+    assert np.float32(thr_sketch) in np.asarray(dist["scores"], np.float32)
