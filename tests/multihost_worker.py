@@ -65,6 +65,12 @@ def main() -> None:
     step_sketch = make_train_step(mesh, **STEP_KWARGS, contamination_error=0.02)
     result_sketch = step_sketch(jax.random.PRNGKey(0), X)
     threshold_sketch = float(result_sketch.threshold)
+    # the element-of-scores contract holds against the SKETCH program's own
+    # scores (a separately compiled program may differ from the first step's
+    # scores by a ulp)
+    scores_sketch = np.asarray(
+        multihost_utils.process_allgather(result_sketch.scores, tiled=True)
+    )
 
     if proc_id == 0:
         np.savez(
@@ -72,6 +78,7 @@ def main() -> None:
             scores=scores,
             threshold=threshold,
             threshold_sketch=threshold_sketch,
+            scores_sketch=scores_sketch,
         )
         print(
             f"multihost worker 0: scores {scores.shape} threshold "
