@@ -64,6 +64,9 @@ def histogram_quantile(
     always an element of the input. This is the eager/host-driven variant
     (Python loop, host scalars) — it cannot run under jit/shard_map; use
     :func:`histogram_quantile_jit` inside compiled or distributed programs.
+
+    Limitation: subnormal inputs may flush to zero (XLA FTZ); anomaly
+    scores live in (0, 1] and are never subnormal.
     """
     scores = jnp.asarray(scores, jnp.float32)
     n = scores.shape[0]
@@ -79,6 +82,11 @@ def histogram_quantile(
             break
         rel = jnp.floor((scores - lo) / width * num_bins)
         bins = jnp.clip(rel, -1, num_bins).astype(jnp.int32)
+        # the last bin is right-CLOSED: scores equal to the current hi must
+        # land inside the histogram (q=1.0 would otherwise chase a maximum
+        # that every pass pushes into the overflow bucket and return a
+        # lower-ranked element — caught by the property fuzz)
+        bins = jnp.where(scores == hi, num_bins - 1, bins)
         # slot 0 counts scores strictly below lo; one scatter, one transfer
         all_counts = np.asarray(
             jnp.zeros((num_bins + 2,), jnp.int32).at[bins + 1].add(1)
@@ -86,7 +94,11 @@ def histogram_quantile(
         counts = all_counts[1 : num_bins + 1]
         cum = all_counts[0] + np.cumsum(counts)
         idx = min(int(np.searchsorted(cum, target)), num_bins - 1)
-        lo, hi = lo + idx * width / num_bins, lo + (idx + 1) * width / num_bins
+        # the top bin's right edge is exactly hi: recomputing it as
+        # lo + width re-rounds in float and can EXCLUDE the true maximum
+        # (e.g. hi=1 with lo=-2^53 gives lo + width == 0) — fuzz-caught
+        new_hi = hi if idx == num_bins - 1 else lo + (idx + 1) * width / num_bins
+        lo, hi = lo + idx * width / num_bins, new_hi
         # Adaptive stop: once the target bin holds <= eps*N elements every
         # element in it satisfies the rank budget; the float-resolution check
         # stops tie-heavy bins that can never thin out (rank error 0 there).
@@ -147,13 +159,21 @@ def histogram_quantile_jit(
         width = jnp.maximum(hi_c - lo_c, jnp.float32(np.finfo(np.float32).tiny))
         rel = jnp.floor((scores - lo_c) / width * num_bins)
         bins = jnp.clip(rel, -1, num_bins).astype(jnp.int32)
+        # right-closed last bin: see the eager variant (q=1.0 edge)
+        bins = jnp.where(scores == hi_c, num_bins - 1, bins)
         counts = jnp.zeros((num_bins + 2,), jnp.int32).at[bins + 1].add(1)
         cum = counts[0] + jnp.cumsum(counts[1 : num_bins + 1])
         idx = jnp.clip(jnp.searchsorted(cum, target), 0, num_bins - 1)
         idx_f = idx.astype(jnp.float32)
+        # top bin keeps its exact right edge (see the eager variant)
+        new_hi = jnp.where(
+            idx == num_bins - 1,
+            hi_c,
+            lo_c + (idx_f + 1.0) * width / num_bins,
+        )
         return (
             lo_c + idx_f * width / num_bins,
-            lo_c + (idx_f + 1.0) * width / num_bins,
+            new_hi,
             counts[idx + 1],
             passes + 1,
         )

@@ -212,6 +212,7 @@ class TestQuantileContractProperties:
                 min_value=np.float32(-1e30),
                 max_value=np.float32(1e30),
                 allow_nan=False,
+                allow_subnormal=False,  # XLA flushes denormals to zero
                 width=32,
             ),
             min_size=1,
