@@ -243,7 +243,9 @@ class IsolationForestModel:
 
         Warm with the SAME configuration the serving path will use: the
         default ``strategy="auto"`` resolves identically here and in
-        :meth:`score` (env var / gather), and pass ``mesh`` if serving scores
+        :meth:`score` (env var, else the per-platform default — the native
+        C++ walker on CPU, whose per-forest prep this warms instead of an
+        XLA program; dense on TPU), and pass ``mesh`` if serving scores
         through a mesh (the sharded program is compiled separately). Batch
         sizes dedupe to their power-of-two buckets, matching
         :func:`~isoforest_tpu.ops.traversal.score_matrix` bucketing. Legacy

@@ -154,8 +154,8 @@ def _parse_attribute(data: bytes) -> Tuple[str, Any, int]:
     return name, by_type[atype], atype
 
 
-# TensorProto: dims=1 data_type=2 float_data=4 int32_data=5 string_data=6
-# int64_data=7 name=8 raw_data=9
+# TensorProto: dims=1 data_type=2 float_data=4 int32_data=5 int64_data=7
+# name=8 raw_data=9
 _TENSOR_DTYPES = {1: np.float32, 6: np.int32, 7: np.int64, 9: np.bool_, 11: np.float64}
 _VALID_ELEM_TYPES = set(range(1, 17))
 
@@ -165,7 +165,7 @@ def _parse_tensor(data: bytes) -> Tuple[str, np.ndarray]:
     dtype = None
     raw = None
     floats: List[float] = []
-    int64s: List[int] = []
+    ints: List[int] = []
     name = ""
     for field, wire, val in _fields(data):
         if field == 1:  # dims: packed (proto3) or unpacked varints
@@ -180,11 +180,11 @@ def _parse_tensor(data: bytes) -> Tuple[str, np.ndarray]:
                 floats.extend(struct.unpack(f"<{len(val) // 4}f", val))
             else:
                 floats.append(struct.unpack("<f", val)[0])
-        elif field == 7:
+        elif field in (5, 7):  # int32_data / int64_data, packed or not
             if wire == 2:
-                int64s.extend(_packed_varints(val))
+                ints.extend(_packed_varints(val))
             else:
-                int64s.append(_signed(val))
+                ints.append(_signed(val))
         elif field == 8:
             name = val.decode()
         elif field == 9:
@@ -197,7 +197,7 @@ def _parse_tensor(data: bytes) -> Tuple[str, np.ndarray]:
     elif floats:
         arr = np.asarray(floats, np_dtype)
     else:
-        arr = np.asarray(int64s, np_dtype)
+        arr = np.asarray(ints, np_dtype)
     want = int(np.prod(dims)) if dims else arr.size
     if arr.size != want:
         raise CheckError(
@@ -246,7 +246,17 @@ def _parse_node(data: bytes) -> dict:
 def parse_model_independent(model_bytes: bytes) -> dict:
     """ModelProto: ir_version=1 graph=7 opset_import=8;
     GraphProto: node=1 name=2 initializer=5 input=11 output=12;
-    OperatorSetIdProto: domain=1 version=2."""
+    OperatorSetIdProto: domain=1 version=2.
+
+    Truncated/corrupt bytes raise :class:`CheckError` (the wire readers hit
+    IndexError/struct.error; callers rely on one structured exception)."""
+    try:
+        return _parse_model_inner(model_bytes)
+    except (IndexError, struct.error, UnicodeDecodeError) as e:
+        raise CheckError(f"truncated or corrupt model bytes: {e}") from e
+
+
+def _parse_model_inner(model_bytes: bytes) -> dict:
     model = {"ir_version": None, "opsets": {}, "graph": None}
     for field, _, val in _fields(model_bytes):
         if field == 1:
@@ -314,6 +324,8 @@ _CORE_OPS = {
 
 
 def _check_tree_ensemble(attrs: dict) -> None:
+    """Vectorised: the pure-Python loop form cost seconds at 1000-tree
+    (~500k-node) scale, the very scale the native save path exists for."""
     node_arrays = [
         "nodes_treeids",
         "nodes_nodeids",
@@ -332,30 +344,41 @@ def _check_tree_ensemble(attrs: dict) -> None:
         raise CheckError(
             f"TreeEnsembleRegressor nodes_* arrays disagree in length: {lengths}"
         )
-    modes = attrs["nodes_modes"]
-    bad_modes = set(modes) - _BRANCH_MODES
+    modes = np.asarray(attrs["nodes_modes"])
+    bad_modes = set(np.unique(modes)) - _BRANCH_MODES
     if bad_modes:
         raise CheckError(f"invalid nodes_modes values {bad_modes}")
-    keys = list(zip(attrs["nodes_treeids"], attrs["nodes_nodeids"]))
-    key_set = set(keys)
-    if len(key_set) != len(keys):
+    tids = np.asarray(attrs["nodes_treeids"], np.int64)
+    nids = np.asarray(attrs["nodes_nodeids"], np.int64)
+    true_ids = np.asarray(attrs["nodes_truenodeids"], np.int64)
+    false_ids = np.asarray(attrs["nodes_falsenodeids"], np.int64)
+    fids = np.asarray(attrs["nodes_featureids"], np.int64)
+    if fids.size and fids.min() < 0:
+        raise CheckError(f"negative nodes_featureids entry {fids.min()}")
+    # pack (treeid, nodeid) into one sortable key for set-free membership
+    base = max(int(nids.max(initial=0)), int(true_ids.max(initial=0)),
+               int(false_ids.max(initial=0))) + 2
+    keys = tids * base + nids
+    sorted_keys = np.sort(keys)
+    if sorted_keys.size > 1 and (np.diff(sorted_keys) == 0).any():
         raise CheckError("duplicate (treeid, nodeid) pairs in node table")
-    for tid, nid, mode, true_id, false_id in zip(
-        attrs["nodes_treeids"],
-        attrs["nodes_nodeids"],
-        modes,
-        attrs["nodes_truenodeids"],
-        attrs["nodes_falsenodeids"],
-    ):
-        if mode != "LEAF":
-            if (tid, true_id) not in key_set or (tid, false_id) not in key_set:
-                raise CheckError(
-                    f"node ({tid},{nid}) branches to nonexistent child "
-                    f"({true_id}/{false_id})"
-                )
-    for fid in attrs["nodes_featureids"]:
-        if fid < 0:
-            raise CheckError(f"negative nodes_featureids entry {fid}")
+
+    def _member(t, n):
+        pos = np.searchsorted(sorted_keys, t * base + n)
+        pos = np.clip(pos, 0, sorted_keys.size - 1)
+        return sorted_keys[pos] == t * base + n
+
+    internal = modes != "LEAF"
+    ok_true = _member(tids[internal], true_ids[internal])
+    ok_false = _member(tids[internal], false_ids[internal])
+    if not (ok_true.all() and ok_false.all()):
+        bad = np.nonzero(~(ok_true & ok_false))[0][0]
+        t_bad = tids[internal][bad]
+        n_bad = nids[internal][bad]
+        raise CheckError(
+            f"node ({t_bad},{n_bad}) branches to nonexistent child "
+            f"({true_ids[internal][bad]}/{false_ids[internal][bad]})"
+        )
     target_arrays = ["target_treeids", "target_nodeids", "target_ids", "target_weights"]
     t_lengths = set()
     for key in target_arrays:
@@ -367,54 +390,75 @@ def _check_tree_ensemble(attrs: dict) -> None:
             f"TreeEnsembleRegressor target_* arrays disagree in length: {t_lengths}"
         )
     n_targets = attrs["n_targets"]
-    for t_id in attrs["target_ids"]:
-        if not 0 <= t_id < n_targets:
-            raise CheckError(f"target_ids entry {t_id} outside [0, {n_targets})")
-    for tid, nid in zip(attrs["target_treeids"], attrs["target_nodeids"]):
-        if (tid, nid) not in key_set:
-            raise CheckError(f"target references nonexistent node ({tid},{nid})")
+    t_ids = np.asarray(attrs["target_ids"], np.int64)
+    if t_ids.size and (t_ids.min() < 0 or t_ids.max() >= n_targets):
+        raise CheckError(f"target_ids entries outside [0, {n_targets})")
+    tt = np.asarray(attrs["target_treeids"], np.int64)
+    tn = np.asarray(attrs["target_nodeids"], np.int64)
+    ok_t = _member(tt, tn)
+    if not ok_t.all():
+        bad = np.nonzero(~ok_t)[0][0]
+        raise CheckError(f"target references nonexistent node ({tt[bad]},{tn[bad]})")
     agg = attrs.get("aggregate_function", "SUM")
     if agg not in _AGG_FUNCS:
         raise CheckError(f"invalid aggregate_function {agg!r}")
     post = attrs.get("post_transform", "NONE")
     if post not in _POST_TRANSFORMS:
         raise CheckError(f"invalid post_transform {post!r}")
-    # acyclicity + reachability: every tree must be a rooted binary tree, not
-    # merely have in-range child ids — a back-edge would make any evaluator's
-    # walk diverge (the model loader already rejects cyclic node tables;
-    # the export gate must be at least as strict)
-    children: Dict[int, Dict[int, Tuple[int, int]]] = {}
-    for tid, nid, mode, true_id, false_id in zip(
-        attrs["nodes_treeids"],
-        attrs["nodes_nodeids"],
-        modes,
-        attrs["nodes_truenodeids"],
-        attrs["nodes_falsenodeids"],
-    ):
-        children.setdefault(tid, {})[nid] = (
-            (true_id, false_id) if mode != "LEAF" else None
+    _check_acyclic_reachable(tids, nids, internal, true_ids, false_ids, base,
+                             keys, sorted_keys)
+
+
+def _check_acyclic_reachable(tids, nids, internal, true_ids, false_ids, base,
+                             keys, sorted_keys) -> None:
+    """Acyclicity + reachability: every tree must be a rooted binary tree,
+    not merely have in-range child ids — a back-edge would make any
+    evaluator's walk diverge (the model loader already rejects cyclic node
+    tables; the export gate must be at least as strict). Vectorised BFS over
+    ALL trees simultaneously: each wave resolves child positions with one
+    searchsorted; bounded by the node count."""
+    n = keys.size
+    order = np.argsort(keys)
+    # per-node child POSITIONS (into the node arrays), -1 for leaves
+    def _pos(t, child):
+        p = np.searchsorted(sorted_keys, t * base + child)
+        p = np.clip(p, 0, n - 1)
+        return order[p]  # membership already validated
+
+    true_pos = np.full(n, -1, np.int64)
+    false_pos = np.full(n, -1, np.int64)
+    idx_internal = np.nonzero(internal)[0]
+    true_pos[idx_internal] = _pos(tids[idx_internal], true_ids[idx_internal])
+    false_pos[idx_internal] = _pos(tids[idx_internal], false_ids[idx_internal])
+
+    roots_mask = nids == 0
+    tree_ids = np.unique(tids)
+    if roots_mask.sum() != tree_ids.size:
+        missing = set(tree_ids) - set(tids[roots_mask])
+        raise CheckError(f"tree(s) {sorted(missing)[:5]} have no root node 0")
+    visits = np.zeros(n, np.int64)
+    frontier = np.nonzero(roots_mask)[0]
+    waves = 0
+    while frontier.size:
+        waves += 1
+        if waves > n + 1:
+            raise CheckError("cyclic node table (BFS exceeded node count)")
+        np.add.at(visits, frontier, 1)
+        fresh = frontier[visits[frontier] == 1]  # expand first visits only
+        kids = np.concatenate([true_pos[fresh], false_pos[fresh]])
+        frontier = kids[kids >= 0]
+    if (visits > 1).any():
+        bad = np.nonzero(visits > 1)[0][0]
+        raise CheckError(
+            f"tree {tids[bad]}: node {nids[bad]} reached twice — cyclic or "
+            "converging node table"
         )
-    for tid, table in children.items():
-        if 0 not in table:
-            raise CheckError(f"tree {tid} has no root node 0")
-        seen = set()
-        stack = [0]
-        while stack:
-            nid = stack.pop()
-            if nid in seen:
-                raise CheckError(
-                    f"tree {tid}: node {nid} reached twice — cyclic or "
-                    "converging node table"
-                )
-            seen.add(nid)
-            kids = table[nid]
-            if kids is not None:
-                stack.extend(kids)
-        if len(seen) != len(table):
-            raise CheckError(
-                f"tree {tid}: {len(table) - len(seen)} node(s) unreachable "
-                "from the root"
-            )
+    if (visits == 0).any():
+        bad = np.nonzero(visits == 0)[0]
+        raise CheckError(
+            f"{bad.size} node(s) unreachable from their roots "
+            f"(first: tree {tids[bad[0]]} node {nids[bad[0]]})"
+        )
 
 
 def check_model(model_bytes: bytes) -> dict:

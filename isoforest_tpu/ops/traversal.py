@@ -95,14 +95,15 @@ def path_lengths(forest, X: jax.Array) -> jax.Array:
     return extended_path_lengths(forest, X)
 
 
-# Measured per-backend winners for strategy="auto". CPU: the hand-scheduled
-# C++ walker beats the XLA gather path ~4x single-core, which itself beats
-# dense ~50x (benchmarks/README.md). TPU: per-lane gathers serialise in
-# the XLA lowering while the dense level-walk is full-width VPU/MXU work
-# (docs/DESIGN.md §3) — dense is the design-predicted winner, pinned here so
-# serving code gets the right kernel without running bench.py first;
-# re-pinned from hardware measurement whenever bench.py runs on a live TPU
-# (it writes the measured winner via ISOFOREST_TPU_STRATEGY or this table).
+# Per-backend winners for strategy="auto". CPU (measured): the
+# hand-scheduled C++ walker beats the XLA gather path ~4x single-core,
+# which itself beats dense ~50x (benchmarks/README.md). TPU (design
+# prediction — no hardware measurement exists yet, ROADMAP.md item 1):
+# per-lane gathers serialise in the XLA lowering while the dense level-walk
+# is full-width VPU/MXU work (docs/DESIGN.md §3). bench.py measures the
+# ranking on whatever backend is live and pins its own process via
+# ISOFOREST_TPU_STRATEGY; updating THIS table for other processes is a
+# source edit, to be made when a real TPU measurement lands.
 PLATFORM_DEFAULT_STRATEGY = {
     "cpu": "native",
     "tpu": "dense",

@@ -120,6 +120,14 @@ class TestCheckerRejects:
     def test_valid_baseline(self):
         check_model(_tiny_valid_graph())
 
+    def test_truncated_bytes_raise_checkerror(self):
+        # corrupt input must surface as the structured CheckError, not a
+        # raw IndexError/struct.error from the wire readers
+        bts = _tiny_valid_graph()
+        for cut in (len(bts) - 1, len(bts) // 2, 3):
+            with pytest.raises(CheckError):
+                check_model(bts[:cut])
+
     def test_missing_opset(self):
         with pytest.raises(CheckError, match="not in opset_import"):
             check_model(_tiny_valid_graph(opsets=[("", 14)]))
