@@ -1,3 +1,4 @@
+from .checker import CheckError, check_model, reference_scores
 from .converter import (
     ExtendedIsolationForestConverter,
     IsolationForestConverter,
@@ -6,9 +7,12 @@ from .converter import (
 from . import proto, runtime
 
 __all__ = [
+    "CheckError",
     "ExtendedIsolationForestConverter",
     "IsolationForestConverter",
+    "check_model",
     "convert_and_save",
     "proto",
+    "reference_scores",
     "runtime",
 ]
