@@ -91,20 +91,29 @@ def _signed(v: int) -> int:
 
 
 def _packed_varints(data: bytes) -> List[int]:
-    out = []
-    pos = 0
-    while pos < len(data):
-        val = 0
-        shift = 0
-        while True:
-            b = data[pos]
-            pos += 1
-            val |= (b & 0x7F) << shift
-            shift += 7
-            if not b & 0x80:
-                break
-        out.append(_signed(val))
-    return out
+    """Vectorised packed-varint decode (profile hotspot at 500k-element
+    TreeEnsembleRegressor attribute arrays). Strictly 64-bit: payload bits
+    beyond 64 wrap, and varints longer than the protobuf maximum of 10
+    bytes raise :class:`CheckError` (a checker SHOULD reject them; the
+    earlier scalar loop permissively decoded unbounded varints)."""
+    b = np.frombuffer(data, np.uint8)
+    if b.size == 0:
+        return []
+    term = (b & 0x80) == 0
+    if not term[-1]:
+        raise CheckError("truncated varint in packed field")
+    gid = np.zeros(b.size, np.int64)
+    gid[1:] = np.cumsum(term.astype(np.int64))[:-1]
+    starts = np.zeros(int(term.sum()), np.int64)
+    starts[1:] = np.nonzero(term)[0][:-1] + 1
+    pos = np.arange(b.size, dtype=np.int64) - starts[gid]
+    if int(pos.max()) > 9:
+        raise CheckError("varint longer than 10 bytes in packed field")
+    vals = np.zeros(starts.size, np.uint64)
+    np.bitwise_or.at(
+        vals, gid, (b & np.uint8(0x7F)).astype(np.uint64) << (7 * pos).astype(np.uint64)
+    )
+    return vals.view(np.int64).tolist()  # two's-complement reinterpret
 
 
 # AttributeProto (onnx.proto): name=1 f=2 i=3 s=4 t=5 floats=7 ints=8

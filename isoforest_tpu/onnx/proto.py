@@ -78,8 +78,45 @@ def field_packed_floats(field: int, values) -> bytes:
     return field_bytes(field, struct.pack(f"<{len(values)}f", *values))
 
 
+def encode_varints(values) -> bytes:
+    """Batch protobuf varint encoding (numpy): byte-identical to
+    ``b"".join(_varint(v))`` for any sequence of **int64-range** values —
+    ~100x faster at the 500k-element attribute arrays a 1000-tree
+    TreeEnsembleRegressor carries. Negatives take the 64-bit
+    two's-complement (10-byte) form, same as :func:`_varint`. Narrower
+    domain than the scalar form: requires a sized sequence (not a bare
+    generator) of values in int64 range — protobuf ints are 64-bit, so
+    every legal attribute value qualifies."""
+    import numpy as np
+
+    u = np.asarray(values, dtype=np.int64).astype(np.uint64)
+    if u.size == 0:
+        return b""
+    # bytes per value: ceil(bitlength/7), min 1 (10 for negatives)
+    nbytes = np.ones(u.size, np.int64)
+    shifted = u >> np.uint64(7)
+    while shifted.any():
+        nbytes += (shifted > 0).astype(np.int64)
+        shifted >>= np.uint64(7)
+    offsets = np.zeros(u.size, np.int64)
+    np.cumsum(nbytes[:-1], out=offsets[1:])
+    total = int(offsets[-1] + nbytes[-1])
+    out = np.zeros(total, np.uint8)
+    for pos in range(10):
+        active = nbytes > pos
+        if not active.any():
+            break
+        idx = offsets[active] + pos
+        byte = ((u[active] >> np.uint64(7 * pos)) & np.uint64(0x7F)).astype(
+            np.uint8
+        )
+        cont = (nbytes[active] - 1 > pos).astype(np.uint8) << 7
+        out[idx] = byte | cont
+    return out.tobytes()
+
+
 def field_packed_varints(field: int, values) -> bytes:
-    return field_bytes(field, b"".join(_varint(int(v)) for v in values))
+    return field_bytes(field, encode_varints(values))
 
 
 # --------------------------------------------------------------------------- #
@@ -103,8 +140,14 @@ def attribute(name: str, value) -> bytes:
         out += field_bytes(5, value)
         out += field_varint(20, ATTR_TENSOR)
     elif isinstance(value, (list, tuple)) and value and isinstance(value[0], str):
-        for s in value:
-            out += field_bytes(9, s.encode())
+        # memoised join: nodes_modes carries ~nodes strings drawn from a
+        # two-value alphabet (BRANCH_LT/LEAF); per-string encode was a
+        # profile hotspot at 1000-tree scale
+        enc: dict = {}
+        out += b"".join(
+            enc.get(s) or enc.setdefault(s, field_bytes(9, s.encode()))
+            for s in value
+        )
         out += field_varint(20, ATTR_STRINGS)
     elif isinstance(value, (list, tuple)) and value and isinstance(value[0], float):
         out += field_packed_floats(7, value)

@@ -320,3 +320,24 @@ class TestNativeEncoderProperties:
             assert nd["splitValue"] == value[i]
             assert nd["numInstances"] == ni[i]
         assert r.pos == len(body)
+
+
+class TestVarintCodecProperties:
+    @given(
+        values=st.lists(
+            st.integers(min_value=-(1 << 63), max_value=(1 << 63) - 1),
+            max_size=2000,
+        )
+    )
+    @_settings
+    def test_vector_encoder_matches_scalar_and_roundtrips(self, values):
+        """encode_varints must be byte-identical to the scalar _varint join
+        (the ONNX wire depends on it), and the checker's vectorised packed
+        decoder must invert it exactly, over the full int64 range."""
+        from isoforest_tpu.onnx.checker import _packed_varints
+        from isoforest_tpu.onnx.proto import _varint, encode_varints
+
+        ref = b"".join(_varint(int(v)) for v in values)
+        got = encode_varints(values)
+        assert got == ref
+        assert _packed_varints(got) == [int(v) for v in values]
