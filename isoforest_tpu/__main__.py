@@ -23,13 +23,19 @@ import sys
 import numpy as np
 
 
-def _load(path: str, labeled: bool):
-    data = np.loadtxt(path, delimiter=",", comments="#").astype(np.float32)
-    if data.ndim == 1:
-        data = data[:, None]
+def _parse_rows(lines_or_path, labeled: bool):
+    """One shared CSV parser for fit and score: rows are samples even for a
+    single-line file (``ndmin=2``)."""
+    data = np.loadtxt(lines_or_path, delimiter=",", comments="#", ndmin=2).astype(
+        np.float32
+    )
     if labeled:
         return data[:, :-1], data[:, -1]
     return data, None
+
+
+def _load(path: str, labeled: bool):
+    return _parse_rows(path, labeled)
 
 
 def _auroc(scores, labels) -> float:
@@ -83,19 +89,46 @@ def cmd_fit(args) -> int:
     return 0
 
 
+def _iter_csv_chunks(in_fh, labeled: bool, chunk_rows: int):
+    """Stream (X, y) chunks from an open CSV handle without materialising
+    the file — the CLI analogue of Spark scoring a Dataset partition by
+    partition."""
+    buf: list = []
+    for line in in_fh:
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        buf.append(line)
+        if len(buf) >= chunk_rows:
+            yield _parse_rows(buf, labeled)
+            buf = []
+    if buf:
+        yield _parse_rows(buf, labeled)
+
+
 def cmd_score(args) -> int:
     model = _load_model(args.model)
-    X, y = _load(args.input, args.labeled)
-    scores = model.score(X)
-    labels = model.predict(scores)
-    out = np.stack([scores, labels], axis=1)
     header = "outlierScore,predictedLabel"
-    if args.output == "-":
-        np.savetxt(sys.stdout, out, delimiter=",", header=header, comments="")
-    else:
-        np.savetxt(args.output, out, delimiter=",", header=header, comments="")
-    if y is not None:
-        print(json.dumps({"auroc": round(_auroc(scores, y), 4)}), file=sys.stderr)
+    # open (and thereby validate) the input BEFORE truncating the output —
+    # a missing input must not destroy a pre-existing results file
+    with open(args.input) as in_fh:
+        out_fh = sys.stdout if args.output == "-" else open(args.output, "w")
+        try:
+            out_fh.write(header + "\n")
+            all_scores, all_labels = [], []
+            for X, y in _iter_csv_chunks(in_fh, args.labeled, args.chunk_rows):
+                scores = model.score(X)
+                labels = model.predict(scores)
+                np.savetxt(out_fh, np.stack([scores, labels], axis=1), delimiter=",")
+                if y is not None:
+                    all_scores.append(scores)
+                    all_labels.append(y)
+        finally:
+            if out_fh is not sys.stdout:
+                out_fh.close()
+    if all_labels:
+        auroc = _auroc(np.concatenate(all_scores), np.concatenate(all_labels))
+        print(json.dumps({"auroc": round(auroc, 4)}), file=sys.stderr)
     return 0
 
 
@@ -159,6 +192,14 @@ def build_parser() -> argparse.ArgumentParser:
     score.add_argument("--input", required=True)
     score.add_argument("--output", default="-")
     score.add_argument("--labeled", action="store_true")
+    score.add_argument(
+        "--chunk-rows",
+        type=int,
+        default=1 << 20,
+        help="stream the input in chunks of this many rows — bounded memory "
+        "for arbitrarily large unlabeled files (--labeled accumulates "
+        "scores+labels for the final AUROC report)",
+    )
     score.set_defaults(func=cmd_score)
 
     conv = sub.add_parser("convert", help="export a saved model to ONNX")

@@ -60,6 +60,28 @@ class TestCli:
         assert info["numTrees"] == 20
         assert info["params"]["numEstimators"] == 20
 
+    def test_chunked_score_matches_unchunked(self, csv_file, tmp_path):
+        model_dir = str(tmp_path / "model")
+        assert main(
+            [
+                "fit", "--input", csv_file, "--labeled", "--output", model_dir,
+                "--num-estimators", "15",
+            ]
+        ) == 0
+        out_a = str(tmp_path / "a.csv")
+        out_b = str(tmp_path / "b.csv")
+        assert main(
+            ["score", "--model", model_dir, "--input", csv_file, "--labeled",
+             "--output", out_a]
+        ) == 0
+        assert main(
+            ["score", "--model", model_dir, "--input", csv_file, "--labeled",
+             "--output", out_b, "--chunk-rows", "300"]
+        ) == 0
+        a = np.loadtxt(out_a, delimiter=",", skiprows=1)
+        b = np.loadtxt(out_b, delimiter=",", skiprows=1)
+        np.testing.assert_array_equal(a, b)
+
     def test_inspect_tree_structure(self, csv_file, tmp_path, capsys):
         model_dir = str(tmp_path / "m2")
         main(["fit", "--input", csv_file, "--labeled", "--output", model_dir,
