@@ -110,10 +110,51 @@ def _standard_kernel(h, T, x_ref, feat_ref, thr_ref, leaf_ref, out_ref):
     out_ref[...] += pl_len[:, None] / T
 
 
-def _extended_kernel(h, T, x_ref, w_ref, off_ref, internal_ref, leaf_ref, out_ref):
+def _extended_kernel_sparse(
+    h, T, x_ref, idx_ref, w_ref, off_ref, internal_ref, leaf_ref, out_ref
+):
+    """EIF scoring from SPARSE hyperplane tables: densify in VMEM (k one-hot
+    accumulation passes, pure VPU) instead of materialising [T, M_pad, F_pad]
+    in HBM — at T=1000, F=274 the precomputed dense table cost ~786 MB; the
+    sparse tables are ~2k/F of that. Used when k is small (the common sparse
+    extension levels); large k dispatches to :func:`_extended_kernel_dense`
+    where the HBM table is no bigger than the sparse form anyway."""
     t = pl.program_id(1)
     x = x_ref[...]  # [C_blk, F_pad]
-    W = w_ref[0]  # block is [1, M_pad, F_pad] -> [M_pad, F_pad] hyperplanes
+    idx = idx_ref[0]  # [k, M_pad] sparse hyperplane coordinates (-1 pad)
+    w = w_ref[0]  # [k, M_pad]
+    f_pad = x.shape[1]
+    m_pad = idx.shape[1]
+    k = idx.shape[0]
+    # Padded coordinates (-1) match no iota row, contributing zero weight.
+    iota_f = jax.lax.broadcasted_iota(jnp.int32, (f_pad, m_pad), 0)
+    w_dense = jnp.zeros((f_pad, m_pad), jnp.float32)
+    for q in range(k):
+        sel = (iota_f == idx[q][None, :]).astype(jnp.float32)  # [F_pad, M_pad]
+        w_dense = w_dense + sel * w[q][None, :]
+    dots = jax.lax.dot_general(
+        x, w_dense, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [C_blk, M_pad] — MXU
+    B = (dots >= off_ref[0]).astype(jnp.float32)
+    internal = internal_ref[0] + jnp.zeros_like(dots)
+    pl_len = _walk_levels(B, internal, leaf_ref[0] + jnp.zeros_like(dots), h)
+
+    @pl.when(t == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += pl_len[:, None] / T
+
+
+def _extended_kernel_dense(
+    h, T, x_ref, w_ref, off_ref, internal_ref, leaf_ref, out_ref
+):
+    """EIF scoring from a precomputed dense [T, M_pad, F_pad] table — for
+    near-fully-extended forests, where sparse storage saves nothing and the
+    in-kernel densify would redo k~F one-hot passes per row block."""
+    t = pl.program_id(1)
+    x = x_ref[...]  # [C_blk, F_pad]
+    W = w_ref[0]  # [M_pad, F_pad]
     dots = jax.lax.dot_general(
         x, W, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # [C_blk, M_pad] — MXU
@@ -154,14 +195,50 @@ def _standard_pallas(X, feature_f32, threshold, leaf_value, h, interpret=False):
     )(X, feature_f32, threshold, leaf_value)[:, 0]
 
 
+# In-kernel densify beyond this many nonzero coordinates loses: the per-row-
+# block one-hot passes approach the matmul's own cost, and sparse storage
+# (2 * k entries/node) stops being smaller than the dense F_pad table.
+_SPARSE_K_MAX = 32
+
+
 @functools.partial(jax.jit, static_argnames=("h", "interpret"))
-def _extended_pallas(X, W_dense, offset, internal, leaf_value, h, interpret=False):
+def _extended_pallas_sparse(
+    X, indices, weights, offset, internal, leaf_value, h, interpret=False
+):
+    C, Fp = X.shape
+    T, _, Mp = offset.shape
+    k = indices.shape[1]
+    grid = (C // _ROW_BLOCK, T)
+    table = _vmem_spec((1, 1, Mp), lambda rb, t: (t, 0, 0))
+    # [1, k, Mp] blocks: minor dim lane-aligned, k rides the sublane axis
+    sparse = _vmem_spec((1, k, Mp), lambda rb, t: (t, 0, 0))
+    return pl.pallas_call(
+        functools.partial(_extended_kernel_sparse, h, T),
+        grid=grid,
+        in_specs=[
+            _vmem_spec((_ROW_BLOCK, Fp), lambda rb, t: (rb, 0)),
+            sparse,
+            sparse,
+            table,
+            table,
+            table,
+        ],
+        out_specs=_vmem_spec((_ROW_BLOCK, 1), lambda rb, t: (rb, 0)),
+        out_shape=jax.ShapeDtypeStruct((C, 1), jnp.float32),
+        interpret=interpret,
+    )(X, indices, weights, offset, internal, leaf_value)[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("h", "interpret"))
+def _extended_pallas_dense(
+    X, W_dense, offset, internal, leaf_value, h, interpret=False
+):
     C, Fp = X.shape
     T, _, Mp = offset.shape
     grid = (C // _ROW_BLOCK, T)
     table = _vmem_spec((1, 1, Mp), lambda rb, t: (t, 0, 0))
     return pl.pallas_call(
-        functools.partial(_extended_kernel, h, T),
+        functools.partial(_extended_kernel_dense, h, T),
         grid=grid,
         in_specs=[
             _vmem_spec((_ROW_BLOCK, Fp), lambda rb, t: (rb, 0)),
@@ -177,7 +254,8 @@ def _extended_pallas(X, W_dense, offset, internal, leaf_value, h, interpret=Fals
 
 
 # The forest is immutable once trained/loaded, but the kernel needs host-side
-# prep (leaf-value tables; densified hyperplanes for EIF — O(T*M*F)). Cache
+# prep (padded node tables, leaf values; sparse [T, k, M_pad] or — above
+# _SPARSE_K_MAX — dense [T, M_pad, F_pad] hyperplane tables for EIF). Cache
 # prep per forest, keyed by the identities of ALL its arrays (a _replace of
 # any single field must miss); holding strong references to the keyed arrays
 # prevents id() reuse. Bounded FIFO.
@@ -186,6 +264,8 @@ _PREP_CACHE_MAX = 8
 
 
 def _cached_prep(forest, build, extra_key=()):
+    """``extra_key`` distinguishes preps that depend on call-site statics
+    beyond the forest arrays (e.g. the dense EIF table's feature padding)."""
     arrays = tuple(forest)
     key = (tuple(id(a) for a in arrays), tuple(forest[0].shape), extra_key)
     hit = _PREP_CACHE.get(key)
@@ -196,6 +276,36 @@ def _cached_prep(forest, build, extra_key=()):
         _PREP_CACHE.pop(next(iter(_PREP_CACHE)))
     _PREP_CACHE[key] = (arrays, prep)
     return prep
+
+
+def sparse_hyperplane_tables(forest, m_pad: int):
+    """Node-axis-padded sparse hyperplane tables in the kernel layout
+    ``[T, k, m_pad]`` (coordinates -1, weights 0 at padding) — shared by the
+    production prep and the TPU-lowering tests so they cannot diverge."""
+    indices = np.asarray(forest.indices)
+    weights = np.asarray(forest.weights, np.float32)
+    t_n, m, k = indices.shape
+    idx_p = np.full((t_n, m_pad, k), -1, np.int32)
+    idx_p[:, :m] = indices
+    w_p = np.zeros((t_n, m_pad, k), np.float32)
+    w_p[:, :m] = weights
+    return (
+        jnp.asarray(np.ascontiguousarray(idx_p.transpose(0, 2, 1))),
+        jnp.asarray(np.ascontiguousarray(w_p.transpose(0, 2, 1))),
+    )
+
+
+def dense_hyperplane_table(forest, m_pad: int, f_pad: int):
+    """Densified ``[T, m_pad, f_pad]`` hyperplane table for the large-k
+    kernel. Duplicate coordinates accumulate (matching the dense XLA path's
+    einsum; numpy fancy-index += would silently drop them)."""
+    indices = np.asarray(forest.indices)
+    weights = np.asarray(forest.weights, np.float32)
+    t_n, m, k = indices.shape
+    W = np.zeros((t_n, m_pad, f_pad), np.float32)
+    t_ix, m_ix, k_ix = np.nonzero(indices >= 0)
+    np.add.at(W, (t_ix, m_ix, indices[t_ix, m_ix, k_ix]), weights[t_ix, m_ix, k_ix])
+    return jnp.asarray(W)
 
 
 def path_lengths_pallas(forest, X, interpret: bool = False) -> jax.Array:
@@ -231,30 +341,38 @@ def path_lengths_pallas(forest, X, interpret: bool = False) -> jax.Array:
         )
     else:
 
+        k = forest.indices.shape[2]
+        sparse = k <= _SPARSE_K_MAX
+
         def build_extended():
-            indices = np.asarray(forest.indices)
-            weights = np.asarray(forest.weights)
-            T, M, _ = indices.shape
-            W = np.zeros((T, m_pad, f_pad), np.float32)
-            t_ix, m_ix, k_ix = np.nonzero(indices >= 0)
-            W[t_ix, m_ix, indices[t_ix, m_ix, k_ix]] += weights[t_ix, m_ix, k_ix]
-            return (
-                jnp.asarray(W),
+            common = (
                 jnp.asarray(
                     _pad_table(np.asarray(forest.offset, np.float32), m_pad, np.inf)
                 ),
                 jnp.asarray(
                     _pad_table(
-                        (indices[..., 0] >= 0).astype(np.float32), m_pad, 0.0
+                        (np.asarray(forest.indices)[..., 0] >= 0).astype(np.float32),
+                        m_pad,
+                        0.0,
                     )
                 ),
                 _leaf_value_tables(forest.num_instances, h, m_pad),
             )
+            if sparse:
+                return sparse_hyperplane_tables(forest, m_pad) + common
+            return (dense_hyperplane_table(forest, m_pad, f_pad),) + common
 
-        W, offset, internal, leaf_value = _cached_prep(
-            forest, build_extended, extra_key=(F,)
+        prep = _cached_prep(
+            forest, build_extended, extra_key=("sparse",) if sparse else ("dense", f_pad)
         )
-        out = _extended_pallas(
-            X, W, offset, internal, leaf_value, h, interpret=interpret
-        )
+        if sparse:
+            idx_p, w_p, offset, internal, leaf_value = prep
+            out = _extended_pallas_sparse(
+                X, idx_p, w_p, offset, internal, leaf_value, h, interpret=interpret
+            )
+        else:
+            W, offset, internal, leaf_value = prep
+            out = _extended_pallas_dense(
+                X, W, offset, internal, leaf_value, h, interpret=interpret
+            )
     return out[:n]

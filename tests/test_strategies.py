@@ -116,6 +116,23 @@ class TestAutoStrategy:
             np.testing.assert_allclose(got, base, atol=3e-6)
 
 
+class TestPallasExtendedDispatch:
+    def test_dense_large_k_path_matches(self, models, monkeypatch):
+        # force the large-k dense-table kernel (production trigger is
+        # k > _SPARSE_K_MAX) and pin parity against the gather walk
+        from isoforest_tpu.ops import pallas_traversal as pt
+
+        X, _, ext = models
+        monkeypatch.setattr(pt, "_SPARSE_K_MAX", 0)
+        pt._PREP_CACHE.clear()
+        try:
+            got = score_matrix(ext.forest, X[:2048], ext.num_samples, strategy="pallas")
+        finally:
+            pt._PREP_CACHE.clear()
+        base = score_matrix(ext.forest, X[:2048], ext.num_samples, strategy="gather")
+        np.testing.assert_allclose(got, base, atol=3e-6)
+
+
 class TestNativeTiledPath:
     def test_large_forest_tiles_match_gather(self):
         # 200 trees x 511 slots ~ 1.2 MB of tables exceeds the walker's
@@ -180,11 +197,6 @@ class TestPallasTpuLowering:
         h = height_of(forest.max_nodes)
         m_pad = pt._pad_lanes(forest.max_nodes)
         indices = np.asarray(forest.indices)
-        weights = np.asarray(forest.weights)
-        T = indices.shape[0]
-        W = np.zeros((T, m_pad, f_pad), np.float32)
-        t_ix, m_ix, k_ix = np.nonzero(indices >= 0)
-        W[t_ix, m_ix, indices[t_ix, m_ix, k_ix]] += weights[t_ix, m_ix, k_ix]
         off = jnp.asarray(
             pt._pad_table(np.asarray(forest.offset, np.float32), m_pad, np.inf)
         )
@@ -192,7 +204,15 @@ class TestPallasTpuLowering:
             pt._pad_table((indices[..., 0] >= 0).astype(np.float32), m_pad, 0.0)
         )
         leaf = pt._leaf_value_tables(forest.num_instances, h, m_pad)
+        # sparse-k kernel (production path for small extension levels)
+        idx_p, w_p = pt.sparse_hyperplane_tables(forest, m_pad)
         self._lower(
-            lambda a, b, c, d, e: pt._extended_pallas(a, b, c, d, e, h),
-            Xp, jnp.asarray(W), off, internal, leaf,
+            lambda a, b, c, d, e, f: pt._extended_pallas_sparse(a, b, c, d, e, f, h),
+            Xp, idx_p, w_p, off, internal, leaf,
+        )
+        # dense-table kernel (large-k dispatch)
+        W = pt.dense_hyperplane_table(forest, m_pad, Xp.shape[1])
+        self._lower(
+            lambda a, b, c, d, e: pt._extended_pallas_dense(a, b, c, d, e, h),
+            Xp, W, off, internal, leaf,
         )
