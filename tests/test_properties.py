@@ -341,3 +341,78 @@ class TestVarintCodecProperties:
         got = encode_varints(values)
         assert got == ref
         assert _packed_varints(got) == [int(v) for v in values]
+
+
+class TestGrowthInvariantProperties:
+    """Fuzz the level-synchronous growth kernels: for arbitrary data
+    distributions and seeds, every grown forest must satisfy the heap
+    invariants the persistence/scoring layers rely on. Shapes are drawn
+    from a small bucket set so XLA compile caching keeps this fast."""
+
+    @given(
+        s_bucket=st.sampled_from([16, 64]),
+        f=st.sampled_from([2, 5]),
+        seed=st.integers(min_value=0, max_value=2**31 - 1),
+        dist=st.sampled_from(["normal", "heavy_ties", "one_hot_col", "constant_col"]),
+    )
+    @_settings
+    def test_standard_forest_invariants(self, s_bucket, f, seed, dist):
+        import jax
+
+        from isoforest_tpu.ops.bagging import (
+            bagged_indices,
+            feature_subsets,
+            per_tree_keys,
+        )
+        from isoforest_tpu.ops.tree_growth import grow_forest
+        from isoforest_tpu.utils import height_limit
+
+        rng = np.random.default_rng(seed)
+        n, t = 300, 3
+        if dist == "normal":
+            X = rng.normal(size=(n, f))
+        elif dist == "heavy_ties":
+            X = rng.choice([0.0, 1.0, 2.0], size=(n, f))
+        elif dist == "one_hot_col":
+            X = rng.normal(size=(n, f))
+            X[:, 0] = 0.0
+            X[rng.integers(0, n), 0] = 1.0
+        else:
+            X = rng.normal(size=(n, f))
+            X[:, -1] = 7.0
+        X = X.astype(np.float32)
+        key = jax.random.PRNGKey(seed)
+        s = s_bucket
+        bag = bagged_indices(jax.random.fold_in(key, 0), n, s, t, False)
+        fidx = feature_subsets(jax.random.fold_in(key, 1), f, f, t)
+        h = height_limit(s)
+        forest = grow_forest(per_tree_keys(jax.random.fold_in(key, 2), t), X, bag, fidx, h)
+        feat = np.asarray(forest.feature)
+        thr = np.asarray(forest.threshold)
+        ni = np.asarray(forest.num_instances)
+        internal = feat >= 0
+        leaf = ni >= 0
+        exists = internal | leaf
+        m = feat.shape[1]
+        assert not np.any(internal & leaf), "node is both internal and leaf"
+        assert exists[:, 0].all(), "missing root"
+        # children exist iff parent internal; leaf populations sum to S
+        for ti in range(t):
+            for i in range(m // 2):
+                li, ri = 2 * i + 1, 2 * i + 2
+                if internal[ti, i]:
+                    assert exists[ti, li] and exists[ti, ri]
+                else:
+                    assert not exists[ti, li] and not exists[ti, ri]
+        np.testing.assert_array_equal(
+            np.where(leaf, ni, 0).sum(axis=1), np.full(t, s)
+        )
+        # every split is on a non-constant feature within its data range,
+        # and constant columns are never chosen
+        if dist == "constant_col":
+            const_gid = f - 1
+            assert not np.any(feat == const_gid)
+        for ti in range(t):
+            for i in np.nonzero(internal[ti])[0]:
+                g = feat[ti, i]
+                assert X[:, g].min() <= thr[ti, i] <= X[:, g].max()
