@@ -43,6 +43,18 @@ class TestStrategyEquivalence:
         assert got.shape == (1537,)
         np.testing.assert_allclose(got, base, atol=3e-6)
 
+    def test_edge_row_counts(self, models, strategy):
+        # zero and single-row inputs must work on every strategy
+        X, std, _ = models
+        empty = score_matrix(
+            std.forest, np.empty((0, X.shape[1]), np.float32), std.num_samples,
+            strategy=strategy,
+        )
+        assert empty.shape == (0,)
+        one = score_matrix(std.forest, X[:1], std.num_samples, strategy=strategy)
+        base = score_matrix(std.forest, X[:1], std.num_samples, strategy="gather")
+        np.testing.assert_allclose(one, base, atol=3e-6)
+
 
 class TestAutoStrategy:
     def test_env_override(self, models, monkeypatch):
