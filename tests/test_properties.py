@@ -416,3 +416,62 @@ class TestGrowthInvariantProperties:
             for i in np.nonzero(internal[ti])[0]:
                 g = feat[ti, i]
                 assert X[:, g].min() <= thr[ti, i] <= X[:, g].max()
+
+
+class TestExtendedGrowthInvariantProperties:
+    @given(
+        s_bucket=st.sampled_from([16, 64]),
+        f=st.sampled_from([3, 6]),
+        level=st.sampled_from([0, 2]),
+        seed=st.integers(min_value=0, max_value=2**31 - 1),
+    )
+    @_settings
+    def test_extended_forest_invariants(self, s_bucket, f, level, seed):
+        import jax
+
+        from isoforest_tpu.ops.bagging import (
+            bagged_indices,
+            feature_subsets,
+            per_tree_keys,
+        )
+        from isoforest_tpu.ops.ext_growth import grow_extended_forest
+        from isoforest_tpu.utils import height_limit
+
+        rng = np.random.default_rng(seed)
+        n, t = 300, 3
+        X = rng.normal(size=(n, f)).astype(np.float32)
+        key = jax.random.PRNGKey(seed)
+        s = s_bucket
+        bag = bagged_indices(jax.random.fold_in(key, 0), n, s, t, False)
+        fidx = feature_subsets(jax.random.fold_in(key, 1), f, f, t)
+        h = height_limit(s)
+        forest = grow_extended_forest(
+            per_tree_keys(jax.random.fold_in(key, 2), t), X, bag, fidx, h, level
+        )
+        idx = np.asarray(forest.indices)
+        w = np.asarray(forest.weights)
+        ni = np.asarray(forest.num_instances)
+        k = min(level + 1, f)
+        assert idx.shape[2] == k
+        internal = idx[:, :, 0] >= 0
+        leaf = ni >= 0
+        assert not np.any(internal & leaf)
+        assert (internal | leaf)[:, 0].all()
+        # hyperplane invariants (SplitHyperplane requires,
+        # ExtendedUtils.scala:21-62): sorted distinct in-range coords,
+        # unit-norm f32 weights
+        sub = idx[internal]
+        if sub.size:
+            assert sub.min() >= 0 and sub.max() < f
+            if k > 1:
+                assert np.all(np.diff(sub, axis=1) > 0)
+            nrm = np.linalg.norm(w[internal], axis=1)
+            assert np.allclose(nrm, 1.0, atol=1e-5)
+        # EIF allows empty (numInstances=0) leaves but populations still
+        # sum to the bag size
+        np.testing.assert_array_equal(
+            np.where(leaf, ni, 0).sum(axis=1), np.full(t, s)
+        )
+        if level == 0 and sub.size:
+            # extensionLevel=0 is axis-aligned: exactly one coordinate
+            assert k == 1
