@@ -475,3 +475,39 @@ class TestExtendedGrowthInvariantProperties:
         if level == 0 and sub.size:
             # extensionLevel=0 is axis-aligned: exactly one coordinate
             assert k == 1
+
+
+class TestOnnxEndToEndProperties:
+    """Fuzz the whole export chain: random small forests -> convert (which
+    self-gates through the independent checker) -> three-way score agreement
+    (framework, bundled runtime, independent evaluator). Fixed shapes keep
+    XLA compile caching effective across examples."""
+
+    @given(
+        seed=st.integers(min_value=0, max_value=2**31 - 1),
+        contamination=st.sampled_from([0.0, 0.05]),
+    )
+    @settings(max_examples=8, deadline=None,
+              suppress_health_check=[HealthCheck.too_slow])
+    def test_convert_check_evaluate(self, seed, contamination, tmp_path_factory):
+        from isoforest_tpu import IsolationForest
+        from isoforest_tpu.onnx import IsolationForestConverter, check_model
+        from isoforest_tpu.onnx.checker import reference_scores
+        from isoforest_tpu.onnx.runtime import run_model
+
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(1500, 4)).astype(np.float32)
+        X[:30] += rng.uniform(3, 8)
+        model = IsolationForest(
+            num_estimators=8, max_samples=64.0,
+            contamination=contamination, random_seed=seed % 1000,
+        ).fit(X)
+        path = tmp_path_factory.mktemp("fuzz") / "m"
+        model.save(str(path))
+        bts = IsolationForestConverter(str(path)).convert()
+        check_model(bts)  # redundant with the convert gate; explicit here
+        rt, _ = run_model(bts, {"features": X[:64]})
+        ind = reference_scores(bts, X[:64])
+        fw = model.score(X[:64])
+        assert np.abs(rt[:, 0] - fw).max() < 1e-5
+        assert np.abs(ind[:, 0] - fw).max() < 1e-5
