@@ -43,6 +43,31 @@ def _pad_lanes(n: int) -> int:
     return max(_LANES, -(-n // _LANES) * _LANES)
 
 
+@functools.lru_cache(maxsize=None)
+def _concat_order(m: int) -> tuple:
+    """Heap node index held by each table slot in the LEVEL-CONCAT layout.
+
+    The kernel's level walk stores the children of a level as
+    ``[all left children | all right children]`` rather than interleaved
+    ``[L0, R0, L1, R1, ...]`` heap order: the interleave needs a
+    ``stack(..., axis=2).reshape`` that Mosaic cannot lower (observed on
+    hardware: ``tpu.reshape vector<1024x2x2xf32> -> vector<1024x4xf32>``
+    "unsupported shape cast"), while the concat form is a plain lane-axis
+    ``jnp.concatenate``. Within level ``l+1`` the left child of in-level
+    parent ``p`` sits at in-level slot ``p`` and the right child at
+    ``w + p``. All node tables are permuted into this layout host-side at
+    prep time; scores are layout-invariant."""
+    h = int(np.log2(m + 1)) - 1
+    assert (1 << (h + 1)) - 1 == m, f"node table size {m} is not a full heap"
+    order = [0]
+    prev = [0]
+    for _ in range(h):
+        nxt = [2 * n + 1 for n in prev] + [2 * n + 2 for n in prev]
+        order.extend(nxt)
+        prev = nxt
+    return tuple(order)
+
+
 def _leaf_value_tables(num_instances: np.ndarray, h: int, m_pad: int) -> jax.Array:
     """[T, 1, m_pad] leaf-value table (:func:`..utils.math.leaf_value_table`
     padded; pad slots contribute 0 to every walk). The unit middle axis makes
@@ -54,15 +79,19 @@ def _leaf_value_tables(num_instances: np.ndarray, h: int, m_pad: int) -> jax.Arr
 
 
 def _pad_table(arr: np.ndarray, m_pad: int, fill: float) -> np.ndarray:
-    """Pad a [T, M] node table to [T, 1, m_pad] with ``fill``."""
+    """Permute a [T, M] heap-order node table into the level-concat layout
+    (:func:`_concat_order`) and pad to [T, 1, m_pad] with ``fill``."""
     t, m = arr.shape
     out = np.full((t, m_pad), fill, arr.dtype)
-    out[:, :m] = arr
+    out[:, :m] = arr[:, list(_concat_order(m))]
     return out[:, None, :]
 
 
 def _walk_levels(B, internal_f32, leaf_value, h: int):
-    """Reach propagation on [C_blk, M] blocks — mirrors dense_traversal."""
+    """Reach propagation on [C_blk, M] blocks — same recurrence as
+    dense_traversal but over tables in the level-concat layout
+    (:func:`_concat_order`): the next level's reach is a lane-axis concat,
+    the one child-ordering Mosaic can lower."""
     C = B.shape[0]
     total = jnp.zeros((C,), jnp.float32)
     reach = jnp.ones((C, 1), jnp.float32)
@@ -75,7 +104,7 @@ def _walk_levels(B, internal_f32, leaf_value, h: int):
             alive = reach * internal_f32[:, start : start + width]
             left = alive * (1.0 - B_l)
             right = alive * B_l
-            reach = jnp.stack([left, right], axis=2).reshape(C, 2 * width)
+            reach = jnp.concatenate([left, right], axis=1)
     return total
 
 
@@ -285,10 +314,11 @@ def sparse_hyperplane_tables(forest, m_pad: int):
     indices = np.asarray(forest.indices)
     weights = np.asarray(forest.weights, np.float32)
     t_n, m, k = indices.shape
+    order = list(_concat_order(m))
     idx_p = np.full((t_n, m_pad, k), -1, np.int32)
-    idx_p[:, :m] = indices
+    idx_p[:, :m] = indices[:, order]
     w_p = np.zeros((t_n, m_pad, k), np.float32)
-    w_p[:, :m] = weights
+    w_p[:, :m] = weights[:, order]
     return (
         jnp.asarray(np.ascontiguousarray(idx_p.transpose(0, 2, 1))),
         jnp.asarray(np.ascontiguousarray(w_p.transpose(0, 2, 1))),
@@ -299,8 +329,9 @@ def dense_hyperplane_table(forest, m_pad: int, f_pad: int):
     """Densified ``[T, m_pad, f_pad]`` hyperplane table for the large-k
     kernel. Duplicate coordinates accumulate (matching the dense XLA path's
     einsum; numpy fancy-index += would silently drop them)."""
-    indices = np.asarray(forest.indices)
-    weights = np.asarray(forest.weights, np.float32)
+    order = list(_concat_order(np.asarray(forest.indices).shape[1]))
+    indices = np.asarray(forest.indices)[:, order]
+    weights = np.asarray(forest.weights, np.float32)[:, order]
     t_n, m, k = indices.shape
     W = np.zeros((t_n, m_pad, f_pad), np.float32)
     t_ix, m_ix, k_ix = np.nonzero(indices >= 0)

@@ -82,11 +82,13 @@ def histogram_quantile(
             break
         rel = jnp.floor((scores - lo) / width * num_bins)
         bins = jnp.clip(rel, -1, num_bins).astype(jnp.int32)
-        # the last bin is right-CLOSED: scores equal to the current hi must
-        # land inside the histogram (q=1.0 would otherwise chase a maximum
-        # that every pass pushes into the overflow bucket and return a
-        # lower-ranked element — caught by the property fuzz)
-        bins = jnp.where(scores == hi, num_bins - 1, bins)
+        # the last bin is right-CLOSED: every score <= the current hi must
+        # land inside the histogram, not the overflow bucket. Equality alone
+        # is not enough — with a huge range the f32 division can round
+        # (score - lo) / width up to 1.0 for scores strictly below hi (e.g.
+        # lo=-2^25, scores {0, 1} — fuzz-caught), silently understating the
+        # chosen bin's population and breaking the rank-error contract.
+        bins = jnp.where(scores <= hi, jnp.minimum(bins, num_bins - 1), bins)
         # slot 0 counts scores strictly below lo; one scatter, one transfer
         all_counts = np.asarray(
             jnp.zeros((num_bins + 2,), jnp.int32).at[bins + 1].add(1)
@@ -159,8 +161,9 @@ def histogram_quantile_jit(
         width = jnp.maximum(hi_c - lo_c, jnp.float32(np.finfo(np.float32).tiny))
         rel = jnp.floor((scores - lo_c) / width * num_bins)
         bins = jnp.clip(rel, -1, num_bins).astype(jnp.int32)
-        # right-closed last bin: see the eager variant (q=1.0 edge)
-        bins = jnp.where(scores == hi_c, num_bins - 1, bins)
+        # right-closed last bin incl. scores that ROUND up to rel == num_bins
+        # (see the eager variant; fuzz-caught)
+        bins = jnp.where(scores <= hi_c, jnp.minimum(bins, num_bins - 1), bins)
         counts = jnp.zeros((num_bins + 2,), jnp.int32).at[bins + 1].add(1)
         cum = counts[0] + jnp.cumsum(counts[1 : num_bins + 1])
         idx = jnp.clip(jnp.searchsorted(cum, target), 0, num_bins - 1)

@@ -56,6 +56,16 @@ class TestHistogramQuantile:
         assert histogram_quantile(s, 0.5) == pytest.approx(0.437, abs=1e-6)
         assert histogram_quantile(s, 0.995) == pytest.approx(0.9, abs=1e-6)
 
+    def test_rounding_cannot_overflow_top_bin(self):
+        # fuzz-caught: with a huge range the f32 division rounds
+        # (score - lo) / width up to 1.0 for scores strictly below hi, which
+        # used to push them into the overflow bucket and understate the top
+        # bin — q=1.0 then returned an element 2 ranks low
+        s = np.array([0.0, 1.0, 2.0, -(2.0**25)], np.float32)
+        for variant in (histogram_quantile, lambda *a, **k: float(histogram_quantile_jit(*a, **k))):
+            assert variant(s, 1.0, eps=1e-3) == 2.0
+            assert variant(s, 0.75, eps=1e-3) in (0.0, 1.0, 2.0)
+
     def test_jit_variant_matches(self, scores):
         for q in [0.5, 0.98]:
             assert float(histogram_quantile_jit(scores, q, eps=1e-9)) == pytest.approx(
