@@ -108,6 +108,20 @@ def _walk_levels(B, internal_f32, leaf_value, h: int):
     return total
 
 
+def _bcast_rows(row, c: int):
+    """Materialize a [1, M] node-table row to [c, M] via a rank-1 MXU
+    contraction. A plain ``row + zeros`` broadcast leaves the value in a
+    sublane-broadcast layout that crashes Mosaic's layout inference when the
+    walk later takes narrow lane slices of it (observed on hardware:
+    ``Check failed: limits[i] <= dim(i) (128 vs. 1)``); the matmul costs
+    ``c * M`` MACs — noise next to the feature-selection contraction — and
+    yields a genuinely materialized vector."""
+    ones = jnp.ones((c, 1), jnp.float32)
+    return jax.lax.dot_general(
+        ones, row, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
 def _standard_kernel(h, T, x_ref, feat_ref, thr_ref, leaf_ref, out_ref):
     t = pl.program_id(1)
     x = x_ref[...]  # [C_blk, F_pad]
@@ -129,8 +143,9 @@ def _standard_kernel(h, T, x_ref, feat_ref, thr_ref, leaf_ref, out_ref):
         x, sel, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )  # [C_blk, M_pad]
     B = (xv >= thr).astype(jnp.float32)
-    internal = (feature >= 0).astype(jnp.float32) + jnp.zeros_like(xv)
-    pl_len = _walk_levels(B, internal, leaf_ref[0] + jnp.zeros_like(xv), h)
+    c_blk = xv.shape[0]
+    internal = _bcast_rows((feature >= 0).astype(jnp.float32), c_blk)
+    pl_len = _walk_levels(B, internal, _bcast_rows(leaf_ref[0], c_blk), h)
 
     @pl.when(t == 0)
     def _init():
@@ -165,8 +180,9 @@ def _extended_kernel_sparse(
         x, w_dense, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )  # [C_blk, M_pad] — MXU
     B = (dots >= off_ref[0]).astype(jnp.float32)
-    internal = internal_ref[0] + jnp.zeros_like(dots)
-    pl_len = _walk_levels(B, internal, leaf_ref[0] + jnp.zeros_like(dots), h)
+    c_blk = dots.shape[0]
+    internal = _bcast_rows(internal_ref[0], c_blk)
+    pl_len = _walk_levels(B, internal, _bcast_rows(leaf_ref[0], c_blk), h)
 
     @pl.when(t == 0)
     def _init():
@@ -188,8 +204,9 @@ def _extended_kernel_dense(
         x, W, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # [C_blk, M_pad] — MXU
     B = (dots >= off_ref[0]).astype(jnp.float32)
-    internal = internal_ref[0] + jnp.zeros_like(dots)
-    pl_len = _walk_levels(B, internal, leaf_ref[0] + jnp.zeros_like(dots), h)
+    c_blk = dots.shape[0]
+    internal = _bcast_rows(internal_ref[0], c_blk)
+    pl_len = _walk_levels(B, internal, _bcast_rows(leaf_ref[0], c_blk), h)
 
     @pl.when(t == 0)
     def _init():
@@ -307,6 +324,34 @@ def _cached_prep(forest, build, extra_key=()):
     return prep
 
 
+def standard_tables(forest, m_pad: int, h: int):
+    """Kernel-layout node tables for a standard forest: ``(feature, threshold,
+    leaf_value)`` permuted/padded ``[T, 1, m_pad]``. Single source for the
+    production prep, the TPU-lowering tests, and the Mosaic machine-compile
+    worker so they cannot diverge. Pads: feature -1 (no one-hot match,
+    non-internal), threshold +inf (go-right bit 0), leaf value 0."""
+    return (
+        jnp.asarray(_pad_table(np.asarray(forest.feature, np.int32), m_pad, -1)),
+        jnp.asarray(
+            _pad_table(np.asarray(forest.threshold, np.float32), m_pad, np.inf)
+        ),
+        _leaf_value_tables(forest.num_instances, h, m_pad),
+    )
+
+
+def extended_common_tables(forest, m_pad: int, h: int):
+    """Kernel-layout ``(offset, internal, leaf_value)`` tables shared by both
+    extended kernels — same single-source rationale as :func:`standard_tables`."""
+    indices = np.asarray(forest.indices)
+    return (
+        jnp.asarray(_pad_table(np.asarray(forest.offset, np.float32), m_pad, np.inf)),
+        jnp.asarray(
+            _pad_table((indices[..., 0] >= 0).astype(np.float32), m_pad, 0.0)
+        ),
+        _leaf_value_tables(forest.num_instances, h, m_pad),
+    )
+
+
 def sparse_hyperplane_tables(forest, m_pad: int):
     """Node-axis-padded sparse hyperplane tables in the kernel layout
     ``[T, k, m_pad]`` (coordinates -1, weights 0 at padding) — shared by the
@@ -329,8 +374,9 @@ def dense_hyperplane_table(forest, m_pad: int, f_pad: int):
     """Densified ``[T, m_pad, f_pad]`` hyperplane table for the large-k
     kernel. Duplicate coordinates accumulate (matching the dense XLA path's
     einsum; numpy fancy-index += would silently drop them)."""
-    order = list(_concat_order(np.asarray(forest.indices).shape[1]))
-    indices = np.asarray(forest.indices)[:, order]
+    indices = np.asarray(forest.indices)
+    order = list(_concat_order(indices.shape[1]))
+    indices = indices[:, order]
     weights = np.asarray(forest.weights, np.float32)[:, order]
     t_n, m, k = indices.shape
     W = np.zeros((t_n, m_pad, f_pad), np.float32)
@@ -354,17 +400,7 @@ def path_lengths_pallas(forest, X, interpret: bool = False) -> jax.Array:
     if isinstance(forest, StandardForest):
 
         def build_standard():
-            # pads: feature -1 (no one-hot match, non-internal), threshold
-            # +inf (go-right bit 0), leaf value 0 (no contribution)
-            return (
-                jnp.asarray(
-                    _pad_table(np.asarray(forest.feature, np.int32), m_pad, -1)
-                ),
-                jnp.asarray(
-                    _pad_table(np.asarray(forest.threshold, np.float32), m_pad, np.inf)
-                ),
-                _leaf_value_tables(forest.num_instances, h, m_pad),
-            )
+            return standard_tables(forest, m_pad, h)
 
         feature_f32, threshold, leaf_value = _cached_prep(forest, build_standard)
         out = _standard_pallas(
@@ -376,19 +412,7 @@ def path_lengths_pallas(forest, X, interpret: bool = False) -> jax.Array:
         sparse = k <= _SPARSE_K_MAX
 
         def build_extended():
-            common = (
-                jnp.asarray(
-                    _pad_table(np.asarray(forest.offset, np.float32), m_pad, np.inf)
-                ),
-                jnp.asarray(
-                    _pad_table(
-                        (np.asarray(forest.indices)[..., 0] >= 0).astype(np.float32),
-                        m_pad,
-                        0.0,
-                    )
-                ),
-                _leaf_value_tables(forest.num_instances, h, m_pad),
-            )
+            common = extended_common_tables(forest, m_pad, h)
             if sparse:
                 return sparse_hyperplane_tables(forest, m_pad) + common
             return (dense_hyperplane_table(forest, m_pad, f_pad),) + common

@@ -1,0 +1,87 @@
+"""Subprocess worker: full Mosaic MACHINE compilation of the Pallas kernels
+via the local chipless TPU AOT compiler (libtpu + a v5e topology description,
+no chip needed). Run by ``tests/test_strategies.py::TestPallasMosaicMachineCompile``
+in a subprocess because a Mosaic layout-inference regression aborts the whole
+process (``Check failed`` → SIGABRT), which must surface as a test failure,
+not kill pytest.
+
+Exit codes: 0 = all kernels compiled; anything else = failure (stderr says why).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# libtpu wants these even for chipless AOT compilation; values are arbitrary
+os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-4")
+os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    try:
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name="v5e:2x2x1"
+        )
+    except Exception as exc:  # no libtpu / no chipless AOT on this machine
+        print(f"TOPOLOGY_UNAVAILABLE: {exc}", file=sys.stderr)
+        return 3
+    mesh = Mesh(np.array(topo.devices)[:1].reshape(1), ("d",))
+    s = NamedSharding(mesh, PartitionSpec())
+
+    from isoforest_tpu import ExtendedIsolationForest, IsolationForest
+    from isoforest_tpu.ops import pallas_traversal as pt
+    from isoforest_tpu.utils.math import height_of
+
+    def aot(fn, *arrs):
+        shapes = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrs]
+        jax.jit(fn, in_shardings=(s,) * len(arrs), out_shardings=s).lower(
+            *shapes
+        ).compile()
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(1024, 6)).astype(np.float32)
+    std = IsolationForest(num_estimators=3, max_samples=64.0, random_seed=1).fit(X)
+    ext = ExtendedIsolationForest(
+        num_estimators=3, max_samples=64.0, extension_level=3, random_seed=1
+    ).fit(X)
+
+    f_pad = pt._pad_lanes(X.shape[1])
+    Xp = jnp.pad(jnp.asarray(X), ((0, 0), (0, f_pad - X.shape[1])))
+
+    forest = std.forest
+    h = height_of(forest.max_nodes)
+    m_pad = pt._pad_lanes(forest.max_nodes)
+    feat, thr, leaf = pt.standard_tables(forest, m_pad, h)
+    aot(lambda a, b, c, d: pt._standard_pallas(a, b, c, d, h), Xp, feat, thr, leaf)
+    print("standard: machine compile ok", flush=True)
+
+    forest = ext.forest
+    h = height_of(forest.max_nodes)
+    m_pad = pt._pad_lanes(forest.max_nodes)
+    off, internal, leaf = pt.extended_common_tables(forest, m_pad, h)
+    idx_p, w_p = pt.sparse_hyperplane_tables(forest, m_pad)
+    aot(
+        lambda a, b, c, d, e, f: pt._extended_pallas_sparse(a, b, c, d, e, f, h),
+        Xp, idx_p, w_p, off, internal, leaf,
+    )
+    print("extended sparse: machine compile ok", flush=True)
+    W = pt.dense_hyperplane_table(forest, m_pad, Xp.shape[1])
+    aot(
+        lambda a, b, c, d, e: pt._extended_pallas_dense(a, b, c, d, e, h),
+        Xp, W, off, internal, leaf,
+    )
+    print("extended dense: machine compile ok", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
