@@ -110,6 +110,30 @@ def kddcup_http_hard(
     return X[perm], y[perm]
 
 
+def mulcross(
+    n: int = 65536, contamination: float = 0.1, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Mulcross-family mixture (Rocke & Woodruff's synthetic generator behind
+    the ODDS 'mulcross' set in the reference's published table,
+    /root/reference/README.md:444-446): 4-d standard-normal inliers plus TWO
+    dense, compact anomaly clusters offset from the mean. Clustered anomalies
+    are the regime where the reference's table shows standard IF (0.991)
+    beating EIF (0.938-0.940) — dense clumps look like small modes, which
+    hyperplane splits carve less cleanly than axis-aligned retries. The
+    cluster spread (0.35 sigma) keeps AUROC off the 1.0 ceiling so the gate
+    can fail."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    n_a = n_out // 2
+    inliers = rng.normal(size=(n - n_out, 4))
+    c1 = rng.normal(loc=(3.5, 3.5, 0.0, 0.0), scale=0.35, size=(n_a, 4))
+    c2 = rng.normal(loc=(0.0, 0.0, 3.5, -3.5), scale=0.35, size=(n_out - n_a, 4))
+    X = np.vstack([inliers, c1, c2]).astype(np.float32)
+    y = np.concatenate([np.zeros(n - n_out), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
 def high_dim_blobs(
     n: int = 20000, f: int = 274, contamination: float = 0.02, seed: int = 0
 ) -> Tuple[np.ndarray, np.ndarray]:

@@ -17,6 +17,7 @@ from isoforest_tpu import ExtendedIsolationForest, IsolationForest
 from isoforest_tpu.data import (
     high_dim_blobs,
     kddcup_http_hard,
+    mulcross,
     sinusoid,
     two_blobs,
 )
@@ -53,6 +54,30 @@ class TestBandedGates:
         model = ExtendedIsolationForest(num_estimators=100, random_seed=1).fit(X)
         a = _auroc(np.asarray(model.score(X)), y)
         assert 0.94 <= a <= 0.99, f"two_blobs EIF AUROC {a:.4f} outside band"
+
+    def test_mulcross_std(self):
+        X, y = mulcross(n=30000)
+        model = IsolationForest(num_estimators=100, random_seed=1).fit(X)
+        a = _auroc(np.asarray(model.score(X)), y)
+        assert 0.96 <= a <= 0.995, f"mulcross AUROC {a:.4f} outside band"
+
+    def test_standard_beats_eif_on_mulcross(self):
+        """The flip side of the sinusoid gate, straight from the reference's
+        published table (README.md:444-446: std 0.991 vs EIF ~0.94): on dense
+        CLUSTERED anomalies, axis-aligned splits with constant-feature retry
+        carve the clumps better than hyperplanes. Both orderings holding
+        simultaneously pins that the two families are genuinely different
+        algorithms, not one kernel behind two names."""
+        X, y = mulcross(n=30000)
+        gap = []
+        for seed in (1, 2, 3):
+            std = IsolationForest(num_estimators=100, random_seed=seed).fit(X)
+            eif = ExtendedIsolationForest(num_estimators=100, random_seed=seed).fit(X)
+            gap.append(
+                _auroc(np.asarray(std.score(X)), y)
+                - _auroc(np.asarray(eif.score(X)), y)
+            )
+        assert np.mean(gap) > 0.005, f"std advantage lost: mean gap {np.mean(gap):.4f}"
 
     def test_eif_beats_standard_on_sinusoid(self):
         """The EIF paper's core claim (and the reference's README:466-470
