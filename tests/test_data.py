@@ -10,6 +10,7 @@ from isoforest_tpu.data import (
     high_dim_blobs,
     kddcup_http_like,
     load_labeled_csv,
+    mulcross,
     sinusoid,
     two_blobs,
 )
@@ -44,6 +45,7 @@ class TestGenerators:
             (sinusoid, dict(n=3000)),
             (kddcup_http_like, dict(n=20000)),
             (high_dim_blobs, dict(n=4000, f=64)),
+            (mulcross, dict(n=3000)),
         ],
     )
     def test_shapes_and_labels(self, gen, kw):

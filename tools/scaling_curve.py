@@ -1,0 +1,108 @@
+"""Device-count scaling curve for the fused distributed train step.
+
+The reference's scale story is Spark executors (one tree per partition,
+SharedTrainLogic.scala:140-145); ours is a ``(data, trees)`` mesh. This tool
+measures the same program at 1/2/4/8 devices two ways:
+
+  * **weak scaling** — per-device work held constant (rows and trees grow
+    with the mesh): ideal is flat wall-clock; the gap is collective overhead.
+  * **strong scaling** — total work held constant: ideal is 1/n wall-clock.
+
+On this image the mesh is 8 virtual CPU devices (the same validation surface
+as tests/test_parallel.py); on a real slice the identical script measures ICI
+instead. One JSON line per point::
+
+    python tools/scaling_curve.py [--rows 262144] [--trees 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1 << 18, help="total rows at full mesh")
+    ap.add_argument("--trees", type=int, default=128, help="total trees at full mesh")
+    ap.add_argument("--samples", type=int, default=256)
+    ap.add_argument("--features", type=int, default=6)
+    ap.add_argument("--max-devices", type=int, default=8)
+    ap.add_argument(
+        "--backend",
+        choices=("cpu", "default"),
+        default="cpu",
+        help="cpu = virtual-device mesh (safe when the TPU tunnel is wedged: "
+        "probing the default backend would hang); default = whatever the "
+        "environment registers (a real slice on TPU hosts)",
+    )
+    args = ap.parse_args()
+
+    if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.max_devices}"
+        )
+    import jax
+
+    if args.backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from isoforest_tpu.parallel import create_mesh, make_train_step
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(0)
+    X_full = rng.normal(size=(args.rows, args.features)).astype(np.float32)
+    X_full[: args.rows // 100] += 5.0
+
+    def run(n_dev: int, rows: int, trees: int, mode: str) -> None:
+        mesh = create_mesh(devices=jax.devices()[:n_dev])
+        step = make_train_step(
+            mesh,
+            num_rows=rows,
+            num_features_total=args.features,
+            num_trees=trees,
+            num_samples=args.samples,
+            num_features=args.features,
+            contamination=0.01,
+        )
+        X = X_full[:rows]
+        key = jax.random.PRNGKey(7)
+        jax.block_until_ready(step(key, X).scores)  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step(key, X).scores)
+            best = min(best, time.perf_counter() - t0)
+        print(
+            json.dumps(
+                {
+                    "metric": f"{mode}_scaling_train_step",
+                    "devices": n_dev,
+                    "rows": rows,
+                    "trees": trees,
+                    "value": round(best, 4),
+                    "unit": "s",
+                    "rows_per_s": round(rows / best, 1),
+                    "backend": platform,
+                    "mesh": dict(mesh.shape),
+                }
+            ),
+            flush=True,
+        )
+
+    n_max = min(args.max_devices, len(jax.devices()))
+    dev_counts = [d for d in (1, 2, 4, 8) if d <= n_max]
+    for n_dev in dev_counts:
+        # weak: per-device share constant
+        run(n_dev, args.rows * n_dev // n_max, args.trees * n_dev // n_max, "weak")
+    for n_dev in dev_counts:
+        run(n_dev, args.rows, args.trees, "strong")
+
+
+if __name__ == "__main__":
+    main()

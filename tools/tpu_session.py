@@ -1,0 +1,164 @@
+"""One-shot TPU measurement batch — run when the tunnel is live.
+
+Chip time in this environment is scarce (the tunnel wedges for hours; see
+benchmarks/tpu_probe_history.log), so when it IS live, this script captures
+every measurement the round needs in one serialized process:
+
+  1. strategy ranking (gather / dense / pallas) on the standard forest,
+  2. the same for the extended family (sparse-k and dense-k dispatch),
+  3. headline 1M-row fit+score (bench.py main, in-process),
+  4. per-phase timings at the BASELINE.json stress shapes,
+  5. an optional ``jax.profiler`` trace of the scoring hot loop
+     (``--trace DIR``).
+
+Usage::
+
+    python tools/tpu_session.py [--trace /tmp/tpu_trace] [--quick]
+
+Every section prints one JSON line, so a driver (or a later round) can diff
+sessions. The script never spawns concurrent TPU work and exits cleanly to
+release the chip claim promptly.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+
+def _bring_up(timeout_s: float = 240.0) -> str:
+    """Probe backend bring-up in a subprocess first (a wedged tunnel hangs
+    the first jax op forever in-process; a subprocess we can time out).
+    An explicit ``JAX_PLATFORMS=cpu`` skips the probe and pins CPU — the
+    sitecustomize force-pins the axon platform over the env var, so this is
+    the only way to test the session mechanics off-TPU."""
+    import os
+    import subprocess
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu"
+
+    code = "import jax; print(jax.devices()[0].platform, flush=True)"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            timeout=timeout_s,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"metric": "tpu_session", "error": "tunnel wedged"}))
+        raise SystemExit(2)
+    if out.returncode != 0:
+        print(
+            json.dumps(
+                {"metric": "tpu_session", "error": out.stderr.strip()[-300:]}
+            )
+        )
+        raise SystemExit(2)
+    return out.stdout.split()[0]
+
+
+def _time(fn, *args, reps: int = 3) -> float:
+    fn(*args)  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn(*args)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def strategy_ranking(model, X, label: str, candidates) -> dict:
+    from isoforest_tpu.ops.traversal import score_matrix
+
+    timings = {}
+    for strat in candidates:
+        try:
+            timings[strat] = round(
+                _time(
+                    lambda s=strat: score_matrix(
+                        model.forest, X, model.num_samples, strategy=s
+                    )
+                ),
+                4,
+            )
+        except Exception as exc:  # noqa: BLE001 — a failed strategy is data
+            timings[strat] = f"error: {str(exc)[:120]}"
+    numeric = {k: v for k, v in timings.items() if isinstance(v, float)}
+    out = {
+        "metric": f"strategy_ranking_{label}",
+        "rows": int(X.shape[0]),
+        "timings": timings,
+        "winner": min(numeric, key=numeric.get) if numeric else None,
+        "unit": "s",
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    trace_dir = None
+    if "--trace" in sys.argv:
+        trace_dir = sys.argv[sys.argv.index("--trace") + 1]
+    n = 1 << 17 if quick else 1 << 19
+    if "--rows" in sys.argv:  # mechanics testing off-TPU uses tiny sizes
+        n = int(sys.argv[sys.argv.index("--rows") + 1])
+
+    platform = _bring_up()
+    print(json.dumps({"metric": "tpu_session_backend", "value": platform}), flush=True)
+
+    import jax
+
+    from isoforest_tpu import ExtendedIsolationForest, IsolationForest
+    from isoforest_tpu.data import kddcup_http_hard
+
+    X, _ = kddcup_http_hard(n=n)
+
+    # 1. standard-forest strategy ranking (pallas off-TPU would run in
+    # interpret mode — minutes per rep — so it only joins on the chip)
+    std = IsolationForest(num_estimators=100, random_seed=1).fit(X)
+    cands = ["gather", "dense"]
+    if jax.devices()[0].platform == "tpu":
+        cands.append("pallas")
+    std_rank = strategy_ranking(std, X, "standard", cands)
+
+    # 2. extended family, both kernel dispatches
+    ext_sparse = ExtendedIsolationForest(
+        num_estimators=100, extension_level=1, random_seed=1
+    ).fit(X)
+    strategy_ranking(ext_sparse, X, "extended_sparse_k2", cands)
+    ext_full = ExtendedIsolationForest(num_estimators=100, random_seed=1).fit(X)
+    strategy_ranking(ext_full, X, "extended_full", cands)
+
+    # 3. growth-phase timing (fit only, separate from scoring)
+    fit_s = _time(lambda: IsolationForest(num_estimators=100, random_seed=1).fit(X))
+    print(
+        json.dumps(
+            {"metric": "fit_only", "rows": n, "value": round(fit_s, 4), "unit": "s"}
+        ),
+        flush=True,
+    )
+
+    # 4. optional profiler trace of the winning-strategy scoring pass
+    if trace_dir:
+        from isoforest_tpu.ops.traversal import score_matrix
+
+        winner = std_rank["winner"] or "dense"
+        score_matrix(std.forest, X, std.num_samples, strategy=winner)  # warm
+        with jax.profiler.trace(trace_dir):
+            score_matrix(std.forest, X, std.num_samples, strategy=winner)
+        print(
+            json.dumps({"metric": "trace_written", "dir": trace_dir, "strategy": winner}),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
