@@ -20,7 +20,10 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> None:
@@ -97,11 +100,22 @@ def main() -> None:
 
     n_max = min(args.max_devices, len(jax.devices()))
     dev_counts = [d for d in (1, 2, 4, 8) if d <= n_max]
+
+    def fit_multiple(value: int, n_dev: int) -> int:
+        # make_train_step requires rows/trees to divide the mesh axes;
+        # rounding to a multiple of the device count satisfies any factoring
+        return max(n_dev, value - value % n_dev)
+
     for n_dev in dev_counts:
         # weak: per-device share constant
-        run(n_dev, args.rows * n_dev // n_max, args.trees * n_dev // n_max, "weak")
+        run(
+            n_dev,
+            fit_multiple(args.rows * n_dev // n_max, n_dev),
+            fit_multiple(args.trees * n_dev // n_max, n_dev),
+            "weak",
+        )
     for n_dev in dev_counts:
-        run(n_dev, args.rows, args.trees, "strong")
+        run(n_dev, fit_multiple(args.rows, n_dev), fit_multiple(args.trees, n_dev), "strong")
 
 
 if __name__ == "__main__":

@@ -5,27 +5,32 @@ benchmarks/tpu_probe_history.log), so when it IS live, this script captures
 every measurement the round needs in one serialized process:
 
   1. strategy ranking (gather / dense / pallas) on the standard forest,
-  2. the same for the extended family (sparse-k and dense-k dispatch),
-  3. headline 1M-row fit+score (bench.py main, in-process),
-  4. per-phase timings at the BASELINE.json stress shapes,
-  5. an optional ``jax.profiler`` trace of the scoring hot loop
-     (``--trace DIR``).
+  2. the same for the extended family (sparse-k and full-extension dispatch),
+  3. fit-only timing (growth + bagging, separate from scoring),
+  4. ``--headline``: the 1M-row bench.py headline (fit+score vs sklearn),
+  5. ``--northstar``: the 10M-row BASELINE.json scale config,
+  6. ``--trace DIR``: a ``jax.profiler`` trace of one scoring pass (winning
+     strategy) and one fit.
 
-Usage::
+Recommended live-window invocation::
 
-    python tools/tpu_session.py [--trace /tmp/tpu_trace] [--quick]
+    python tools/tpu_session.py --headline --northstar --trace /tmp/tpu_trace
 
 Every section prints one JSON line, so a driver (or a later round) can diff
 sessions. The script never spawns concurrent TPU work and exits cleanly to
-release the chip claim promptly.
+release the chip claim promptly. Off-TPU mechanics test (tiny sizes, CPU):
+``JAX_PLATFORMS=cpu python tools/tpu_session.py --rows 4096``.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _bring_up(timeout_s: float = 240.0) -> str:
@@ -34,7 +39,6 @@ def _bring_up(timeout_s: float = 240.0) -> str:
     An explicit ``JAX_PLATFORMS=cpu`` skips the probe and pins CPU — the
     sitecustomize force-pins the axon platform over the env var, so this is
     the only way to test the session mechanics off-TPU."""
-    import os
     import subprocess
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -103,13 +107,16 @@ def strategy_ranking(model, X, label: str, candidates) -> dict:
 
 
 def main() -> None:
-    quick = "--quick" in sys.argv
-    trace_dir = None
-    if "--trace" in sys.argv:
-        trace_dir = sys.argv[sys.argv.index("--trace") + 1]
-    n = 1 << 17 if quick else 1 << 19
-    if "--rows" in sys.argv:  # mechanics testing off-TPU uses tiny sizes
-        n = int(sys.argv[sys.argv.index("--rows") + 1])
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=1 << 19,
+                    help="ranking/fit section row count (tiny for CPU tests)")
+    ap.add_argument("--headline", action="store_true",
+                    help="also run the 1M-row bench.py headline in-process")
+    ap.add_argument("--northstar", action="store_true",
+                    help="also run the 10M-row BASELINE.json scale config")
+    ap.add_argument("--trace", metavar="DIR", default=None,
+                    help="write a jax.profiler trace of scoring + fit")
+    args = ap.parse_args()
 
     platform = _bring_up()
     print(json.dumps({"metric": "tpu_session_backend", "value": platform}), flush=True)
@@ -119,7 +126,7 @@ def main() -> None:
     from isoforest_tpu import ExtendedIsolationForest, IsolationForest
     from isoforest_tpu.data import kddcup_http_hard
 
-    X, _ = kddcup_http_hard(n=n)
+    X, _ = kddcup_http_hard(n=args.rows)
 
     # 1. standard-forest strategy ranking (pallas off-TPU would run in
     # interpret mode — minutes per rep — so it only joins on the chip)
@@ -141,21 +148,72 @@ def main() -> None:
     fit_s = _time(lambda: IsolationForest(num_estimators=100, random_seed=1).fit(X))
     print(
         json.dumps(
-            {"metric": "fit_only", "rows": n, "value": round(fit_s, 4), "unit": "s"}
+            {"metric": "fit_only", "rows": args.rows, "value": round(fit_s, 4), "unit": "s"}
         ),
         flush=True,
     )
 
-    # 4. optional profiler trace of the winning-strategy scoring pass
-    if trace_dir:
+    # 4. the bench.py headline (1M rows, sklearn comparison) in-process —
+    # bench's own backend probe is skipped; we already brought the chip up
+    if args.headline:
+        import bench
+
+        Xh, yh = bench.make_data()
+        total_s, bfit_s, score_s, scores, strategy = bench.bench_ours(Xh)
+        print(
+            json.dumps(
+                {
+                    "metric": "headline_1M_fit_score",
+                    "value": round(bench.NUM_ROWS / total_s, 1),
+                    "unit": "rows/s",
+                    "fit_s": round(bfit_s, 3),
+                    "score_s": round(score_s, 3),
+                    "strategy": strategy,
+                    "auroc": round(bench.auroc(scores, yh), 4),
+                    "backend": platform,
+                }
+            ),
+            flush=True,
+        )
+
+    # 5. north-star config: 10M-row fit+score (BASELINE.json's scale
+    # target; the CPU steady state is 15.1 s / 663k rows/s)
+    if args.northstar:
+        Xn, _ = kddcup_http_hard(n=10_000_000)
+        est = IsolationForest(num_estimators=100, random_seed=1)
+        est.fit(Xn).score(Xn)  # compile + warm at shape
+        t0 = time.perf_counter()
+        model = est.fit(Xn)
+        nfit_s = time.perf_counter() - t0
+        model.score(Xn)
+        total = time.perf_counter() - t0
+        print(
+            json.dumps(
+                {
+                    "metric": "northstar_10M_fit_score",
+                    "value": round(10_000_000 / total, 1),
+                    "unit": "rows/s",
+                    "fit_s": round(nfit_s, 3),
+                    "total_s": round(total, 3),
+                    "backend": platform,
+                }
+            ),
+            flush=True,
+        )
+
+    # 6. optional profiler trace: one scoring pass (winning strategy) AND one
+    # fit — the r2 live window showed fit at 0.47 s on TPU vs 0.065 s on CPU,
+    # so the trace should say whether bagging transfers or growth dominate
+    if args.trace:
         from isoforest_tpu.ops.traversal import score_matrix
 
         winner = std_rank["winner"] or "dense"
         score_matrix(std.forest, X, std.num_samples, strategy=winner)  # warm
-        with jax.profiler.trace(trace_dir):
+        with jax.profiler.trace(args.trace):
             score_matrix(std.forest, X, std.num_samples, strategy=winner)
+            IsolationForest(num_estimators=100, random_seed=1).fit(X)
         print(
-            json.dumps({"metric": "trace_written", "dir": trace_dir, "strategy": winner}),
+            json.dumps({"metric": "trace_written", "dir": args.trace, "strategy": winner}),
             flush=True,
         )
 
