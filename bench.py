@@ -91,8 +91,14 @@ def _pick_strategy(model, X: np.ndarray) -> str:
     return best
 
 
-def bench_ours(X: np.ndarray) -> tuple[float, float, float, np.ndarray, str]:
-    """Returns (total_s, fit_s, score_s, scores, strategy)."""
+def bench_ours(
+    X: np.ndarray, strategy: str | None = None
+) -> tuple[float, float, float, np.ndarray, str]:
+    """Returns (total_s, fit_s, score_s, scores, strategy). Pass ``strategy``
+    to pin a pre-measured winner (tools/tpu_session.py ranks strategies
+    itself and must not burn chip time re-ranking here)."""
+    import os
+
     from isoforest_tpu import IsolationForest
 
     est = IsolationForest(
@@ -102,7 +108,10 @@ def bench_ours(X: np.ndarray) -> tuple[float, float, float, np.ndarray, str]:
     # measures steady-state execution, not XLA compilation; auto-tune the
     # scoring strategy for this backend along the way
     model = est.fit(X)
-    strategy = _pick_strategy(model, X)
+    if strategy is None:
+        strategy = _pick_strategy(model, X)
+    else:
+        os.environ["ISOFOREST_TPU_STRATEGY"] = strategy
     model.score(X)
 
     # best of two timed passes: the shared build host adds run-to-run noise

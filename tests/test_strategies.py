@@ -164,6 +164,37 @@ class TestNativeTiledPath:
         np.testing.assert_allclose(got, base, atol=3e-6)
 
 
+class TestConcatOrderLayout:
+    """Structural contract of the level-concat table layout — the kernel's
+    walk arithmetic (parent at in-level slot p -> left child at p, right at
+    w + p) must match exactly what :func:`_concat_order` promises, for every
+    height the forests use."""
+
+    @pytest.mark.parametrize("h", [1, 2, 5, 8])
+    def test_parent_child_relation(self, h):
+        from isoforest_tpu.ops.pallas_traversal import _concat_order
+
+        m = (1 << (h + 1)) - 1
+        order = _concat_order(m)
+        assert sorted(order) == list(range(m))  # a permutation of the heap
+        for level in range(h):
+            start, w = (1 << level) - 1, 1 << level
+            start2 = (1 << (level + 1)) - 1
+            # each level's slots hold exactly that heap level's nodes
+            lvl = set(order[start : start + w])
+            assert lvl == set(range(start, start + w))
+            for p in range(w):
+                parent = order[start + p]
+                assert order[start2 + p] == 2 * parent + 1  # left block
+                assert order[start2 + w + p] == 2 * parent + 2  # right block
+
+    def test_rejects_non_full_heap(self):
+        from isoforest_tpu.ops.pallas_traversal import _concat_order
+
+        with pytest.raises(AssertionError):
+            _concat_order(6)
+
+
 class TestPallasMosaicMachineCompile:
     """FULL Mosaic machine compilation, no chip required: the local libtpu
     exposes a chipless AOT compiler through a TPU topology description

@@ -153,13 +153,61 @@ def main() -> None:
         flush=True,
     )
 
+    # 3b. scoring chunk-size sweep on the winning strategy: the dense path
+    # streams [chunk, M] intermediates through HBM, so the chunk size trades
+    # working-set size against dispatch overhead — measured, not guessed
+    from isoforest_tpu.ops.traversal import score_matrix
+
+    winner_strat = std_rank["winner"] or "dense"
+    chunk_timings = {}
+    for log2c in (14, 16, 18):
+        if (1 << log2c) > args.rows:
+            continue
+        try:
+            chunk_timings[f"2^{log2c}"] = round(
+                _time(
+                    lambda c=1 << log2c: score_matrix(
+                        std.forest, X, std.num_samples, chunk_size=c, strategy=winner_strat
+                    )
+                ),
+                4,
+            )
+        except Exception as exc:  # noqa: BLE001 — a failed point is data
+            chunk_timings[f"2^{log2c}"] = f"error: {str(exc)[:120]}"
+    print(
+        json.dumps(
+            {
+                "metric": "chunk_size_sweep",
+                "strategy": winner_strat,
+                "rows": args.rows,
+                "timings": chunk_timings,
+                "unit": "s",
+            }
+        ),
+        flush=True,
+    )
+
     # 4. the bench.py headline (1M rows, sklearn comparison) in-process —
     # bench's own backend probe is skipped; we already brought the chip up
     if args.headline:
         import bench
 
         Xh, yh = bench.make_data()
-        total_s, bfit_s, score_s, scores, strategy = bench.bench_ours(Xh)
+        # bench_ours auto-tunes and exports ISOFOREST_TPU_STRATEGY as a side
+        # effect; restore it afterwards so later sections resolve the same
+        # strategy whether or not --headline ran (session JSONs stay
+        # diffable), and pin section 1's winner up front so bench does not
+        # burn chip time re-ranking what section 1 already measured
+        prev_env = os.environ.get("ISOFOREST_TPU_STRATEGY")
+        try:
+            total_s, bfit_s, score_s, scores, strategy = bench.bench_ours(
+                Xh, strategy=winner_strat
+            )
+        finally:
+            if prev_env is None:
+                os.environ.pop("ISOFOREST_TPU_STRATEGY", None)
+            else:
+                os.environ["ISOFOREST_TPU_STRATEGY"] = prev_env
         print(
             json.dumps(
                 {
@@ -205,15 +253,14 @@ def main() -> None:
     # fit — the r2 live window showed fit at 0.47 s on TPU vs 0.065 s on CPU,
     # so the trace should say whether bagging transfers or growth dominate
     if args.trace:
-        from isoforest_tpu.ops.traversal import score_matrix
-
-        winner = std_rank["winner"] or "dense"
-        score_matrix(std.forest, X, std.num_samples, strategy=winner)  # warm
+        score_matrix(std.forest, X, std.num_samples, strategy=winner_strat)  # warm
         with jax.profiler.trace(args.trace):
-            score_matrix(std.forest, X, std.num_samples, strategy=winner)
+            score_matrix(std.forest, X, std.num_samples, strategy=winner_strat)
             IsolationForest(num_estimators=100, random_seed=1).fit(X)
         print(
-            json.dumps({"metric": "trace_written", "dir": args.trace, "strategy": winner}),
+            json.dumps(
+                {"metric": "trace_written", "dir": args.trace, "strategy": winner_strat}
+            ),
             flush=True,
         )
 
