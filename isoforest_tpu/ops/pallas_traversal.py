@@ -108,17 +108,24 @@ def _walk_levels(B, internal_f32, leaf_value, h: int):
     return total
 
 
-def _bcast_rows(row, c: int):
+def _bcast_rows(row, c: int, precision=None):
     """Materialize a [1, M] node-table row to [c, M] via a rank-1 MXU
     contraction. A plain ``row + zeros`` broadcast leaves the value in a
     sublane-broadcast layout that crashes Mosaic's layout inference when the
     walk later takes narrow lane slices of it (observed on hardware:
-    ``Check failed: limits[i] <= dim(i) (128 vs. 1)``); the matmul costs
-    ``c * M`` MACs — noise next to the feature-selection contraction — and
-    yields a genuinely materialized vector."""
+    ``Check failed: limits[i] <= dim(i) (128 vs. 1)``; a broadcasting
+    multiply by a [c, 1] ones column hits the same class of crash in the
+    *remote* compile helper even though the local chipless AOT pipeline
+    accepts it — the helper runs a different Mosaic build, so only
+    remote-proven formulations ship). ``precision``: the standard kernel
+    passes HIGHEST so leaf/internal table values do not round through bf16
+    mantissas (proven to compile remotely 2026-07-29); the EIF kernels keep
+    the default — HIGHEST inside them crashes the remote helper, and they
+    are the measured losers vs dense anyway (benchmarks/README.md)."""
     ones = jnp.ones((c, 1), jnp.float32)
     return jax.lax.dot_general(
-        ones, row, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ones, row, (((1,), (0,)), ((), ())),
+        precision=precision, preferred_element_type=jnp.float32,
     )
 
 
@@ -140,12 +147,13 @@ def _standard_kernel(h, T, x_ref, feat_ref, thr_ref, leaf_ref, out_ref):
     iota_f = jax.lax.broadcasted_iota(jnp.int32, (f_pad, m_pad), 0)
     sel = (iota_f == feature).astype(jnp.float32)  # [F_pad, M_pad]
     xv = jax.lax.dot_general(
-        x, sel, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        x, sel, (((1,), (0,)), ((), ())), precision=jax.lax.Precision.HIGHEST, preferred_element_type=jnp.float32
     )  # [C_blk, M_pad]
     B = (xv >= thr).astype(jnp.float32)
     c_blk = xv.shape[0]
-    internal = _bcast_rows((feature >= 0).astype(jnp.float32), c_blk)
-    pl_len = _walk_levels(B, internal, _bcast_rows(leaf_ref[0], c_blk), h)
+    hp = jax.lax.Precision.HIGHEST
+    internal = _bcast_rows((feature >= 0).astype(jnp.float32), c_blk, hp)
+    pl_len = _walk_levels(B, internal, _bcast_rows(leaf_ref[0], c_blk, hp), h)
 
     @pl.when(t == 0)
     def _init():
@@ -176,6 +184,12 @@ def _extended_kernel_sparse(
     for q in range(k):
         sel = (iota_f == idx[q][None, :]).astype(jnp.float32)  # [F_pad, M_pad]
         w_dense = w_dense + sel * w[q][None, :]
+    # NOTE: default matmul precision (bf16 passes) — Precision.HIGHEST on
+    # this contraction crashes the Mosaic compile helper on real hardware
+    # (observed 2026-07-29: tpu_compile_helper exit 1; the standard kernel's
+    # HIGHEST contraction compiles fine). The EIF pallas path is already the
+    # measured loser vs dense (benchmarks/README.md) — kept compilable for
+    # the record rather than bit-exact.
     dots = jax.lax.dot_general(
         x, w_dense, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )  # [C_blk, M_pad] — MXU
@@ -200,6 +214,8 @@ def _extended_kernel_dense(
     t = pl.program_id(1)
     x = x_ref[...]  # [C_blk, F_pad]
     W = w_ref[0]  # [M_pad, F_pad]
+    # default precision for the same Mosaic-compile reason as the sparse
+    # EIF kernel above
     dots = jax.lax.dot_general(
         x, W, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # [C_blk, M_pad] — MXU

@@ -45,6 +45,22 @@ class TestStrategyEquivalence:
         assert got.shape == (1537,)
         np.testing.assert_allclose(got, base, atol=3e-6)
 
+    def test_standard_wide_features(self, models, strategy):
+        # F=24 > _SELECT_MAX_FEATURES drives the dense path's one-hot
+        # HIGHEST-precision contraction branch (the production path for
+        # wide data, e.g. the F=274 configs); without this, only the
+        # small-F select branch is ever exercised by CI
+        rng = np.random.default_rng(3)
+        Xw = rng.normal(size=(2048, 24)).astype(np.float32)
+        from isoforest_tpu import IsolationForest
+        from isoforest_tpu.ops.dense_traversal import _SELECT_MAX_FEATURES
+
+        assert Xw.shape[1] > _SELECT_MAX_FEATURES
+        m = IsolationForest(num_estimators=10, random_seed=1).fit(Xw)
+        base = score_matrix(m.forest, Xw, m.num_samples, strategy="gather")
+        got = score_matrix(m.forest, Xw, m.num_samples, strategy=strategy)
+        np.testing.assert_allclose(got, base, atol=3e-6)
+
     def test_edge_row_counts(self, models, strategy):
         # zero and single-row inputs must work on every strategy
         X, std, _ = models

@@ -1,0 +1,347 @@
+"""On-chip A/B experiments for the dense scoring kernel.
+
+The measured headline scoring leg is HBM-bound (benchmarks/README.md): the
+current dense formulation materialises a ``[C, M]`` f32 feature-selection
+matrix per tree before the compare, and the level walk keeps row-major
+``[C, W]`` bools whose minor dim underfills the 128-lane VPU for W < 128.
+Each variant here attacks that traffic; this script times them all on the
+live backend against the shipped kernel and checks bitwise agreement.
+
+Run (tunnel live):  python tools/dense_experiments.py --rows 524288
+Off-chip mechanics: JAX_PLATFORMS=cpu python tools/dense_experiments.py --rows 8192
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _time(fn, *args, reps: int = 3) -> float:
+    r = fn(*args)
+    jax.block_until_ready(r)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _old_level_walk(B, is_internal, leaf_value, h):
+    """Round-2-era eager level walk over a precomputed [C, M] bit matrix
+    (kept here so the historical variants stay runnable after the shipped
+    kernel moved to the lazy per-level formulation)."""
+    C = B.shape[0]
+    total = jnp.zeros((C,), jnp.float32)
+    reach = jnp.ones((C, 1), jnp.bool_)
+    for level in range(h + 1):
+        start = (1 << level) - 1
+        width = 1 << level
+        internal_l = is_internal[start : start + width]
+        value_l = leaf_value[start : start + width]
+        total = total + jnp.einsum("cw,w->c", reach.astype(jnp.float32), value_l)
+        if level < h:
+            B_l = B[:, start : start + width]
+            alive = reach & internal_l[None, :]
+            left = alive & ~B_l
+            right = alive & B_l
+            reach = jnp.stack([left, right], axis=2).reshape(C, 2 * width)
+    return total
+
+
+def _leaf_values(num_instances, h):
+    from isoforest_tpu.ops.dense_traversal import _leaf_values as _lv
+
+    return _lv(num_instances, h)
+
+
+# ---------------------------------------------------------------- variant B
+# Per-level select-based compare: never materialises [C, M]; everything per
+# level is elementwise over [C, W] and should fuse into one kernel per level.
+def standard_dense_select(forest, X):
+    from isoforest_tpu.utils.math import height_of
+
+    h = height_of(forest.max_nodes)
+    F = X.shape[1]
+    C = X.shape[0]
+
+    def one_tree(carry, tree):
+        feature, threshold, num_instances = tree
+        leaf_value = _leaf_values(num_instances, h)
+        total = jnp.zeros((C,), jnp.float32)
+        reach = jnp.ones((C, 1), jnp.bool_)
+        for level in range(h + 1):
+            start = (1 << level) - 1
+            width = 1 << level
+            value_l = leaf_value[start : start + width]
+            total = total + jnp.einsum("cw,w->c", reach.astype(jnp.float32), value_l)
+            if level < h:
+                feat_l = feature[start : start + width]
+                thr_l = threshold[start : start + width]
+                xv = jnp.zeros((C, width), X.dtype)
+                for f in range(F):
+                    xv = jnp.where(feat_l[None, :] == f, X[:, f][:, None], xv)
+                B_l = xv >= thr_l[None, :]
+                alive = reach & (feat_l >= 0)[None, :]
+                left = alive & ~B_l
+                right = alive & B_l
+                reach = jnp.stack([left, right], axis=2).reshape(C, 2 * width)
+        return carry + total, None
+
+    total, _ = lax.scan(
+        one_tree,
+        jnp.zeros((C,), jnp.float32),
+        (forest.feature, forest.threshold, forest.num_instances),
+    )
+    return total / forest.num_trees
+
+
+# ---------------------------------------------------------------- variant T
+# Same as B but transposed [W, C] layout: rows ride the 128-wide lane dim at
+# every level, widths ride sublanes; interleave is a sublane stack+reshape.
+def standard_dense_select_t(forest, X):
+    from isoforest_tpu.utils.math import height_of
+
+    h = height_of(forest.max_nodes)
+    F = X.shape[1]
+    C = X.shape[0]
+    XT = X.T  # [F, C]
+
+    def one_tree(carry, tree):
+        feature, threshold, num_instances = tree
+        leaf_value = _leaf_values(num_instances, h)
+        total = jnp.zeros((C,), jnp.float32)
+        reach = jnp.ones((1, C), jnp.bool_)
+        for level in range(h + 1):
+            start = (1 << level) - 1
+            width = 1 << level
+            value_l = leaf_value[start : start + width]
+            total = total + jnp.einsum("wc,w->c", reach.astype(jnp.float32), value_l)
+            if level < h:
+                feat_l = feature[start : start + width]
+                thr_l = threshold[start : start + width]
+                xv = jnp.zeros((width, C), X.dtype)
+                for f in range(F):
+                    xv = jnp.where(feat_l[:, None] == f, XT[f][None, :], xv)
+                B_l = xv >= thr_l[:, None]
+                alive = reach & (feat_l >= 0)[:, None]
+                left = alive & ~B_l
+                right = alive & B_l
+                reach = jnp.stack([left, right], axis=1).reshape(2 * width, C)
+        return carry + total, None
+
+    total, _ = lax.scan(
+        one_tree,
+        jnp.zeros((C,), jnp.float32),
+        (forest.feature, forest.threshold, forest.num_instances),
+    )
+    return total / forest.num_trees
+
+
+# ---------------------------------------------------------------- variant H
+# Current formulation with the one-hot contraction forced to HIGHEST matmul
+# precision (TPU default is bf16-mantissa passes — a silent exactness bug
+# for the feature-selection trick; this measures the cost of fixing it
+# while keeping the matmul form, which scales to large F).
+def standard_dense_hp(forest, X):
+    from isoforest_tpu.utils.math import height_of
+
+    h = height_of(forest.max_nodes)
+    F = X.shape[1]
+    C = X.shape[0]
+
+    def one_tree(carry, tree):
+        feature, threshold, num_instances = tree
+        foh = jax.nn.one_hot(jnp.maximum(feature, 0), F, dtype=X.dtype)
+        xv = jnp.einsum("cf,mf->cm", X, foh, precision=lax.Precision.HIGHEST)
+        B = xv >= threshold[None, :]
+        pl = _old_level_walk(B, feature >= 0, _leaf_values(num_instances, h), h)
+        return carry + pl, None
+
+    total, _ = lax.scan(
+        one_tree,
+        jnp.zeros((X.shape[0],), jnp.float32),
+        (forest.feature, forest.threshold, forest.num_instances),
+    )
+    return total / forest.num_trees
+
+
+# ---------------------------------------------------------------- variant D
+# Current formulation with the [C, M] intermediate in bf16 (halved traffic;
+# compare precision relaxed — NOT reference-exact, measurement only).
+def standard_dense_bf16(forest, X):
+    from isoforest_tpu.utils.math import height_of
+
+    h = height_of(forest.max_nodes)
+    F = X.shape[1]
+
+    def one_tree(carry, tree):
+        feature, threshold, num_instances = tree
+        foh = jax.nn.one_hot(jnp.maximum(feature, 0), F, dtype=jnp.bfloat16)
+        xv = jnp.einsum("cf,mf->cm", X.astype(jnp.bfloat16), foh)
+        B = xv >= threshold[None, :].astype(jnp.bfloat16)
+        leaf_value = _leaf_values(num_instances, h)
+        pl = _old_level_walk(B, feature >= 0, leaf_value, h)
+        return carry + pl, None
+
+    total, _ = lax.scan(
+        one_tree,
+        jnp.zeros((X.shape[0],), jnp.float32),
+        (forest.feature, forest.threshold, forest.num_instances),
+    )
+    return total / forest.num_trees
+
+
+# ---------------------------------------------------------------- variant E
+# Extended forest: per-level matmul slices instead of the [C, M] dots —
+# the [C, W] outputs at most half-materialise and the compare can fuse.
+def extended_dense_perlevel(forest, X, hp: bool = False):
+    from isoforest_tpu.utils.math import height_of
+
+    h = height_of(forest.max_nodes)
+    F = X.shape[1]
+    C = X.shape[0]
+
+    def one_tree(carry, tree):
+        indices, weights, offset, num_instances = tree
+        foh = jax.nn.one_hot(jnp.maximum(indices, 0), F, dtype=X.dtype)  # [M,k,F]
+        valid = (indices >= 0).astype(X.dtype)
+        prec_d = lax.Precision.HIGHEST if hp else None
+        W = jnp.einsum(
+            "mk,mkf->mf", weights * valid, foh, precision=prec_d
+        )  # [M, F] — hp matches the shipped extended_path_lengths_dense
+        leaf_value = _leaf_values(num_instances, h)
+        total = jnp.zeros((C,), jnp.float32)
+        reach = jnp.ones((C, 1), jnp.bool_)
+        for level in range(h + 1):
+            start = (1 << level) - 1
+            width = 1 << level
+            value_l = leaf_value[start : start + width]
+            total = total + jnp.einsum("cw,w->c", reach.astype(jnp.float32), value_l)
+            if level < h:
+                W_l = W[start : start + width]  # [W, F]
+                off_l = offset[start : start + width]
+                prec = lax.Precision.HIGHEST if hp else None
+                dots = jnp.matmul(X, W_l.T, precision=prec)  # [C, W]
+                B_l = dots >= off_l[None, :]
+                alive = reach & (indices[start : start + width, 0] >= 0)[None, :]
+                left = alive & ~B_l
+                right = alive & B_l
+                reach = jnp.stack([left, right], axis=2).reshape(C, 2 * width)
+        return carry + total, None
+
+    total, _ = lax.scan(
+        one_tree,
+        jnp.zeros((C,), jnp.float32),
+        (forest.indices, forest.weights, forest.offset, forest.num_instances),
+    )
+    return total / forest.num_trees
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1 << 19)
+    ap.add_argument("--features", type=int, default=3)
+    ap.add_argument("--trees", type=int, default=100)
+    ap.add_argument("--skip-extended", action="store_true")
+    args = ap.parse_args()
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from isoforest_tpu import ExtendedIsolationForest, IsolationForest
+    from isoforest_tpu.ops.dense_traversal import (
+        standard_path_lengths_dense,
+        extended_path_lengths_dense,
+    )
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.normal(size=(args.rows, args.features)), jnp.float32)
+    forest = IsolationForest(num_estimators=args.trees, random_seed=1).fit(
+        np.asarray(X)
+    ).forest
+    jax.block_until_ready(forest.feature)
+
+    out = {"metric": "dense_experiments_standard", "platform": platform,
+           "rows": args.rows, "features": args.features, "trees": args.trees,
+           "timings": {}, "agree": {}}
+
+    # ground truth: the pointer-walk is pure elementwise f32 — no matmul
+    # precision in play. Slow on TPU but exact; run it once on a slice.
+    from isoforest_tpu.ops.traversal import standard_path_lengths
+
+    g_rows = min(args.rows, 1 << 15)
+    truth = jax.jit(standard_path_lengths)(forest, X[:g_rows])
+
+    base_fn = jax.jit(standard_path_lengths_dense)
+    ref = base_fn(forest, X)
+    out["timings"]["current"] = round(_time(base_fn, forest, X), 4)
+    out["agree"]["current_vs_gather"] = float(
+        jnp.max(jnp.abs(ref[:g_rows] - truth))
+    )
+
+    for name, fn in (
+        ("select", standard_dense_select),
+        ("select_t", standard_dense_select_t),
+        ("hp", standard_dense_hp),
+        ("bf16", standard_dense_bf16),
+    ):
+        jfn = jax.jit(fn)
+        try:
+            got = jfn(forest, X)
+            out["timings"][name] = round(_time(jfn, forest, X), 4)
+            out["agree"][name + "_vs_gather"] = float(
+                jnp.max(jnp.abs(got[:g_rows] - truth))
+            )
+        except Exception as e:  # noqa: BLE001 - record and continue
+            out["timings"][name] = f"error: {type(e).__name__}: {str(e)[:160]}"
+    print(json.dumps(out), flush=True)
+
+    if not args.skip_extended:
+        eforest = ExtendedIsolationForest(
+            num_estimators=args.trees, random_seed=1
+        ).fit(np.asarray(X)).forest
+        jax.block_until_ready(eforest.offset)
+        out2 = {"metric": "dense_experiments_extended", "platform": platform,
+                "rows": args.rows, "timings": {}, "agree": {}}
+        from isoforest_tpu.ops.traversal import extended_path_lengths
+
+        truth_e = jax.jit(extended_path_lengths)(eforest, X[:g_rows])
+        base_e = jax.jit(extended_path_lengths_dense)
+        ref_e = base_e(eforest, X)
+        out2["timings"]["current"] = round(_time(base_e, eforest, X), 4)
+        out2["agree"]["current_vs_gather"] = float(
+            jnp.max(jnp.abs(ref_e[:g_rows] - truth_e))
+        )
+        for name, fn in (
+            ("perlevel", extended_dense_perlevel),
+            ("perlevel_hp", functools.partial(extended_dense_perlevel, hp=True)),
+        ):
+            jfn = jax.jit(fn)
+            try:
+                got = jfn(eforest, X)
+                out2["timings"][name] = round(_time(jfn, eforest, X), 4)
+                out2["agree"][name + "_vs_gather"] = float(
+                    jnp.max(jnp.abs(got[:g_rows] - truth_e))
+                )
+            except Exception as e:  # noqa: BLE001
+                out2["timings"][name] = f"error: {type(e).__name__}: {str(e)[:160]}"
+        print(json.dumps(out2), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
