@@ -167,19 +167,36 @@ def _score_chunk(forest, X, num_samples: int, strategy: str = "dense") -> jax.Ar
     return score_from_path_length(pl, num_samples)
 
 
+# Measured on a live v5e (2026-07-29, 524k rows x 100 trees, dense): bigger
+# chunks win monotonically — 0.81 s at 2^17, 0.64 s at 2^18, 0.53 s at 2^19
+# (single chunk) vs 0.35 s for the raw kernel on resident data; the gap is
+# per-chunk dispatch + tunnel transfer overhead. CPU keeps the smaller
+# working set (the XLA:CPU paths are latency- not dispatch-bound).
+PLATFORM_DEFAULT_CHUNK = {"tpu": 1 << 19, "cpu": 1 << 18}
+
+
+def _default_chunk_size() -> int:
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:  # backend bring-up failed; CPU default is safe
+        platform = "cpu"
+    return PLATFORM_DEFAULT_CHUNK.get(platform, 1 << 18)
+
+
 def score_matrix(
     forest,
     X,
     num_samples: int,
-    chunk_size: int = 1 << 18,
+    chunk_size: int | None = None,
     strategy: str = "auto",
 ) -> np.ndarray:
     """Score a full ``[N, F]`` matrix, chunked along rows.
 
     Chunking bounds the traversal state so big-N scoring streams through a
-    fixed working set. Row counts are always padded up to a power-of-two
-    bucket (min 1024) so varying batch sizes reuse a handful of compiled
-    programs instead of recompiling per distinct ``n``.
+    fixed working set; ``chunk_size=None`` resolves the measured per-backend
+    default (:data:`PLATFORM_DEFAULT_CHUNK`). Row counts are always padded
+    up to a power-of-two bucket (min 1024) so varying batch sizes reuse a
+    handful of compiled programs instead of recompiling per distinct ``n``.
 
     ``strategy``:
       * ``"gather"`` — pointer-walk formulation, ``O(C * h)`` gathers.
@@ -244,6 +261,8 @@ def score_matrix(
         def run_chunk(chunk):
             return _score_chunk(forest, chunk, num_samples, strategy)
 
+    if chunk_size is None:
+        chunk_size = _default_chunk_size()
     X = jnp.asarray(X, jnp.float32)
     n = X.shape[0]
     if n == 0:
@@ -255,6 +274,9 @@ def score_matrix(
             X = jnp.pad(X, ((0, pad), (0, 0)))
         return np.asarray(run_chunk(X)[:n])
 
+    # dispatch every chunk before pulling any result back: jax dispatch is
+    # async, so device compute overlaps the (tunnel-expensive on TPU)
+    # device->host transfers instead of serialising on a per-chunk sync
     outs = []
     for start in range(0, n, chunk_size):
         chunk = X[start : start + chunk_size]
@@ -262,5 +284,5 @@ def score_matrix(
         if pad:
             chunk = jnp.pad(chunk, ((0, pad), (0, 0)))
         scores = run_chunk(chunk)
-        outs.append(np.asarray(scores[: chunk_size - pad] if pad else scores))
-    return np.concatenate(outs)
+        outs.append(scores[: chunk_size - pad] if pad else scores)
+    return np.concatenate([np.asarray(o) for o in outs])
