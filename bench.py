@@ -77,10 +77,21 @@ def _time_strategies(model, sl: np.ndarray) -> dict:
 
 def _pick_strategy(model, X: np.ndarray) -> str:
     """Auto-tune the traversal strategy on the live backend: time each
-    candidate on a slice and pin the winner via ISOFOREST_TPU_STRATEGY."""
+    candidate on a slice and pin the winner via ISOFOREST_TPU_STRATEGY.
+
+    The slice must match the headline's batch regime. Strategy rankings are
+    regime-dependent on TPU (measured 2026-07-29 on a live v5e): pallas is
+    one fused launch and wins small batches (0.31 s vs dense 0.73 s at
+    131k rows — dense's scan has a ~0.6 s launch-overhead floor), while
+    dense wins large batches (1.10 s vs pallas 2.21 s at the 1M headline).
+    A 131k-row probe therefore picked the wrong headline strategy; probe at
+    the full chunk size the headline will actually run."""
     import os
 
-    timings = _time_strategies(model, X[: 1 << 17])
+    import jax
+
+    probe_rows = 1 << 19 if jax.devices()[0].platform == "tpu" else 1 << 17
+    timings = _time_strategies(model, X[:probe_rows])
     if not timings:
         print("[bench] all strategies failed to time; defaulting to gather", file=sys.stderr)
         os.environ["ISOFOREST_TPU_STRATEGY"] = "gather"
@@ -205,9 +216,16 @@ def _roofline(strategy: str, n: int, f: int, elapsed_s: float, platform: str) ->
     pass, as fractions of the platform's peaks.
 
     Scoring models per strategy (T trees, M heap slots, height h):
-      * dense/pallas — one-hot select matmul ``2*N*F*M*T`` + level walk
-        ``~6*N*M*T`` flops; bytes = X once + node tables re-streamed per
-        row chunk + scores out.
+      * dense — comparisons + level walk ``2*N*F*M*T + 6*N*M*T`` flops;
+        HBM traffic is dominated by the per-(row, node) walk intermediates
+        that XLA materialises between level fusions, modelled as
+        ``~6 bytes * N * M * T`` — the constant is *calibrated* against a
+        measured point (524k rows x 100 trees in 0.35 s on a v5e ≈ 5.5
+        B/(row·node) at the 819 GB/s ceiling), not derived; the earlier
+        model counted only node-table bytes and reported a nonsensical
+        0.018 GB for a ~300 GB pass.
+      * pallas — same flops; the walk lives in VMEM, so HBM bytes are just
+        X + node tables per row block (C_blk=1024) + scores.
       * gather — ``~4*N*T*h`` flops; bytes dominated by data-dependent node
         record reads ``8*N*T*h`` (worst case, uncached).
     Growth: per level a min/max scan over every bag — ``~2*T*S*F*h`` flops
@@ -216,10 +234,13 @@ def _roofline(strategy: str, n: int, f: int, elapsed_s: float, platform: str) ->
     t, s = NUM_TREES, NUM_SAMPLES
     h = int(np.ceil(np.log2(s)))
     m = (1 << (h + 1)) - 1
-    chunks = max(1, n >> 18)
-    if strategy in ("dense", "pallas"):
+    if strategy == "dense":
         flops = 2.0 * n * f * m * t + 6.0 * n * m * t
-        bytes_moved = 4.0 * n * f + 12.0 * t * m * chunks + 4.0 * n
+        bytes_moved = 6.0 * n * m * t + 4.0 * n * f + 4.0 * n
+    elif strategy == "pallas":
+        flops = 2.0 * n * f * m * t + 6.0 * n * m * t
+        blocks = max(1, n // 1024)
+        bytes_moved = 4.0 * n * f + 12.0 * t * m * blocks + 4.0 * n
     else:  # gather / native pointer walks
         flops = 4.0 * n * t * h
         bytes_moved = 8.0 * n * t * h + 4.0 * n * f
