@@ -88,10 +88,9 @@ def _pick_strategy(model, X: np.ndarray) -> str:
     the full chunk size the headline will actually run."""
     import os
 
-    import jax
+    from isoforest_tpu.ops.traversal import _default_chunk_size
 
-    probe_rows = 1 << 19 if jax.devices()[0].platform == "tpu" else 1 << 17
-    timings = _time_strategies(model, X[:probe_rows])
+    timings = _time_strategies(model, X[: _default_chunk_size()])
     if not timings:
         print("[bench] all strategies failed to time; defaulting to gather", file=sys.stderr)
         os.environ["ISOFOREST_TPU_STRATEGY"] = "gather"
@@ -238,8 +237,10 @@ def _roofline(strategy: str, n: int, f: int, elapsed_s: float, platform: str) ->
         flops = 2.0 * n * f * m * t + 6.0 * n * m * t
         bytes_moved = 6.0 * n * m * t + 4.0 * n * f + 4.0 * n
     elif strategy == "pallas":
+        from isoforest_tpu.ops.pallas_traversal import _ROW_BLOCK
+
         flops = 2.0 * n * f * m * t + 6.0 * n * m * t
-        blocks = max(1, n // 1024)
+        blocks = max(1, -(-n // _ROW_BLOCK))  # kernel pads rows up to a block
         bytes_moved = 4.0 * n * f + 12.0 * t * m * blocks + 4.0 * n
     else:  # gather / native pointer walks
         flops = 4.0 * n * t * h

@@ -33,6 +33,10 @@ from ..utils.math import height_of as _height_of
 from .tree_growth import StandardForest
 
 _ROW_BLOCK = 1024
+# Same crossover as dense_traversal._SELECT_MAX_FEATURES (measured on a live
+# v5e): below this, per-feature select passes beat the lane-padded one-hot
+# contraction (which runs [C, 128] @ [128, M] regardless of true F).
+_SELECT_MAX_FEATURES = 16
 # Mosaic tiles f32 as (8, 128) sublane x lane; node tables and the feature
 # axis are padded to lane multiples so every block is natively tileable
 # (511-wide tables and raw F were the round-1 hardware-compile risk).
@@ -129,7 +133,7 @@ def _bcast_rows(row, c: int, precision=None):
     )
 
 
-def _standard_kernel(h, T, x_ref, feat_ref, thr_ref, leaf_ref, out_ref):
+def _standard_kernel(h, T, f_raw, x_ref, feat_ref, thr_ref, leaf_ref, out_ref):
     t = pl.program_id(1)
     x = x_ref[...]  # [C_blk, F_pad]
     # node-table refs are [1, 1, M_pad] blocks (trailing two dims equal the
@@ -139,18 +143,30 @@ def _standard_kernel(h, T, x_ref, feat_ref, thr_ref, leaf_ref, out_ref):
     thr = thr_ref[0]
     f_pad = x.shape[1]
     m_pad = feature.shape[1]
-    # One-hot feature selection as a single MXU contraction (the formulation
-    # dense_traversal.py uses; the round-1 per-feature unrolled loop was
-    # O(F * C * M) VPU passes and could not scale to the F=274 configs).
-    # sel[f, m] = 1 iff node m splits on feature f; padded slots match no f.
-    # Mosaic requires integer iota, hence the int32 feature table.
-    iota_f = jax.lax.broadcasted_iota(jnp.int32, (f_pad, m_pad), 0)
-    sel = (iota_f == feature).astype(jnp.float32)  # [F_pad, M_pad]
-    xv = jax.lax.dot_general(
-        x, sel, (((1,), (0,)), ((), ())), precision=jax.lax.Precision.HIGHEST, preferred_element_type=jnp.float32
-    )  # [C_blk, M_pad]
+    c_blk = x.shape[0]
+    if f_raw <= _SELECT_MAX_FEATURES:
+        # Per-feature select chain (pure VPU), mirroring dense_traversal's
+        # small-F dispatch. The one-hot contraction below runs over the
+        # lane-PADDED F axis — [C, 128] @ [128, M] at HIGHEST precision is
+        # ~42x the needed flops at F=3 and dominated the measured 1.04 s
+        # pallas score at 1M rows; F masked passes over [C_blk, M_pad] are
+        # O(F * C * M) VPU work with no padding amplification. (The round-1
+        # worry about this loop was F=274 configs — those still take the
+        # matmul branch.)
+        xv = jnp.zeros((c_blk, m_pad), jnp.float32)
+        for f in range(f_raw):
+            xv = jnp.where(feature == f, x[:, f : f + 1], xv)
+    else:
+        # One-hot feature selection as a single MXU contraction (the
+        # formulation dense_traversal.py uses for wide F).
+        # sel[f, m] = 1 iff node m splits on feature f; padded slots match
+        # no f. Mosaic requires integer iota, hence the int32 feature table.
+        iota_f = jax.lax.broadcasted_iota(jnp.int32, (f_pad, m_pad), 0)
+        sel = (iota_f == feature).astype(jnp.float32)  # [F_pad, M_pad]
+        xv = jax.lax.dot_general(
+            x, sel, (((1,), (0,)), ((), ())), precision=jax.lax.Precision.HIGHEST, preferred_element_type=jnp.float32
+        )  # [C_blk, M_pad]
     B = (xv >= thr).astype(jnp.float32)
-    c_blk = xv.shape[0]
     hp = jax.lax.Precision.HIGHEST
     internal = _bcast_rows((feature >= 0).astype(jnp.float32), c_blk, hp)
     pl_len = _walk_levels(B, internal, _bcast_rows(leaf_ref[0], c_blk, hp), h)
@@ -236,14 +252,14 @@ def _vmem_spec(block_shape, index_map):
     return pl.BlockSpec(block_shape, index_map, **kw)
 
 
-@functools.partial(jax.jit, static_argnames=("h", "interpret"))
-def _standard_pallas(X, feature_f32, threshold, leaf_value, h, interpret=False):
+@functools.partial(jax.jit, static_argnames=("h", "f_raw", "interpret"))
+def _standard_pallas(X, feature_f32, threshold, leaf_value, h, f_raw, interpret=False):
     C, Fp = X.shape
     T, _, Mp = threshold.shape
     grid = (C // _ROW_BLOCK, T)
     table = _vmem_spec((1, 1, Mp), lambda rb, t: (t, 0, 0))
     return pl.pallas_call(
-        functools.partial(_standard_kernel, h, T),
+        functools.partial(_standard_kernel, h, T, f_raw),
         grid=grid,
         in_specs=[
             _vmem_spec((_ROW_BLOCK, Fp), lambda rb, t: (rb, 0)),
@@ -420,7 +436,7 @@ def path_lengths_pallas(forest, X, interpret: bool = False) -> jax.Array:
 
         feature_f32, threshold, leaf_value = _cached_prep(forest, build_standard)
         out = _standard_pallas(
-            X, feature_f32, threshold, leaf_value, h, interpret=interpret
+            X, feature_f32, threshold, leaf_value, h, F, interpret=interpret
         )
     else:
 

@@ -61,8 +61,19 @@ def main() -> int:
     h = height_of(forest.max_nodes)
     m_pad = pt._pad_lanes(forest.max_nodes)
     feat, thr, leaf = pt.standard_tables(forest, m_pad, h)
-    aot(lambda a, b, c, d: pt._standard_pallas(a, b, c, d, h), Xp, feat, thr, leaf)
+    aot(lambda a, b, c, d: pt._standard_pallas(a, b, c, d, h, X.shape[1]), Xp, feat, thr, leaf)
     print("standard: machine compile ok", flush=True)
+
+    # wide-F variant: f_raw above _SELECT_MAX_FEATURES takes the one-hot
+    # MXU-contraction branch instead of the select chain — both kernel
+    # bodies must survive machine compilation
+    aot(
+        lambda a, b, c, d: pt._standard_pallas(
+            a, b, c, d, h, pt._SELECT_MAX_FEATURES + 1
+        ),
+        Xp, feat, thr, leaf,
+    )
+    print("standard wide-F: machine compile ok", flush=True)
 
     forest = ext.forest
     h = height_of(forest.max_nodes)

@@ -247,7 +247,7 @@ class TestPallasMosaicMachineCompile:
             f"Mosaic machine compile failed (rc={out.returncode}):\n"
             f"{out.stdout[-500:]}\n{out.stderr[-2000:]}"
         )
-        assert out.stdout.count("machine compile ok") == 3
+        assert out.stdout.count("machine compile ok") == 4
 
 
 class TestPallasTpuLowering:
@@ -277,7 +277,7 @@ class TestPallasTpuLowering:
         h = height_of(forest.max_nodes)
         m_pad = pt._pad_lanes(forest.max_nodes)
         feat, thr, leaf = pt.standard_tables(forest, m_pad, h)
-        self._lower(lambda a, b, c, d: pt._standard_pallas(a, b, c, d, h), Xp, feat, thr, leaf)
+        self._lower(lambda a, b, c, d: pt._standard_pallas(a, b, c, d, h, X.shape[1]), Xp, feat, thr, leaf)
 
     def test_extended_kernel_lowers_for_tpu(self, models):
         import jax.numpy as jnp
