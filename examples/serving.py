@@ -9,6 +9,13 @@ The scoring strategy resolves per backend (`strategy="auto"`): the native
 C++ walker on CPU (no XLA program — warmup primes its per-forest prep
 cache), the dense MXU level-walk on TPU (warmup pre-compiles the bucketed
 XLA programs so no live request pays compilation).
+
+TPU latency note (measured on a live v5e, benchmarks/README.md): for
+*small* per-request batches the Pallas kernel is a single fused launch and
+beats the dense scan's ~0.6 s launch-overhead floor by ~2x (0.31 s vs
+0.73 s at 131k rows, further ahead at smaller batches) — latency-sensitive
+TPU serving loops should pin ``ISOFOREST_TPU_STRATEGY=pallas``; the auto
+default optimises bulk throughput.
 """
 
 import os
