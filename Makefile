@@ -1,13 +1,18 @@
 # One-command validation of a fresh checkout — the analogue of the
 # reference's CI gates (.github/workflows/ci.yml: build + test matrix;
 # isolation-forest-onnx/setup.cfg: flake8/mypy/coverage). The image ships no
-# external linters, so lint is the in-repo AST gate (tools/lint.py).
+# external linters, so lint is the in-repo AST gate (tools/lint.py) and
+# coverage is the sys.monitoring gate (tools/coverage_gate.py, >=90% on the
+# ONNX subpackage — reference setup.cfg [coverage:report] fail_under=90).
 
 PY ?= python3
 
-.PHONY: check lint test bench dryrun
+.PHONY: check lint test coverage bench dryrun
 
-check: lint test
+check: lint test coverage
+
+coverage:
+	$(PY) tools/coverage_gate.py
 
 lint:
 	$(PY) tools/lint.py

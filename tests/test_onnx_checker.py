@@ -209,3 +209,241 @@ class TestCheckerRejects:
         X = np.array([[0.0, 0.0], [1.0, 0.0]], np.float32)
         out = reference_scores(bts, X)
         assert out[0, 0] == 1.0 and out[1, 0] == 2.0
+
+
+def _tiny_graph_parts():
+    """(input_vi, output_vi) for the hand-assembled model-level tests."""
+    return (
+        proto.value_info("features", proto.FLOAT, ["batch", 2]),
+        proto.value_info("path", proto.FLOAT, ["batch", 1]),
+    )
+
+
+class TestCheckerRejectsModelLevel:
+    """Model/graph-level violations (the branches TestCheckerRejects'
+    ensemble mutations cannot reach) — each must raise a pointed
+    CheckError, mirroring onnx.checker.check_model's model surface."""
+
+    def test_bad_ir_version(self):
+        g_in, g_out = _tiny_graph_parts()
+        neg = proto.node("Neg", ["features"], ["path"])
+        graph = proto.graph(
+            nodes=[neg], name="g", inputs=[g_in], outputs=[g_out], initializers=[]
+        )
+        with pytest.raises(CheckError, match="ir_version"):
+            check_model(proto.model(graph, opset_imports=[("", 14)], ir_version=99))
+
+    def test_no_opsets(self):
+        g_in, g_out = _tiny_graph_parts()
+        neg = proto.node("Neg", ["features"], ["path"])
+        graph = proto.graph(
+            nodes=[neg], name="g", inputs=[g_in], outputs=[g_out], initializers=[]
+        )
+        with pytest.raises(CheckError, match="no opset_import"):
+            check_model(proto.model(graph, opset_imports=[]))
+
+    def test_zero_opset_version(self):
+        g_in, g_out = _tiny_graph_parts()
+        neg = proto.node("Neg", ["features"], ["path"])
+        graph = proto.graph(
+            nodes=[neg], name="g", inputs=[g_in], outputs=[g_out], initializers=[]
+        )
+        with pytest.raises(CheckError, match="no valid version"):
+            check_model(proto.model(graph, opset_imports=[("", 0)]))
+
+    def test_empty_graph(self):
+        g_in, g_out = _tiny_graph_parts()
+        graph = proto.graph(
+            nodes=[], name="g", inputs=[g_in], outputs=[g_out], initializers=[]
+        )
+        with pytest.raises(CheckError, match="no nodes"):
+            check_model(proto.model(graph, opset_imports=[("", 14)]))
+
+    def test_empty_graph_name(self):
+        g_in, g_out = _tiny_graph_parts()
+        neg = proto.node("Neg", ["features"], ["path"])
+        graph = proto.graph(
+            nodes=[neg], name="", inputs=[g_in], outputs=[g_out], initializers=[]
+        )
+        with pytest.raises(CheckError, match="graph name"):
+            check_model(proto.model(graph, opset_imports=[("", 14)]))
+
+    def test_missing_outputs(self):
+        g_in, _ = _tiny_graph_parts()
+        neg = proto.node("Neg", ["features"], ["path"])
+        graph = proto.graph(
+            nodes=[neg], name="g", inputs=[g_in], outputs=[], initializers=[]
+        )
+        with pytest.raises(CheckError, match="declare inputs and outputs"):
+            check_model(proto.model(graph, opset_imports=[("", 14)]))
+
+    def test_empty_value_name(self):
+        _, g_out = _tiny_graph_parts()
+        neg = proto.node("Neg", ["features"], ["path"])
+        graph = proto.graph(
+            nodes=[neg],
+            name="g",
+            inputs=[proto.value_info("", proto.FLOAT, ["batch", 2])],
+            outputs=[g_out],
+            initializers=[],
+        )
+        with pytest.raises(CheckError, match="empty name"):
+            check_model(proto.model(graph, opset_imports=[("", 14)]))
+
+    def test_invalid_elem_type(self):
+        _, g_out = _tiny_graph_parts()
+        neg = proto.node("Neg", ["features"], ["path"])
+        graph = proto.graph(
+            nodes=[neg],
+            name="g",
+            inputs=[proto.value_info("features", 99, ["batch", 2])],
+            outputs=[g_out],
+            initializers=[],
+        )
+        with pytest.raises(CheckError, match="invalid elem_type"):
+            check_model(proto.model(graph, opset_imports=[("", 14)]))
+
+    def test_unexpected_op(self):
+        g_in, g_out = _tiny_graph_parts()
+        relu = proto.node("Relu", ["features"], ["path"])
+        graph = proto.graph(
+            nodes=[relu], name="g", inputs=[g_in], outputs=[g_out], initializers=[]
+        )
+        with pytest.raises(CheckError, match="unexpected op"):
+            check_model(proto.model(graph, opset_imports=[("", 14)]))
+
+    def test_wrong_domain(self):
+        g_in, g_out = _tiny_graph_parts()
+        neg = proto.node("Neg", ["features"], ["path"], domain="ai.onnx.ml")
+        graph = proto.graph(
+            nodes=[neg], name="g", inputs=[g_in], outputs=[g_out], initializers=[]
+        )
+        with pytest.raises(CheckError, match="domain"):
+            check_model(
+                proto.model(graph, opset_imports=[("ai.onnx.ml", 1), ("", 14)])
+            )
+
+    def test_bad_arity(self):
+        g_in, g_out = _tiny_graph_parts()
+        neg = proto.node("Neg", ["features", "features"], ["path"])
+        graph = proto.graph(
+            nodes=[neg], name="g", inputs=[g_in], outputs=[g_out], initializers=[]
+        )
+        with pytest.raises(CheckError, match="arity"):
+            check_model(proto.model(graph, opset_imports=[("", 14)]))
+
+    def test_missing_required_attr(self):
+        g_in, g_out = _tiny_graph_parts()
+        cast = proto.node("Cast", ["features"], ["path"])  # no 'to'
+        graph = proto.graph(
+            nodes=[cast], name="g", inputs=[g_in], outputs=[g_out], initializers=[]
+        )
+        with pytest.raises(CheckError, match="missing required attribute"):
+            check_model(proto.model(graph, opset_imports=[("", 14)]))
+
+    def test_duplicate_output_names(self):
+        g_in, g_out = _tiny_graph_parts()
+        n1 = proto.node("Neg", ["features"], ["path"])
+        n2 = proto.node("Neg", ["features"], ["path"])
+        graph = proto.graph(
+            nodes=[n1, n2], name="g", inputs=[g_in], outputs=[g_out], initializers=[]
+        )
+        with pytest.raises(CheckError, match="duplicate output"):
+            check_model(proto.model(graph, opset_imports=[("", 14)]))
+
+    def test_cast_invalid_dtype(self):
+        g_in, g_out = _tiny_graph_parts()
+        cast = proto.node(
+            "Cast", ["features"], ["path"], attributes=[proto.attribute("to", 99)]
+        )
+        graph = proto.graph(
+            nodes=[cast], name="g", inputs=[g_in], outputs=[g_out], initializers=[]
+        )
+        with pytest.raises(CheckError, match="invalid 'to'"):
+            check_model(proto.model(graph, opset_imports=[("", 14)]))
+
+    def test_unproduced_graph_output(self):
+        g_in, _ = _tiny_graph_parts()
+        neg = proto.node("Neg", ["features"], ["mid"])
+        graph = proto.graph(
+            nodes=[neg],
+            name="g",
+            inputs=[g_in],
+            outputs=[proto.value_info("ghost", proto.FLOAT, ["batch", 1])],
+            initializers=[],
+        )
+        with pytest.raises(CheckError, match="never produced"):
+            check_model(proto.model(graph, opset_imports=[("", 14)]))
+
+    def test_bad_post_transform(self):
+        with pytest.raises(CheckError, match="post_transform"):
+            check_model(
+                _tiny_valid_graph(ensemble_attrs={"post_transform": "RELU"})
+            )
+
+    def test_target_ids_out_of_range(self):
+        with pytest.raises(CheckError, match="target_ids"):
+            check_model(_tiny_valid_graph(ensemble_attrs={"target_ids": [0, 5]}))
+
+    def test_tree_without_root(self):
+        # tree 1 contributes nodes but none with node id 0
+        with pytest.raises(CheckError, match="root"):
+            check_model(
+                _tiny_valid_graph(
+                    ensemble_attrs={
+                        "nodes_treeids": [0, 0, 0, 1],
+                        "nodes_nodeids": [0, 1, 2, 5],
+                        "nodes_featureids": [0, 0, 0, 0],
+                        "nodes_values": [0.5, 0.0, 0.0, 0.0],
+                        "nodes_modes": ["BRANCH_LT", "LEAF", "LEAF", "LEAF"],
+                        "nodes_truenodeids": [1, 0, 0, 0],
+                        "nodes_falsenodeids": [2, 0, 0, 0],
+                    }
+                )
+            )
+
+    def test_cyclic_node_table(self):
+        with pytest.raises(CheckError, match="cycl|reached twice"):
+            check_model(
+                _tiny_valid_graph(
+                    ensemble_attrs={
+                        "nodes_modes": ["BRANCH_LT", "BRANCH_LT", "LEAF"],
+                        "nodes_truenodeids": [1, 0, 0],
+                        "nodes_falsenodeids": [2, 2, 0],
+                    }
+                )
+            )
+
+
+class TestEvaluatorBranchModes:
+    def test_branch_leq_semantics(self):
+        # BRANCH_LEQ: x <= 0.5 -> true branch (weight 1), else 2 — equality
+        # goes TRUE here where BRANCH_LT sends it FALSE
+        bts = _tiny_valid_graph(
+            ensemble_attrs={"nodes_modes": ["BRANCH_LEQ", "LEAF", "LEAF"]}
+        )
+        X = np.array([[0.5, 0.0], [0.51, 0.0]], np.float32)
+        out = reference_scores(bts, X)
+        assert out[0, 0] == 1.0 and out[1, 0] == 2.0
+        lt = reference_scores(_tiny_valid_graph(), X)
+        assert lt[0, 0] == 2.0  # the same input on BRANCH_LT goes false
+
+    def test_extended_model_full_graph_eval(self, tmp_path):
+        # EIF export lifts hyperplanes through Constant + MatMul nodes; the
+        # independent evaluator must agree with the bundled runtime on the
+        # whole graph, not just check its structure
+        from isoforest_tpu.onnx import ExtendedIsolationForestConverter
+        from isoforest_tpu.onnx.runtime import run_model
+
+        rng = np.random.default_rng(11)
+        X = rng.normal(size=(1500, 4)).astype(np.float32)
+        model = ExtendedIsolationForest(
+            num_estimators=8, max_samples=64.0, extension_level=2, random_seed=5
+        ).fit(X)
+        model.save(str(tmp_path / "m"))
+        bts = ExtendedIsolationForestConverter(str(tmp_path / "m")).convert()
+        ours, _ = run_model(bts, {"features": X[:400]})
+        independent = reference_scores(bts, X[:400])
+        assert np.abs(ours[:, 0] - independent[:, 0]).max() < 1e-6
+        want = model.score(X[:400])
+        assert np.abs(independent[:, 0] - want).max() < 1e-5
