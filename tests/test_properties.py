@@ -511,3 +511,39 @@ class TestOnnxEndToEndProperties:
         fw = model.score(X[:64])
         assert np.abs(rt[:, 0] - fw).max() < 1e-5
         assert np.abs(ind[:, 0] - fw).max() < 1e-5
+
+
+class TestDenseDispatchBoundary:
+    """The dense scorer dispatches on feature count (select chain vs
+    HIGHEST-precision one-hot contraction, ops/dense_traversal.py). Both
+    branches — and the boundary itself — must agree with the pointer walk
+    on any data shape, including ties and constant columns."""
+
+    @given(
+        f=st.sampled_from([1, 2, 15, 16, 17, 24]),  # straddle the crossover
+        seed=st.integers(min_value=0, max_value=2**31 - 1),
+        dist=st.sampled_from(["normal", "heavy_ties", "constant_col"]),
+    )
+    @_settings
+    def test_select_and_matmul_branches_match_gather(self, f, seed, dist):
+        from isoforest_tpu import IsolationForest
+        from isoforest_tpu.ops.dense_traversal import _SELECT_MAX_FEATURES
+        from isoforest_tpu.ops.traversal import score_matrix
+
+        assert _SELECT_MAX_FEATURES in (15, 16, 17), (
+            "crossover moved - update the sampled f values to straddle it"
+        )
+        rng = np.random.default_rng(seed)
+        n = 500
+        if dist == "normal":
+            X = rng.normal(size=(n, f))
+        elif dist == "heavy_ties":
+            X = rng.choice([0.0, 1.0, 2.0], size=(n, f))
+        else:
+            X = rng.normal(size=(n, f))
+            X[:, 0] = 3.14
+        X = X.astype(np.float32)
+        m = IsolationForest(num_estimators=5, max_samples=64.0, random_seed=1).fit(X)
+        base = score_matrix(m.forest, X, m.num_samples, strategy="gather")
+        got = score_matrix(m.forest, X, m.num_samples, strategy="dense")
+        np.testing.assert_allclose(got, base, atol=3e-6)
