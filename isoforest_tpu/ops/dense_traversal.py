@@ -53,9 +53,11 @@ from .tree_growth import StandardForest
 
 # Feature-count crossover between the fused per-level select formulation and
 # the one-hot HIGHEST-precision contraction. Measured on a v5e chip
-# (tools/dense_experiments.py, 2026-07-29): F=3 select 0.35 s vs matmul
-# 0.46 s; F=274 select 1.20 s vs matmul 0.20 s.
-_SELECT_MAX_FEATURES = 16
+# (tools/dense_experiments.py + on-chip sweep, 2026-07-29): F=3 select
+# 0.35 s vs matmul 0.46 s (524k rows); at 262k rows F=8 select 0.43 vs
+# 0.46, F=16 select 0.82 vs matmul 0.79, F=24 1.22 vs 1.11, F=274 select
+# 1.20 s vs matmul 0.20 s — the flip sits between 8 and 16.
+_SELECT_MAX_FEATURES = 12
 
 
 def _level_walk(bits_fn, is_internal: jax.Array, leaf_value: jax.Array, C: int, h: int):

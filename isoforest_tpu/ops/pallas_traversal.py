@@ -36,7 +36,7 @@ _ROW_BLOCK = 1024
 # Same crossover as dense_traversal._SELECT_MAX_FEATURES (measured on a live
 # v5e): below this, per-feature select passes beat the lane-padded one-hot
 # contraction (which runs [C, 128] @ [128, M] regardless of true F).
-_SELECT_MAX_FEATURES = 16
+_SELECT_MAX_FEATURES = 12
 # Mosaic tiles f32 as (8, 128) sublane x lane; node tables and the feature
 # axis are padded to lane multiples so every block is natively tileable
 # (511-wide tables and raw F were the round-1 hardware-compile risk).
