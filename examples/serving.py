@@ -12,10 +12,14 @@ XLA programs so no live request pays compilation).
 
 TPU latency note (measured on a live v5e, benchmarks/README.md): for
 *small* per-request batches the Pallas kernel is a single fused launch and
-beats the dense scan's ~0.6 s launch-overhead floor by ~2x (0.31 s vs
-0.73 s at 131k rows, further ahead at smaller batches) — latency-sensitive
-TPU serving loops should pin ``ISOFOREST_TPU_STRATEGY=pallas``; the auto
-default optimises bulk throughput.
+beats the dense scan's launch-overhead floor by ~2x (0.31 s vs 0.73 s at
+131k rows, further ahead at smaller batches). ``strategy="auto"`` encodes
+that measured crossover (``ops/traversal.py PALLAS_MAX_ROWS``): standard-
+forest batches up to 2^18 rows take the Pallas kernel, larger ones the
+dense scan — no env var needed. ``ISOFOREST_TPU_STRATEGY`` remains an
+override. Extended forests always score through the dense HIGHEST-precision
+path on TPU: the EIF Pallas kernels are precision-fenced on the current
+toolchain (bf16-mantissa hyperplane matmuls).
 """
 
 import os

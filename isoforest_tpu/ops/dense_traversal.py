@@ -59,6 +59,23 @@ from .tree_growth import StandardForest
 # 1.20 s vs matmul 0.20 s — the flip sits between 8 and 16.
 _SELECT_MAX_FEATURES = 12
 
+# Multi-tree blocking of the tree scan (VERDICT r2 item 1): each lax.scan
+# step is an XLA While iteration whose per-step dispatch and [C, width] walk
+# intermediates are paid per tree; ``unroll=G`` processes G trees per
+# iteration so XLA fuses across tree bodies and the row chunk stays live.
+# ``None`` means the measured default; tools/unroll_sweep.py overrides the
+# module global. Measured on a live v5e (2026-07-29, 524k rows x 100
+# trees): G=1 0.532s; G in {2..100} 0.55-0.61s — unrolling is a wash-to-
+# loss on every platform, so the per-step dispatch is NOT the dense
+# bottleneck (the [C, width] walk intermediates are; benchmarks/README.md
+# round-3 section). Default therefore 1 everywhere, with no device probe.
+_SCAN_UNROLL: int | None = None
+
+
+def _scan_unroll(num_trees: int) -> int:
+    g = 1 if _SCAN_UNROLL is None else _SCAN_UNROLL
+    return max(1, min(int(g), num_trees))
+
 
 def _level_walk(bits_fn, is_internal: jax.Array, leaf_value: jax.Array, C: int, h: int):
     """Shared reach-propagation over the implicit heap.
@@ -133,6 +150,7 @@ def standard_path_lengths_dense(forest: StandardForest, X: jax.Array) -> jax.Arr
         one_tree,
         jnp.zeros((C,), jnp.float32),
         (forest.feature, forest.threshold, forest.num_instances),
+        unroll=_scan_unroll(forest.num_trees),
     )
     return total / forest.num_trees
 
@@ -168,6 +186,7 @@ def extended_path_lengths_dense(forest: ExtendedForest, X: jax.Array) -> jax.Arr
         one_tree,
         jnp.zeros((C,), jnp.float32),
         (forest.indices, forest.weights, forest.offset, forest.num_instances),
+        unroll=_scan_unroll(forest.num_trees),
     )
     return total / forest.num_trees
 

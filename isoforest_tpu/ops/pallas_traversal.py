@@ -33,10 +33,12 @@ from ..utils.math import height_of as _height_of
 from .tree_growth import StandardForest
 
 _ROW_BLOCK = 1024
-# Same crossover as dense_traversal._SELECT_MAX_FEATURES (measured on a live
-# v5e): below this, per-feature select passes beat the lane-padded one-hot
-# contraction (which runs [C, 128] @ [128, M] regardless of true F).
-_SELECT_MAX_FEATURES = 12
+# Shared feature-count crossover (measured on a live v5e): below this,
+# per-feature select passes beat the lane-padded one-hot contraction (which
+# runs [C, 128] @ [128, M] regardless of true F). Imported so the dispatch
+# boundary cannot drift between the XLA and Pallas paths (ADVICE r2):
+# ``f_raw`` is a static kernel arg, so this stays a compile-time constant.
+from .dense_traversal import _SELECT_MAX_FEATURES
 # Mosaic tiles f32 as (8, 128) sublane x lane; node tables and the feature
 # axis are padded to lane multiples so every block is natively tileable
 # (511-wide tables and raw F were the round-1 hardware-compile risk).

@@ -110,18 +110,54 @@ PLATFORM_DEFAULT_STRATEGY = {
     "tpu": "dense",
 }
 
+# Measured batch-regime crossover on a live v5e (benchmarks/README.md,
+# 2026-07-29): the Pallas kernel is a single fused launch and wins small
+# batches (0.31 s vs dense 0.73 s at 131k rows; 0.071 s vs 0.074 s at 8k
+# re-confirmed by bench.py --full), while the dense scan wins large batches
+# (1.04 s vs 2.21 s at the 1M headline; 0.53 s vs ~1.0 s at 524k rows).
+# The flip sits between 131k and 524k rows; 2^18 splits the measured
+# bracket — refine with an on-chip point at 262k when a live window allows.
+# Standard forests only: the EIF Pallas kernels are precision-fenced on
+# real TPU (see the fence in :func:`score_matrix`).
+PALLAS_MAX_ROWS = 1 << 18
+
 STRATEGIES = ("gather", "dense", "pallas", "native")
 
 _warned_native_fallback = False
+_warned_eif_pallas_fence = False
 
 
-def default_strategy() -> str:
-    """Resolve the measured/predicted best strategy for the live backend."""
+def _live_platform() -> str:
     try:
-        platform = jax.devices()[0].platform
+        return jax.devices()[0].platform
     except Exception:  # backend bring-up failed; any strategy works on CPU
-        platform = "cpu"
+        return "cpu"
+
+
+def default_strategy(
+    num_rows: int | None = None,
+    extended: bool = False,
+    platform: str | None = None,
+) -> str:
+    """Resolve the measured/predicted best strategy for the live backend.
+
+    With ``num_rows`` the TPU choice is batch-regime-aware (VERDICT r2
+    item 3): standard-forest batches at or below :data:`PALLAS_MAX_ROWS`
+    take the Pallas kernel's single fused launch; larger batches (or no
+    row-count information) keep the dense level-walk. Extended forests
+    always resolve dense on TPU — their Pallas kernels are fenced at
+    bf16-mantissa precision on the current toolchain.
+    """
+    if platform is None:
+        platform = _live_platform()
     choice = PLATFORM_DEFAULT_STRATEGY.get(platform, "gather")
+    if (
+        platform == "tpu"
+        and not extended
+        and num_rows is not None
+        and 0 < num_rows <= PALLAS_MAX_ROWS
+    ):
+        choice = "pallas"
     if choice == "native":
         from .. import native
 
@@ -176,11 +212,7 @@ PLATFORM_DEFAULT_CHUNK = {"tpu": 1 << 19, "cpu": 1 << 18}
 
 
 def _default_chunk_size() -> int:
-    try:
-        platform = jax.devices()[0].platform
-    except Exception:  # backend bring-up failed; CPU default is safe
-        platform = "cpu"
-    return PLATFORM_DEFAULT_CHUNK.get(platform, 1 << 18)
+    return PLATFORM_DEFAULT_CHUNK.get(_live_platform(), 1 << 18)
 
 
 def score_matrix(
@@ -210,14 +242,22 @@ def score_matrix(
       * ``"native"`` — hand-scheduled C++ walker (:mod:`..native` scorer),
         the CPU fast path; no jax involvement at all.
       * ``"auto"`` — ``ISOFOREST_TPU_STRATEGY`` env var if set, else the
-        per-backend default from :data:`PLATFORM_DEFAULT_STRATEGY`
-        (``jax.devices()[0].platform``: native C++ on CPU, dense on TPU) —
-        a fresh process on each backend picks its measured/predicted
-        winner with no env var and no bench run. ``bench.py`` measures
-        all strategies on the live backend and reports the ranking.
+        per-backend, batch-regime-aware default (:func:`default_strategy`:
+        native C++ on CPU; on TPU, pallas for standard-forest batches up
+        to :data:`PALLAS_MAX_ROWS` and dense above — both crossovers
+        measured on a live v5e) — a fresh process on each backend picks
+        its measured/predicted winner with no env var and no bench run.
+        ``bench.py`` measures all strategies on the live backend and
+        reports the ranking.
     """
+    if not isinstance(X, (np.ndarray, jax.Array)):
+        X = np.asarray(X, np.float32)
+    n = X.shape[0]
+    extended = not isinstance(forest, StandardForest)
     if strategy == "auto":
-        strategy = os.environ.get("ISOFOREST_TPU_STRATEGY") or default_strategy()
+        strategy = os.environ.get("ISOFOREST_TPU_STRATEGY") or default_strategy(
+            num_rows=n, extended=extended
+        )
         if strategy not in STRATEGIES:
             from ..utils import logger
 
@@ -225,14 +265,37 @@ def score_matrix(
                 "ISOFOREST_TPU_STRATEGY=%r is not one of %s; using %s",
                 strategy,
                 "/".join(STRATEGIES),
-                default_strategy(),
+                default_strategy(num_rows=n, extended=extended),
             )
-            strategy = default_strategy()
+            strategy = default_strategy(num_rows=n, extended=extended)
     if strategy not in STRATEGIES:
         raise ValueError(
             f"unknown scoring strategy {strategy!r}; expected one of "
             f"'auto', {', '.join(repr(s) for s in STRATEGIES)}"
         )
+    if strategy == "pallas" and extended and _live_platform() == "tpu":
+        # Precision fence (VERDICT r2 item 4 / ADVICE r2 medium): the EIF
+        # Pallas kernels' hyperplane contractions run at the TPU's default
+        # bf16-mantissa matmul precision — Precision.HIGHEST inside them
+        # crashes the remote Mosaic compile helper (the only compile path
+        # on this toolchain; benchmarks/tpu_probe_history.log 16:10Z) — the
+        # same error class measured at up to 0.24 max path-length deviation
+        # on the dense path before its r2 fix. CI's interpret-mode (CPU)
+        # equivalence runs are exact f32 and cannot catch it, so real-TPU
+        # extended scoring routes to the dense HIGHEST-precision path.
+        global _warned_eif_pallas_fence
+        if not _warned_eif_pallas_fence:
+            _warned_eif_pallas_fence = True
+            from ..utils import logger
+
+            logger.warning(
+                "strategy='pallas' for extended forests is fenced on TPU: "
+                "the kernel's hyperplane matmul runs at bf16-mantissa "
+                "precision on the current toolchain (measured error class: "
+                "up to 0.24 path-length deviation); scoring with the dense "
+                "HIGHEST-precision path instead"
+            )
+        strategy = "dense"
     if strategy == "native":
         out = _score_native(forest, X, num_samples)
         if out is not None:
@@ -250,7 +313,7 @@ def score_matrix(
     if strategy == "pallas":
         from .pallas_traversal import path_lengths_pallas
 
-        interpret = jax.devices()[0].platform != "tpu"
+        interpret = _live_platform() != "tpu"
 
         def run_chunk(chunk):
             pl_len = path_lengths_pallas(forest, chunk, interpret=interpret)
@@ -263,9 +326,6 @@ def score_matrix(
 
     if chunk_size is None:
         chunk_size = _default_chunk_size()
-    if not isinstance(X, (np.ndarray, jax.Array)):
-        X = np.asarray(X, np.float32)
-    n = X.shape[0]
     if n == 0:
         return np.zeros((0,), np.float32)
     if n <= chunk_size:

@@ -1,0 +1,101 @@
+"""Re-probe the Pallas row-block ceiling on the live toolchain.
+
+Round-2 finding (benchmarks/README.md): row blocks of 2048/4096/8192
+consistently crashed the remote Mosaic compile helper while 1024 compiled,
+pinning the kernel at 512 row-blocks x 100 trees = 51k grid steps of
+table-DMA + fixed overhead — the measured residual vs the dense XLA path.
+VERDICT r3 item 1 asks to re-probe whenever the helper updates.
+
+Usage: python tools/pallas_block_sweep.py [--rows N] [--trees T] [--eif]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1 << 19)
+    ap.add_argument("--trees", type=int, default=100)
+    ap.add_argument("--eif", action="store_true")
+    ap.add_argument("--sweep", type=str, default="1024,2048,4096,8192,16384")
+    args = ap.parse_args()
+
+    import jax
+
+    print(f"[sweep] backend {jax.devices()}", file=sys.stderr)
+
+    import jax.numpy as jnp
+
+    from isoforest_tpu import ExtendedIsolationForest, IsolationForest
+    from isoforest_tpu.data import kddcup_http_hard
+    from isoforest_tpu.ops import pallas_traversal
+
+    X, _ = kddcup_http_hard(n=args.rows, seed=7)
+    est = (
+        ExtendedIsolationForest(num_estimators=args.trees)
+        if args.eif
+        else IsolationForest(num_estimators=args.trees)
+    )
+    model = est.fit(X)
+    Xd = jnp.asarray(X)
+
+    # call path_lengths_pallas directly, NOT score_matrix: the production
+    # path fences EIF+pallas to dense on real TPU (the precision fence this
+    # sweep exists to eventually retire), which would silently turn --eif
+    # runs into dense timings
+    def run_once():
+        pallas_traversal.path_lengths_pallas(model.forest, Xd).block_until_ready()
+
+    for blk in [int(s) for s in args.sweep.split(",")]:
+        pallas_traversal._ROW_BLOCK = blk
+        for fn in (
+            pallas_traversal._standard_pallas,
+            pallas_traversal._extended_pallas_sparse,
+            pallas_traversal._extended_pallas_dense,
+        ):
+            fn.clear_cache()
+        try:
+            run_once()
+            best = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                run_once()
+                dt = time.perf_counter() - t0
+                best = dt if best is None or dt < best else best
+            print(
+                json.dumps(
+                    {
+                        "metric": "pallas_row_block",
+                        "eif": args.eif,
+                        "rows": args.rows,
+                        "trees": args.trees,
+                        "block": blk,
+                        "value": round(best, 4),
+                        "unit": "s",
+                    }
+                ),
+                flush=True,
+            )
+        except Exception as exc:
+            print(
+                json.dumps(
+                    {
+                        "metric": "pallas_row_block",
+                        "block": blk,
+                        "error": str(exc)[-300:],
+                    }
+                ),
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""On-chip sweep of the dense scan's multi-tree unroll factor.
+
+Round-2 measurement (benchmarks/README.md): the dense strategy's 100-step
+tree scan has a ~0.6 s launch-overhead floor at 131k rows — each scan step
+is a separate XLA While iteration whose [C, width] walk intermediates round
+-trip HBM and whose dispatch costs are paid per tree. Unrolling the scan G
+trees per step amortises both (the [C, F] chunk stays live across G trees
+and XLA fuses across tree bodies), which is exactly the multi-tree blocking
+VERDICT.md round-3 item 1 asks to measure.
+
+Usage: python tools/unroll_sweep.py [--rows N] [--trees T] [--eif]
+Prints one JSON line per (strategy-variant, G) with best-of-3 seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1 << 19)
+    ap.add_argument("--trees", type=int, default=100)
+    ap.add_argument("--eif", action="store_true")
+    ap.add_argument("--sweep", type=str, default="1,2,4,5,10,20,25,50,100")
+    args = ap.parse_args()
+
+    import jax
+
+    print(f"[sweep] backend {jax.devices()}", file=sys.stderr)
+
+    from isoforest_tpu import ExtendedIsolationForest, IsolationForest
+    from isoforest_tpu.data import kddcup_http_hard
+    from isoforest_tpu.ops import dense_traversal
+    from isoforest_tpu.ops.traversal import score_matrix
+
+    X, _ = kddcup_http_hard(n=args.rows, seed=7)
+    est = (
+        ExtendedIsolationForest(num_estimators=args.trees)
+        if args.eif
+        else IsolationForest(num_estimators=args.trees)
+    )
+    model = est.fit(X)
+
+    for g in [int(s) for s in args.sweep.split(",")]:
+        if g > args.trees:
+            continue
+        dense_traversal._SCAN_UNROLL = g
+        try:
+            # _score_chunk's jit cache keys on shapes/statics, not on the
+            # module global — drop it so each G actually recompiles
+            from isoforest_tpu.ops.traversal import _score_chunk
+
+            _score_chunk.clear_cache()
+            score_matrix(model.forest, X, model.num_samples, strategy="dense")
+            best = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                score_matrix(model.forest, X, model.num_samples, strategy="dense")
+                dt = time.perf_counter() - t0
+                best = dt if best is None or dt < best else best
+            print(
+                json.dumps(
+                    {
+                        "metric": "dense_unroll",
+                        "eif": args.eif,
+                        "rows": args.rows,
+                        "trees": args.trees,
+                        "G": g,
+                        "value": round(best, 4),
+                        "unit": "s",
+                    }
+                ),
+                flush=True,
+            )
+        except Exception as exc:
+            print(
+                json.dumps({"metric": "dense_unroll", "G": g, "error": str(exc)[-200:]}),
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()
