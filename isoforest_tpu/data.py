@@ -134,6 +134,90 @@ def mulcross(
     return X[perm], y[perm]
 
 
+def annthyroid_like(
+    n: int = 6000, contamination: float = 0.05, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Annthyroid-family shape: low-dim (6) data whose anomalies deviate on
+    ONE axis while the remaining dims are high-variance nuisance.
+
+    The reference's published table shows the starkest EIF_max collapse here
+    (StandardIF 0.813 vs ExtendedIF_max 0.646, /root/reference/README.md:418-421).
+    Mechanism this generator reproduces: a fully-extended hyperplane draws
+    weight ~1/sqrt(6) on the relevant axis, so the anomaly offset is diluted
+    by the nuisance dims' variance (split SNR < 1), while axis-aligned splits
+    see the offset undiluted whenever they draw the relevant feature."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    n_in = n - n_out
+    f0_in = rng.normal(0.0, 0.5, n_in)
+    nuis_in = rng.normal(0.0, 3.0, (n_in, 5))
+    sign = rng.choice([-1.0, 1.0], n_out)
+    f0_out = sign * rng.normal(2.5, 0.4, n_out)
+    nuis_out = rng.normal(0.0, 3.0, (n_out, 5))
+    X = np.vstack(
+        [np.column_stack([f0_in, nuis_in]), np.column_stack([f0_out, nuis_out])]
+    ).astype(np.float32)
+    y = np.concatenate([np.zeros(n_in), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
+def forestcover_like(
+    n: int = 8000, contamination: float = 0.03, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """ForestCover-family shape: 10-d with strongly correlated nuisance
+    structure (3 latent factors over 8 dims, like correlated geospatial
+    covariates) and anomalies extreme on 2 marginal dims only.
+
+    Reproduces the published EIF_max collapse at ForestCover's magnitude
+    (StandardIF 0.882 vs ExtendedIF_max 0.688, /root/reference/README.md:430-432;
+    measured here over seeds 1-3: std ~0.883 vs EIF_max ~0.707) — the
+    correlated factors dominate every oblique projection, drowning the two
+    relevant coordinates."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    n_in = n - n_out
+    basis = rng.normal(size=(3, 8)) * 2.0
+    nuis_in = rng.normal(size=(n_in, 3)) @ basis + rng.normal(0, 0.3, (n_in, 8))
+    nuis_out = rng.normal(size=(n_out, 3)) @ basis + rng.normal(0, 0.3, (n_out, 8))
+    rel_in = rng.normal(0.0, 0.6, (n_in, 2))
+    sign = rng.choice([-1.0, 1.0], (n_out, 2))
+    rel_out = sign * rng.normal(2.0, 0.5, (n_out, 2))
+    X = np.vstack(
+        [np.hstack([rel_in, nuis_in]), np.hstack([rel_out, nuis_out])]
+    ).astype(np.float32)
+    y = np.concatenate([np.zeros(n_in), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
+def ionosphere_like(
+    n: int = 4000, contamination: float = 0.1, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Ionosphere-family shape: 33-d inliers on a rank-6 correlated manifold;
+    anomalies approximately match every marginal but break the correlation
+    structure (independent coordinates at 1.25x marginal scale).
+
+    The regime where the reference's table shows EIF_max WINNING on high-dim
+    correlated data (StandardIF 0.8443 vs ExtendedIF_max 0.9075,
+    /root/reference/README.md:436-440; measured here over seeds 1-3: std
+    ~0.862 vs EIF_max ~0.919): axis-aligned splits only see marginals, while
+    random hyperplanes project onto low-inlier-variance directions orthogonal
+    to the manifold where correlation-breaking anomalies stick out."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    n_in = n - n_out
+    f, r = 33, 6
+    basis = rng.normal(size=(r, f)) / np.sqrt(r)
+    inliers = rng.normal(size=(n_in, r)) @ basis + rng.normal(0, 0.15, (n_in, f))
+    marg_std = inliers.std(axis=0)
+    outliers = rng.normal(0.0, 1.25, (n_out, f)) * marg_std
+    X = np.vstack([inliers, outliers]).astype(np.float32)
+    y = np.concatenate([np.zeros(n_in), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
 def high_dim_blobs(
     n: int = 20000, f: int = 274, contamination: float = 0.02, seed: int = 0
 ) -> Tuple[np.ndarray, np.ndarray]:

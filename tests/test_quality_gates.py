@@ -15,7 +15,10 @@ import numpy as np
 
 from isoforest_tpu import ExtendedIsolationForest, IsolationForest
 from isoforest_tpu.data import (
+    annthyroid_like,
+    forestcover_like,
     high_dim_blobs,
+    ionosphere_like,
     kddcup_http_hard,
     mulcross,
     sinusoid,
@@ -95,6 +98,64 @@ class TestBandedGates:
                 - _auroc(np.asarray(std.score(X)), y)
             )
         assert np.mean(gap) > 0.005, f"EIF advantage lost: mean gap {np.mean(gap):.4f}"
+
+
+_SEED_MEAN_MEMO: dict = {}
+
+
+def _seed_mean(gen, estimator_cls, seeds=(1, 2, 3), **est_kw):
+    """Mean AUROC of ``estimator_cls`` over per-seed datasets + fits.
+    Memoised — several ordering gates share the same (gen, model) mean."""
+    key = (gen.__name__, estimator_cls.__name__, seeds, tuple(sorted(est_kw.items())))
+    if key not in _SEED_MEAN_MEMO:
+        vals = []
+        for seed in seeds:
+            X, y = gen(seed=seed)
+            m = estimator_cls(num_estimators=100, random_seed=seed, **est_kw).fit(X)
+            vals.append(_auroc(np.asarray(m.score(X)), y))
+        _SEED_MEAN_MEMO[key] = float(np.mean(vals))
+    return _SEED_MEAN_MEMO[key]
+
+
+class TestPublishedOrderingGates:
+    """The three remaining published EIF-vs-standard orderings (VERDICT r2
+    item 5), each reproduced by a generator shaped to the mechanism and gated
+    on both the 3-seed mean gap and banded absolute levels (a band that can
+    fail in both directions, like every other gate in this file). Published
+    values: /root/reference/README.md:418-440, extracted in BASELINE.md."""
+
+    def test_annthyroid_eif_max_collapse(self):
+        # published: StandardIF 0.813 vs ExtendedIF_max 0.646 (README:418-421)
+        std = _seed_mean(annthyroid_like, IsolationForest)
+        eif = _seed_mean(annthyroid_like, ExtendedIsolationForest)
+        assert 0.85 <= std <= 0.96, f"std {std:.4f} outside band"
+        assert 0.55 <= eif <= 0.72, f"EIF_max {eif:.4f} outside band"
+        assert std - eif > 0.15, f"collapse lost: gap {std - eif:.4f}"
+
+    def test_annthyroid_eif0_tracks_standard(self):
+        # published: ExtendedIF_0 0.813 == StandardIF 0.813 on annthyroid —
+        # the collapse is an extension-level effect, not an EIF-family one
+        std = _seed_mean(annthyroid_like, IsolationForest)
+        eif0 = _seed_mean(annthyroid_like, ExtendedIsolationForest, extension_level=0)
+        assert abs(std - eif0) < 0.04, f"EIF_0 {eif0:.4f} vs std {std:.4f}"
+
+    def test_forestcover_eif_max_collapse(self):
+        # published: StandardIF 0.882 vs ExtendedIF_max 0.688 (README:430-432);
+        # measured here (seeds 1-3): std 0.883 vs EIF_max 0.707
+        std = _seed_mean(forestcover_like, IsolationForest)
+        eif = _seed_mean(forestcover_like, ExtendedIsolationForest)
+        assert 0.84 <= std <= 0.94, f"std {std:.4f} outside band"
+        assert 0.62 <= eif <= 0.80, f"EIF_max {eif:.4f} outside band"
+        assert std - eif > 0.08, f"collapse lost: gap {std - eif:.4f}"
+
+    def test_ionosphere_eif_max_wins_high_dim_correlated(self):
+        # published: ExtendedIF_max 0.9075 vs StandardIF 0.8443 (README:436-440);
+        # measured here (seeds 1-3): EIF_max 0.919 vs std 0.862
+        std = _seed_mean(ionosphere_like, IsolationForest)
+        eif = _seed_mean(ionosphere_like, ExtendedIsolationForest)
+        assert 0.80 <= std <= 0.92, f"std {std:.4f} outside band"
+        assert 0.86 <= eif <= 0.97, f"EIF_max {eif:.4f} outside band"
+        assert eif - std > 0.02, f"EIF advantage lost: gap {eif - std:.4f}"
 
 
 def _auprc(y, s):

@@ -6,6 +6,14 @@ pinning the kernel at 512 row-blocks x 100 trees = 51k grid steps of
 table-DMA + fixed overhead — the measured residual vs the dense XLA path.
 VERDICT r3 item 1 asks to re-probe whenever the helper updates.
 
+Each block size runs in its OWN SUBPROCESS with a hard timeout: the known
+failure mode is not a Python exception but a compile-helper core dump that
+wedges the TPU tunnel (benchmarks/tpu_probe_history.log 17:35Z lesson), so
+an in-process try/except would hang the whole sweep at the first bad block.
+A wedged block surfaces as {"error": "timeout/killed"} and the parent keeps
+going — though note a real wedge usually takes the tunnel down for every
+later block too, so put the risky sizes last.
+
 Usage: python tools/pallas_block_sweep.py [--rows N] [--trees T] [--eif]
 """
 
@@ -14,38 +22,30 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--rows", type=int, default=1 << 19)
-    ap.add_argument("--trees", type=int, default=100)
-    ap.add_argument("--eif", action="store_true")
-    ap.add_argument("--sweep", type=str, default="1024,2048,4096,8192,16384")
-    args = ap.parse_args()
-
-    import jax
-
-    print(f"[sweep] backend {jax.devices()}", file=sys.stderr)
-
+def run_one(rows: int, trees: int, eif: bool, blk: int) -> None:
+    """Child-process body: compile + best-of-3 time a single block size."""
     import jax.numpy as jnp
 
     from isoforest_tpu import ExtendedIsolationForest, IsolationForest
     from isoforest_tpu.data import kddcup_http_hard
     from isoforest_tpu.ops import pallas_traversal
 
-    X, _ = kddcup_http_hard(n=args.rows, seed=7)
+    X, _ = kddcup_http_hard(n=rows, seed=7)
     est = (
-        ExtendedIsolationForest(num_estimators=args.trees)
-        if args.eif
-        else IsolationForest(num_estimators=args.trees)
+        ExtendedIsolationForest(num_estimators=trees)
+        if eif
+        else IsolationForest(num_estimators=trees)
     )
     model = est.fit(X)
     Xd = jnp.asarray(X)
+    pallas_traversal._ROW_BLOCK = blk
 
     # call path_lengths_pallas directly, NOT score_matrix: the production
     # path fences EIF+pallas to dense on real TPU (the precision fence this
@@ -54,43 +54,78 @@ def main() -> None:
     def run_once():
         pallas_traversal.path_lengths_pallas(model.forest, Xd).block_until_ready()
 
+    run_once()
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run_once()
+        dt = time.perf_counter() - t0
+        best = dt if best is None or dt < best else best
+    print(
+        json.dumps(
+            {
+                "metric": "pallas_row_block",
+                "eif": eif,
+                "rows": rows,
+                "trees": trees,
+                "block": blk,
+                "value": round(best, 4),
+                "unit": "s",
+            }
+        ),
+        flush=True,
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1 << 19)
+    ap.add_argument("--trees", type=int, default=100)
+    ap.add_argument("--eif", action="store_true")
+    ap.add_argument("--sweep", type=str, default="1024,2048,4096,8192,16384")
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--one", type=int, default=None, help="(internal) child mode")
+    args = ap.parse_args()
+
+    if args.one is not None:
+        run_one(args.rows, args.trees, args.eif, args.one)
+        return
+
     for blk in [int(s) for s in args.sweep.split(",")]:
-        pallas_traversal._ROW_BLOCK = blk
-        for fn in (
-            pallas_traversal._standard_pallas,
-            pallas_traversal._extended_pallas_sparse,
-            pallas_traversal._extended_pallas_dense,
-        ):
-            fn.clear_cache()
+        cmd = [
+            sys.executable,
+            os.path.abspath(__file__),
+            "--rows",
+            str(args.rows),
+            "--trees",
+            str(args.trees),
+            "--one",
+            str(blk),
+        ] + (["--eif"] if args.eif else [])
         try:
-            run_once()
-            best = None
-            for _ in range(3):
-                t0 = time.perf_counter()
-                run_once()
-                dt = time.perf_counter() - t0
-                best = dt if best is None or dt < best else best
-            print(
-                json.dumps(
-                    {
-                        "metric": "pallas_row_block",
-                        "eif": args.eif,
-                        "rows": args.rows,
-                        "trees": args.trees,
-                        "block": blk,
-                        "value": round(best, 4),
-                        "unit": "s",
-                    }
-                ),
-                flush=True,
+            out = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=args.timeout
             )
-        except Exception as exc:
+            sys.stdout.write(out.stdout)
+            if out.returncode != 0:
+                print(
+                    json.dumps(
+                        {
+                            "metric": "pallas_row_block",
+                            "block": blk,
+                            "error": f"rc={out.returncode}: {out.stderr[-300:]}",
+                        }
+                    ),
+                    flush=True,
+                )
+        except subprocess.TimeoutExpired:
             print(
                 json.dumps(
                     {
                         "metric": "pallas_row_block",
                         "block": blk,
-                        "error": str(exc)[-300:],
+                        "error": f"timeout/killed after {args.timeout:.0f}s "
+                        "(compile-helper wedge class)",
                     }
                 ),
                 flush=True,
