@@ -142,9 +142,12 @@ class TestFusedTrainStep:
         assert float(result.threshold) == -1.0
         assert result.forest.k == 3
 
-    def test_histogram_threshold_path(self, mesh, data):
-        """contamination_error > 0 routes through the psum-able histogram
-        sketch; threshold must agree with the exact-sort path to float noise."""
+    # shared by the sketch-agreement and rank-contract tests below; one
+    # (exact, sketch) train-step pair instead of two per test
+    SKETCH_EPS = 0.01
+
+    @pytest.fixture(scope="class")
+    def exact_and_sketch(self, mesh, data):
         kw = dict(
             num_rows=len(data),
             num_features_total=5,
@@ -154,15 +157,42 @@ class TestFusedTrainStep:
             contamination=0.1,
         )
         exact = make_train_step(mesh, **kw)(jax.random.PRNGKey(0), data)
-        sketch = make_train_step(mesh, contamination_error=0.01, **kw)(
+        sketch = make_train_step(mesh, contamination_error=self.SKETCH_EPS, **kw)(
             jax.random.PRNGKey(0), data
         )
+        return exact, sketch
+
+    def test_histogram_threshold_path(self, exact_and_sketch):
+        """contamination_error > 0 routes through the psum-able histogram
+        sketch; threshold must agree with the exact-sort path to float noise."""
+        exact, sketch = exact_and_sketch
         assert float(sketch.threshold) == pytest.approx(
             float(exact.threshold), abs=1e-5
         )
         np.testing.assert_allclose(
             np.asarray(sketch.scores), np.asarray(exact.scores), rtol=1e-6
         )
+
+    def test_threshold_rank_contract_on_mesh(self, exact_and_sketch, data):
+        """Mesh-level pin of the approxQuantile rank contract (VERDICT r2
+        item 6): both the exact and the psum'd-histogram threshold must be
+        elements of the gathered score column at (within eps*N of) rank
+        ceil(q*N). This is what MULTICHIP_rN's dryrun asserts, kept here as
+        a first-class test against the 8-virtual-device mesh."""
+        from isoforest_tpu.ops.quantile import quantile_rank_error
+
+        exact, sketch = exact_and_sketch
+        scores = np.asarray(exact.scores)
+        # exact path: rank error must be 0 AND the threshold the exact
+        # rank-ceil(q*N) element of the sorted gathered scores
+        assert quantile_rank_error(scores, float(exact.threshold), 0.9) == 0
+        rank = min(max(int(np.ceil(0.9 * len(data))) - 1, 0), len(data) - 1)
+        assert float(exact.threshold) == float(np.sort(scores)[rank])
+
+        err = quantile_rank_error(
+            np.asarray(sketch.scores), float(sketch.threshold), 0.9
+        )
+        assert err <= max(int(self.SKETCH_EPS * len(data)), 1), err
 
     def test_indivisible_counts_rejected(self, mesh, data):
         with pytest.raises(ValueError):

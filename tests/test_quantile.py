@@ -10,6 +10,7 @@ from isoforest_tpu.ops.quantile import (
     histogram_quantile,
     histogram_quantile_jit,
     observed_contamination,
+    quantile_rank_error,
 )
 
 
@@ -82,14 +83,28 @@ class TestHistogramQuantile:
 
 
 def _rank_error(scores, value, q):
-    """min |rank(value) - target_rank| over the value's positions (GK metric)."""
-    s = np.sort(scores)
-    target = max(int(np.ceil(q * len(s))), 1) - 1
-    lo = np.searchsorted(s, value, side="left")
-    hi = np.searchsorted(s, value, side="right") - 1
-    if lo > hi:  # not an element — infinite error
-        return np.inf
-    return 0 if lo <= target <= hi else min(abs(lo - target), abs(hi - target))
+    """GK rank-error metric — the library's own contract checker (also used
+    by the MULTICHIP dryrun); non-membership surfaces as the checker's
+    AssertionError rather than an inf sentinel."""
+    return quantile_rank_error(scores, value, q)
+
+
+class TestQuantileRankError:
+    def test_tie_class_covers_target(self):
+        s = np.array([1.0, 2.0, 2.0, 2.0, 3.0], np.float32)
+        # target rank 3 of 5 (q=0.6) falls inside the 2.0 tie class [2, 4]
+        assert quantile_rank_error(s, 2.0, 0.6) == 0
+
+    def test_distance_outside_tie_class(self):
+        s = np.arange(1, 101, dtype=np.float32)
+        # target rank ceil(0.95*100)=95; element 90 occupies rank 90
+        assert quantile_rank_error(s, 90.0, 0.95) == 5
+        assert quantile_rank_error(s, 99.0, 0.95) == 4
+
+    def test_non_element_raises(self):
+        s = np.array([1.0, 2.0, 3.0], np.float32)
+        with pytest.raises(ValueError, match="not an element"):
+            quantile_rank_error(s, 2.5, 0.5)
 
 
 class TestGreenwaldKhannaContract:
