@@ -2,14 +2,19 @@
 # reference's CI gates (.github/workflows/ci.yml: build + test matrix;
 # isolation-forest-onnx/setup.cfg: flake8/mypy/coverage). The image ships no
 # external linters, so lint is the in-repo AST gate (tools/lint.py) and
-# coverage is the sys.monitoring gate (tools/coverage_gate.py, >=90% on the
-# ONNX subpackage — reference setup.cfg [coverage:report] fail_under=90).
+# coverage is the sys.monitoring gate (tools/coverage_gate.py).
+#
+# `check` = lint + coverage: the coverage gate runs the FULL test suite once
+# under line monitoring and enforces two floors (onnx >= 90%, matching the
+# reference's setup.cfg fail_under=90; rest of the package >= 85%), so a
+# separate `test` pass would run every test twice (ADVICE r2). `test` stays
+# for quick monitoring-free local runs.
 
 PY ?= python3
 
 .PHONY: check lint test coverage bench dryrun
 
-check: lint test coverage
+check: lint coverage
 
 coverage:
 	$(PY) tools/coverage_gate.py

@@ -1,19 +1,31 @@
-"""In-repo line-coverage gate for the ONNX subpackage — the analogue of the
-reference's ``coverage fail_under = 90`` on its converter module
+"""In-repo line-coverage gate over the whole package — the expanded analogue
+of the reference's ``coverage fail_under = 90`` on its converter module
 (``/root/reference/isolation-forest-onnx/setup.cfg`` [coverage:report]; its
 CI runs pytest under coverage and fails the build below the bar).
 
+Two floors (VERDICT r2 item 7): the ONNX subpackage keeps the reference's
+own 90% bar; the rest of the package — where this framework's risk mass
+actually lives (``ops``/``io``/``models``/``utils``/``parallel``) — gates at
+85%. The whole test suite runs ONCE under monitoring, so ``make check``
+needs no separate ``test`` pass (the round-2 Makefile ran the ONNX files
+twice; ADVICE r2).
+
 The image ships no ``coverage``/``pytest-cov`` and installs are forbidden,
 so this uses :mod:`sys.monitoring` (PEP 669, py3.12+) with a
-:mod:`sys.settrace` fallback to record executed lines in
-``isoforest_tpu/onnx/*`` while the ONNX test files run, then measures them
-against the executable-line set derived from each module's AST.
+:mod:`sys.settrace` fallback to record executed lines while the tests run,
+then measures them against the executable-line set derived from each
+module's AST.
+
+Lines that only execute in SUBPROCESSES the suite spawns (the Mosaic AOT
+worker, the 2-process Gloo test, CLI subprocess tests) are invisible to
+in-process monitoring; the floors below are calibrated with that known
+undercount included.
 
 Run via ``make coverage`` (or directly)::
 
-    python tools/coverage_gate.py [--fail-under 90]
+    python tools/coverage_gate.py [--fail-under-core 85] [--fail-under-onnx 90]
 
-Exit 0 at/above the bar, 1 below (per-file table printed either way).
+Exit 0 at/above both bars, 1 below either (per-file table printed always).
 """
 
 from __future__ import annotations
@@ -25,8 +37,8 @@ import pathlib
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-PKG = ROOT / "isoforest_tpu" / "onnx"
-TESTS = ["tests/test_onnx.py", "tests/test_onnx_checker.py"]
+PKG = ROOT / "isoforest_tpu"
+TESTS = ["tests/"]
 
 
 def _executable_lines(path: pathlib.Path) -> set:
@@ -63,11 +75,14 @@ def _run_tests_with_monitoring(watched: dict) -> int:
         mon.use_tool_id(tool, "isoforest-coverage-gate")
 
         def on_line(code, line):
-            f = code.co_filename
-            hit = watched.get(f)
+            hit = watched.get(code.co_filename)
             if hit is not None:
                 hit.add(line)
-            return mon.DISABLE if hit is None else None
+            # DISABLE is per-(code, line): each location fires exactly once
+            # (coverage.py's own sysmon strategy) — without it every re-
+            # execution of a recorded line pays the callback, which at
+            # full-package x full-suite scope multiplies the wall time
+            return mon.DISABLE
 
         mon.register_callback(tool, mon.events.LINE, on_line)
         mon.set_events(tool, mon.events.LINE)
@@ -94,9 +109,43 @@ def _run_tests_with_monitoring(watched: dict) -> int:
     return rc
 
 
+def _gate(name: str, rows: list, fail_under: float) -> bool:
+    """Print one gate's per-file table; True when at/above the bar."""
+    total_exec = sum(r[1] for r in rows)
+    total_hit = sum(r[2] for r in rows)
+    overall = 100.0 * total_hit / total_exec if total_exec else 100.0
+    width = max(len(r[0]) for r in rows)
+    print(f"\n[{name}] {'file':{width}}  stmts   hit   cover")
+    for fname, n_exec, n_hit, pct in rows:
+        print(f"[{name}] {fname:{width}}  {n_exec:5d} {n_hit:5d}  {pct:5.1f}%")
+    print(
+        f"[{name}] {'TOTAL':{width}}  {total_exec:5d} {total_hit:5d}  {overall:5.1f}%"
+    )
+    if overall < fail_under:
+        print(
+            f"coverage gate [{name}] FAILED: {overall:.1f}% < fail-under "
+            f"{fail_under:.0f}%",
+            file=sys.stderr,
+        )
+        return False
+    print(f"coverage gate [{name}] OK: {overall:.1f}% >= {fail_under:.0f}%")
+    return True
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--fail-under", type=float, default=90.0)
+    ap.add_argument(
+        "--fail-under-onnx",
+        type=float,
+        default=90.0,
+        help="floor for isoforest_tpu/onnx (reference setup.cfg fail_under=90)",
+    )
+    ap.add_argument(
+        "--fail-under-core",
+        type=float,
+        default=85.0,
+        help="floor for the rest of the package (VERDICT r2 item 7)",
+    )
     args = ap.parse_args()
 
     os.chdir(ROOT)
@@ -107,39 +156,24 @@ def main() -> int:
     if "host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
-    files = sorted(p for p in PKG.glob("*.py"))
+    files = sorted(PKG.rglob("*.py"))
     watched = {str(p.resolve()): set() for p in files}
     rc = _run_tests_with_monitoring(watched)
     if rc != 0:
         print(f"coverage gate: tests failed (pytest rc={rc})", file=sys.stderr)
         return 1
 
-    total_exec = total_hit = 0
-    rows = []
+    onnx_rows, core_rows = [], []
     for p in files:
         execu = _executable_lines(p)
         hit = watched[str(p.resolve())] & execu
-        total_exec += len(execu)
-        total_hit += len(hit)
         pct = 100.0 * len(hit) / len(execu) if execu else 100.0
-        rows.append((str(p.relative_to(ROOT)), len(execu), len(hit), pct))
-    overall = 100.0 * total_hit / total_exec if total_exec else 100.0
+        row = (str(p.relative_to(ROOT)), len(execu), len(hit), pct)
+        (onnx_rows if p.is_relative_to(PKG / "onnx") else core_rows).append(row)
 
-    width = max(len(r[0]) for r in rows)
-    print(f"\n{'file':{width}}  stmts   hit   cover")
-    for name, n_exec, n_hit, pct in rows:
-        print(f"{name:{width}}  {n_exec:5d} {n_hit:5d}  {pct:5.1f}%")
-    print(f"{'TOTAL':{width}}  {total_exec:5d} {total_hit:5d}  {overall:5.1f}%")
-    if overall < args.fail_under:
-        print(
-            f"coverage gate FAILED: {overall:.1f}% < fail-under "
-            f"{args.fail_under:.0f}% (reference parity: setup.cfg "
-            "[coverage:report] fail_under=90)",
-            file=sys.stderr,
-        )
-        return 1
-    print(f"coverage gate OK: {overall:.1f}% >= {args.fail_under:.0f}%")
-    return 0
+    ok = _gate("onnx", onnx_rows, args.fail_under_onnx)
+    ok = _gate("core", core_rows, args.fail_under_core) and ok
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
