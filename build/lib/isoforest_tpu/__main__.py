@@ -1,0 +1,223 @@
+"""Command-line front-end: fit / score / convert / inspect.
+
+The reference is consumed as a JVM library from Spark jobs; the equivalent
+operational surface here is a small CLI over CSV files:
+
+    python -m isoforest_tpu fit --input data.csv --output /tmp/model \\
+        --num-estimators 100 --contamination 0.02 [--extended]
+    python -m isoforest_tpu score --model /tmp/model --input data.csv \\
+        --output scores.csv
+    python -m isoforest_tpu convert --model /tmp/model --output model.onnx
+    python -m isoforest_tpu inspect --model /tmp/model [--tree 0]
+
+CSV rows are feature columns; ``--labeled`` treats the last column as a label
+(excluded from features; used to report AUROC after fit/score).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _parse_rows(lines_or_path, labeled: bool):
+    """One shared CSV parser for fit and score: rows are samples even for a
+    single-line file (``ndmin=2``)."""
+    data = np.loadtxt(lines_or_path, delimiter=",", comments="#", ndmin=2).astype(
+        np.float32
+    )
+    if labeled:
+        return data[:, :-1], data[:, -1]
+    return data, None
+
+
+def _load(path: str, labeled: bool):
+    return _parse_rows(path, labeled)
+
+
+def _auroc(scores, labels) -> float:
+    order = np.argsort(scores, kind="stable")
+    ranks = np.empty(len(scores))
+    ranks[order] = np.arange(1, len(scores) + 1)
+    pos = labels == 1
+    n1, n0 = int(pos.sum()), int((~pos).sum())
+    if n1 == 0 or n0 == 0:
+        return float("nan")
+    return float((ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0))
+
+
+def _load_model(path: str):
+    from .io.persistence import EXTENDED_MODEL_CLASS, _read_metadata
+    from .models import ExtendedIsolationForestModel, IsolationForestModel
+
+    if _read_metadata(path).get("class") == EXTENDED_MODEL_CLASS:
+        return ExtendedIsolationForestModel.load(path)
+    return IsolationForestModel.load(path)
+
+
+def cmd_fit(args) -> int:
+    from .models import ExtendedIsolationForest, IsolationForest
+
+    X, y = _load(args.input, args.labeled)
+    kw = dict(
+        num_estimators=args.num_estimators,
+        max_samples=args.max_samples,
+        contamination=args.contamination,
+        contamination_error=args.contamination_error,
+        max_features=args.max_features,
+        bootstrap=args.bootstrap,
+        random_seed=args.random_seed,
+    )
+    if args.extended:
+        est = ExtendedIsolationForest(extension_level=args.extension_level, **kw)
+    else:
+        est = IsolationForest(**kw)
+    model = est.fit(X)
+    model.save(args.output, overwrite=args.overwrite)
+    summary = {
+        "model": args.output,
+        "numTrees": model.forest.num_trees,
+        "numSamples": model.num_samples,
+        "threshold": model.outlier_score_threshold,
+    }
+    if y is not None:
+        summary["auroc"] = round(_auroc(model.score(X), y), 4)
+    print(json.dumps(summary))
+    return 0
+
+
+def _iter_csv_chunks(in_fh, labeled: bool, chunk_rows: int):
+    """Stream (X, y) chunks from an open CSV handle without materialising
+    the file — the CLI analogue of Spark scoring a Dataset partition by
+    partition."""
+    buf: list = []
+    for line in in_fh:
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        buf.append(line)
+        if len(buf) >= chunk_rows:
+            yield _parse_rows(buf, labeled)
+            buf = []
+    if buf:
+        yield _parse_rows(buf, labeled)
+
+
+def cmd_score(args) -> int:
+    model = _load_model(args.model)
+    header = "outlierScore,predictedLabel"
+    # open (and thereby validate) the input BEFORE truncating the output —
+    # a missing input must not destroy a pre-existing results file
+    with open(args.input) as in_fh:
+        out_fh = sys.stdout if args.output == "-" else open(args.output, "w")
+        try:
+            out_fh.write(header + "\n")
+            all_scores, all_labels = [], []
+            for X, y in _iter_csv_chunks(in_fh, args.labeled, args.chunk_rows):
+                scores = model.score(X)
+                labels = model.predict(scores)
+                np.savetxt(out_fh, np.stack([scores, labels], axis=1), delimiter=",")
+                if y is not None:
+                    all_scores.append(scores)
+                    all_labels.append(y)
+        finally:
+            if out_fh is not sys.stdout:
+                out_fh.close()
+    if all_labels:
+        auroc = _auroc(np.concatenate(all_scores), np.concatenate(all_labels))
+        print(json.dumps({"auroc": round(auroc, 4)}), file=sys.stderr)
+    return 0
+
+
+def cmd_convert(args) -> int:
+    from .onnx import convert_and_save
+
+    convert_and_save(args.model, args.output)
+    print(json.dumps({"onnx": args.output}))
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    from .utils.inspect import tree_structure_string
+
+    model = _load_model(args.model)
+    if args.tree is not None:
+        print(tree_structure_string(model, args.tree))
+        return 0
+    ni = np.asarray(model.forest.num_instances)
+    leaves = (ni >= 0).sum(axis=1)
+    print(
+        json.dumps(
+            {
+                "class": type(model).__name__,
+                "numTrees": model.forest.num_trees,
+                "maxNodes": model.forest.max_nodes,
+                "numSamples": model.num_samples,
+                "numFeatures": model.num_features,
+                "totalNumFeatures": model.total_num_features,
+                "outlierScoreThreshold": model.outlier_score_threshold,
+                "avgLeavesPerTree": round(float(leaves.mean()), 2),
+                "params": model.params.to_param_map(),
+            }
+        )
+    )
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="isoforest_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    fit = sub.add_parser("fit", help="train a model from a CSV")
+    fit.add_argument("--input", required=True)
+    fit.add_argument("--output", required=True)
+    fit.add_argument("--labeled", action="store_true")
+    fit.add_argument("--extended", action="store_true")
+    fit.add_argument("--num-estimators", type=int, default=100)
+    fit.add_argument("--max-samples", type=float, default=256.0)
+    fit.add_argument("--contamination", type=float, default=0.0)
+    fit.add_argument("--contamination-error", type=float, default=0.0)
+    fit.add_argument("--max-features", type=float, default=1.0)
+    fit.add_argument("--bootstrap", action="store_true")
+    fit.add_argument("--random-seed", type=int, default=1)
+    fit.add_argument("--extension-level", type=int, default=None)
+    fit.add_argument("--overwrite", action="store_true")
+    fit.set_defaults(func=cmd_fit)
+
+    score = sub.add_parser("score", help="score a CSV with a saved model")
+    score.add_argument("--model", required=True)
+    score.add_argument("--input", required=True)
+    score.add_argument("--output", default="-")
+    score.add_argument("--labeled", action="store_true")
+    score.add_argument(
+        "--chunk-rows",
+        type=int,
+        default=1 << 20,
+        help="stream the input in chunks of this many rows — bounded memory "
+        "for arbitrarily large unlabeled files (--labeled accumulates "
+        "scores+labels for the final AUROC report)",
+    )
+    score.set_defaults(func=cmd_score)
+
+    conv = sub.add_parser("convert", help="export a saved model to ONNX")
+    conv.add_argument("--model", required=True)
+    conv.add_argument("--output", required=True)
+    conv.set_defaults(func=cmd_convert)
+
+    insp = sub.add_parser("inspect", help="summarise a saved model")
+    insp.add_argument("--model", required=True)
+    insp.add_argument("--tree", type=int, default=None)
+    insp.set_defaults(func=cmd_inspect)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

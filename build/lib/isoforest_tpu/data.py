@@ -1,0 +1,237 @@
+"""Dataset utilities: labeled-CSV loading and the benchmark generators.
+
+The reference's data plumbing is Spark DataFrames + committed ODDS CSVs with
+explicit schemas and a VectorAssembler (core/TestUtils.scala:58-135). The
+analogues here: a numpy CSV loader with the same ``f1,...,fk,label`` row
+contract, and synthetic generators for the BASELINE.json stress
+configurations (two-blobs / sinusoid — the Extended Isolation Forest paper's
+canonical shapes — and a KDDCup99-HTTP-like mixture).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def load_labeled_csv(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Load ``f1,...,fk,label`` rows (``#`` comments) -> (f32[N,F], labels[N])."""
+    data = np.loadtxt(path, delimiter=",", comments="#").astype(np.float32)
+    if data.ndim != 2 or data.shape[1] < 2:
+        raise ValueError(f"{path}: expected rows of features plus a label column")
+    return data[:, :-1], data[:, -1].astype(np.float64)
+
+
+def two_blobs(
+    n: int = 4096, contamination: float = 0.02, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Two dense Gaussian blobs + sparse background anomalies (EIF paper fig. 2:
+    the shape where axis-aligned score maps show 'ghost' artifacts that
+    hyperplane splits remove)."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    n_in = n - n_out
+    a = rng.normal(loc=(0.0, 10.0), scale=1.0, size=(n_in // 2, 2))
+    b = rng.normal(loc=(10.0, 0.0), scale=1.0, size=(n_in - n_in // 2, 2))
+    outliers = rng.uniform(low=-5.0, high=15.0, size=(n_out, 2))
+    X = np.vstack([a, b, outliers]).astype(np.float32)
+    y = np.concatenate([np.zeros(n_in), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
+def sinusoid(
+    n: int = 4096, contamination: float = 0.02, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Points along a sine curve + uniform anomalies (EIF paper fig. 3)."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    n_in = n - n_out
+    x = rng.uniform(0.0, 10.0, size=n_in)
+    y_coord = np.sin(x) + rng.normal(scale=0.15, size=n_in)
+    inliers = np.stack([x, y_coord], axis=1)
+    outliers = rng.uniform(low=(0.0, -4.0), high=(10.0, 4.0), size=(n_out, 2))
+    X = np.vstack([inliers, outliers]).astype(np.float32)
+    y = np.concatenate([np.zeros(n_in), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
+def kddcup_http_like(
+    n: int = 1_000_000, contamination: float = 0.004, seed: int = 7
+) -> Tuple[np.ndarray, np.ndarray]:
+    """KDDCup99-HTTP-like 3-feature mixture (log-scaled duration/src/dst
+    bytes) with a dense attack cluster."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    normal = rng.multivariate_normal(
+        mean=[0.0, 5.2, 8.0],
+        cov=[[0.6, 0.1, 0.0], [0.1, 1.2, 0.3], [0.0, 0.3, 1.5]],
+        size=n - n_out,
+    )
+    attacks = rng.multivariate_normal(
+        mean=[4.5, 9.5, 2.0], cov=np.eye(3).tolist(), size=n_out
+    )
+    X = np.vstack([normal, attacks]).astype(np.float32)
+    y = np.concatenate([np.zeros(n - n_out), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
+def kddcup_http_hard(
+    n: int = 1_000_000, contamination: float = 0.004, seed: int = 7
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Harder KDDCup99-HTTP-like mixture whose AUROC can actually fail.
+
+    :func:`kddcup_http_like` saturates at AUROC 1.0000 for every reasonable
+    implementation (VERDICT r1: a benchmark that cannot detect a quality
+    regression). Here half the attacks are 'stealth': drawn from the normal
+    cloud's own covariance at ~2 Mahalanobis-sigma offset, so they overlap
+    the inlier tail and perfect separation is impossible. A healthy isolation
+    forest lands at AUROC ~0.93-0.97 on this mixture; degraded tree growth,
+    broken bagging, or a mis-set threshold moves the number measurably.
+    """
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    n_loud = n_out // 2
+    n_stealth = n_out - n_loud
+    cov = [[0.6, 0.1, 0.0], [0.1, 1.2, 0.3], [0.0, 0.3, 1.5]]
+    normal = rng.multivariate_normal(mean=[0.0, 5.2, 8.0], cov=cov, size=n - n_out)
+    loud = rng.multivariate_normal(
+        mean=[4.5, 9.5, 2.0], cov=(2.0 * np.eye(3)).tolist(), size=n_loud
+    )
+    stealth = rng.multivariate_normal(
+        mean=[1.4, 6.9, 9.9], cov=cov, size=n_stealth
+    )
+    X = np.vstack([normal, loud, stealth]).astype(np.float32)
+    y = np.concatenate([np.zeros(n - n_out), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
+def mulcross(
+    n: int = 65536, contamination: float = 0.1, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Mulcross-family mixture (Rocke & Woodruff's synthetic generator behind
+    the ODDS 'mulcross' set in the reference's published table,
+    /root/reference/README.md:444-446): 4-d standard-normal inliers plus TWO
+    dense, compact anomaly clusters offset from the mean. Clustered anomalies
+    are the regime where the reference's table shows standard IF (0.991)
+    beating EIF (0.938-0.940) — dense clumps look like small modes, which
+    hyperplane splits carve less cleanly than axis-aligned retries. The
+    cluster spread (0.35 sigma) keeps AUROC off the 1.0 ceiling so the gate
+    can fail."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    n_a = n_out // 2
+    inliers = rng.normal(size=(n - n_out, 4))
+    c1 = rng.normal(loc=(3.5, 3.5, 0.0, 0.0), scale=0.35, size=(n_a, 4))
+    c2 = rng.normal(loc=(0.0, 0.0, 3.5, -3.5), scale=0.35, size=(n_out - n_a, 4))
+    X = np.vstack([inliers, c1, c2]).astype(np.float32)
+    y = np.concatenate([np.zeros(n - n_out), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
+def annthyroid_like(
+    n: int = 6000, contamination: float = 0.05, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Annthyroid-family shape: low-dim (6) data whose anomalies deviate on
+    ONE axis while the remaining dims are high-variance nuisance.
+
+    The reference's published table shows the starkest EIF_max collapse here
+    (StandardIF 0.813 vs ExtendedIF_max 0.646, /root/reference/README.md:418-421).
+    Mechanism this generator reproduces: a fully-extended hyperplane draws
+    weight ~1/sqrt(6) on the relevant axis, so the anomaly offset is diluted
+    by the nuisance dims' variance (split SNR < 1), while axis-aligned splits
+    see the offset undiluted whenever they draw the relevant feature."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    n_in = n - n_out
+    f0_in = rng.normal(0.0, 0.5, n_in)
+    nuis_in = rng.normal(0.0, 3.0, (n_in, 5))
+    sign = rng.choice([-1.0, 1.0], n_out)
+    f0_out = sign * rng.normal(2.5, 0.4, n_out)
+    nuis_out = rng.normal(0.0, 3.0, (n_out, 5))
+    X = np.vstack(
+        [np.column_stack([f0_in, nuis_in]), np.column_stack([f0_out, nuis_out])]
+    ).astype(np.float32)
+    y = np.concatenate([np.zeros(n_in), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
+def forestcover_like(
+    n: int = 8000, contamination: float = 0.03, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """ForestCover-family shape: 10-d with strongly correlated nuisance
+    structure (3 latent factors over 8 dims, like correlated geospatial
+    covariates) and anomalies extreme on 2 marginal dims only.
+
+    Reproduces the published EIF_max collapse at ForestCover's magnitude
+    (StandardIF 0.882 vs ExtendedIF_max 0.688, /root/reference/README.md:430-432;
+    measured here over seeds 1-3: std ~0.883 vs EIF_max ~0.707) — the
+    correlated factors dominate every oblique projection, drowning the two
+    relevant coordinates."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    n_in = n - n_out
+    basis = rng.normal(size=(3, 8)) * 2.0
+    nuis_in = rng.normal(size=(n_in, 3)) @ basis + rng.normal(0, 0.3, (n_in, 8))
+    nuis_out = rng.normal(size=(n_out, 3)) @ basis + rng.normal(0, 0.3, (n_out, 8))
+    rel_in = rng.normal(0.0, 0.6, (n_in, 2))
+    sign = rng.choice([-1.0, 1.0], (n_out, 2))
+    rel_out = sign * rng.normal(2.0, 0.5, (n_out, 2))
+    X = np.vstack(
+        [np.hstack([rel_in, nuis_in]), np.hstack([rel_out, nuis_out])]
+    ).astype(np.float32)
+    y = np.concatenate([np.zeros(n_in), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
+def ionosphere_like(
+    n: int = 4000, contamination: float = 0.1, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Ionosphere-family shape: 33-d inliers on a rank-6 correlated manifold;
+    anomalies approximately match every marginal but break the correlation
+    structure (independent coordinates at 1.25x marginal scale).
+
+    The regime where the reference's table shows EIF_max WINNING on high-dim
+    correlated data (StandardIF 0.8443 vs ExtendedIF_max 0.9075,
+    /root/reference/README.md:436-440; measured here over seeds 1-3: std
+    ~0.862 vs EIF_max ~0.919): axis-aligned splits only see marginals, while
+    random hyperplanes project onto low-inlier-variance directions orthogonal
+    to the manifold where correlation-breaking anomalies stick out."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    n_in = n - n_out
+    f, r = 33, 6
+    basis = rng.normal(size=(r, f)) / np.sqrt(r)
+    inliers = rng.normal(size=(n_in, r)) @ basis + rng.normal(0, 0.15, (n_in, f))
+    marg_std = inliers.std(axis=0)
+    outliers = rng.normal(0.0, 1.25, (n_out, f)) * marg_std
+    X = np.vstack([inliers, outliers]).astype(np.float32)
+    y = np.concatenate([np.zeros(n_in), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
+def high_dim_blobs(
+    n: int = 20000, f: int = 274, contamination: float = 0.02, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """High-dimensional correlated blobs (Arrhythmia-274-like shape) for the
+    maxFeatures < 1.0 column-subsampling stress config."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    basis = rng.normal(size=(16, f))
+    inliers = rng.normal(size=(n - n_out, 16)) @ basis
+    # scale 1.8: outlier latents overlap the inlier cloud enough that AUROC
+    # sits ~0.9 instead of saturating at 1.0 (a gate that can fail)
+    outliers = rng.normal(scale=1.8, size=(n_out, 16)) @ basis
+    X = np.vstack([inliers, outliers]).astype(np.float32)
+    X += rng.normal(scale=0.1, size=X.shape).astype(np.float32)
+    y = np.concatenate([np.zeros(n - n_out), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]

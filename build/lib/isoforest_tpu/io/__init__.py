@@ -1,0 +1,3 @@
+from . import avro, persistence
+
+__all__ = ["avro", "persistence"]

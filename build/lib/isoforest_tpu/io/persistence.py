@@ -1,0 +1,834 @@
+"""Model persistence in the reference's exact on-disk layout.
+
+Layout (IsolationForestModelReadWrite.scala:210-325 and
+core/IsolationForestModelReadWriteUtils.scala:28-188):
+
+    <path>/metadata/part-00000   single-line JSON: {class, timestamp,
+                                 sparkVersion, uid, paramMap, <extras>}
+    <path>/metadata/_SUCCESS
+    <path>/data/part-00000-<uuid>-c000.avro   node table (one row per node)
+    <path>/data/_SUCCESS
+
+Node rows are ``(treeID, nodeData)`` with **pre-order** ids and ``-1`` null
+sentinels (NodeData.build, IsolationForestModelReadWrite.scala:82-132;
+extended variant ExtendedIsolationForestModelReadWrite.scala:59-67 with empty
+arrays + 0.0 sentinels for leaves). The heap-tensor forest is converted to
+pre-order on write and rebuilt on read, so models interoperate both ways with
+the reference implementation and its ONNX converter, including the committed
+Spark-era golden fixtures (snappy codec, loaded via :mod:`.avro`).
+
+Legacy models without ``totalNumFeatures`` load with the ``-1`` sentinel and a
+warning (IsolationForestModelReadWrite.scala:298-306).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+import uuid
+from typing import List, Tuple
+
+import numpy as np
+
+from ..ops.ext_growth import ExtendedForest
+from ..ops.tree_growth import StandardForest
+from ..utils import logger
+from ..utils.params import ExtendedIsolationForestParams, IsolationForestParams
+from ..utils.validation import UNKNOWN_TOTAL_NUM_FEATURES
+from . import avro
+
+SPARK_VERSION_STRING = "3.5.5"  # layout-compat version tag written to metadata
+
+STANDARD_MODEL_CLASS = "com.linkedin.relevance.isolationforest.IsolationForestModel"
+EXTENDED_MODEL_CLASS = (
+    "com.linkedin.relevance.isolationforest.extended.ExtendedIsolationForestModel"
+)
+STANDARD_ESTIMATOR_CLASS = "com.linkedin.relevance.isolationforest.IsolationForest"
+EXTENDED_ESTIMATOR_CLASS = (
+    "com.linkedin.relevance.isolationforest.extended.ExtendedIsolationForest"
+)
+
+# Schemas matching what spark-avro emits for the reference's node tables.
+STANDARD_SCHEMA = {
+    "type": "record",
+    "name": "topLevelRecord",
+    "fields": [
+        {"name": "treeID", "type": "int"},
+        {
+            "name": "nodeData",
+            "type": [
+                {
+                    "type": "record",
+                    "name": "nodeData",
+                    "namespace": ".nodeData",
+                    "fields": [
+                        {"name": "id", "type": "int"},
+                        {"name": "leftChild", "type": "int"},
+                        {"name": "rightChild", "type": "int"},
+                        {"name": "splitAttribute", "type": "int"},
+                        {"name": "splitValue", "type": "double"},
+                        {"name": "numInstances", "type": "long"},
+                    ],
+                },
+                "null",
+            ],
+        },
+    ],
+}
+
+EXTENDED_SCHEMA = {
+    "type": "record",
+    "name": "topLevelRecord",
+    "fields": [
+        {"name": "treeID", "type": "int"},
+        {
+            "name": "extendedNodeData",
+            "type": [
+                {
+                    "type": "record",
+                    "name": "extendedNodeData",
+                    "namespace": "topLevelRecord",
+                    "fields": [
+                        {"name": "id", "type": "int"},
+                        {"name": "leftChild", "type": "int"},
+                        {"name": "rightChild", "type": "int"},
+                        {"name": "indices", "type": [{"type": "array", "items": "int"}, "null"]},
+                        {"name": "weights", "type": [{"type": "array", "items": "float"}, "null"]},
+                        {"name": "offset", "type": "double"},
+                        {"name": "numInstances", "type": "long"},
+                    ],
+                },
+                "null",
+            ],
+        },
+    ],
+}
+
+
+# --------------------------------------------------------------------------- #
+# heap <-> pre-order conversion
+# --------------------------------------------------------------------------- #
+
+
+def standard_tree_to_records(feature, threshold, num_instances) -> List[dict]:
+    """One tree's heap arrays -> pre-order NodeData dicts
+    (sentinels per IsolationForestModelReadWrite.scala:36-67)."""
+    records: List[dict] = []
+
+    def walk(slot: int) -> int:
+        my_id = len(records)
+        records.append(None)  # reserve pre-order position
+        if feature[slot] >= 0:
+            left = walk(2 * slot + 1)
+            right = walk(2 * slot + 2)
+            records[my_id] = {
+                "id": my_id,
+                "leftChild": left,
+                "rightChild": right,
+                "splitAttribute": int(feature[slot]),
+                "splitValue": float(threshold[slot]),
+                "numInstances": -1,
+            }
+        else:
+            records[my_id] = {
+                "id": my_id,
+                "leftChild": -1,
+                "rightChild": -1,
+                "splitAttribute": -1,
+                "splitValue": 0.0,
+                "numInstances": int(num_instances[slot]),
+            }
+        return my_id
+
+    walk(0)
+    return records
+
+
+def extended_tree_to_records(indices, weights, offset, num_instances) -> List[dict]:
+    """EIF heap arrays -> pre-order ExtendedNodeData dicts (leaf sentinels:
+    empty arrays + 0.0, ExtendedIsolationForestModelReadWrite.scala:33-35)."""
+    records: List[dict] = []
+
+    def walk(slot: int) -> int:
+        my_id = len(records)
+        records.append(None)
+        if indices[slot, 0] >= 0:
+            left = walk(2 * slot + 1)
+            right = walk(2 * slot + 2)
+            valid = indices[slot] >= 0  # drop (-1, 0.0) padding entries
+            records[my_id] = {
+                "id": my_id,
+                "leftChild": left,
+                "rightChild": right,
+                "indices": [int(v) for v in indices[slot][valid]],
+                "weights": [float(v) for v in weights[slot][valid]],
+                "offset": float(offset[slot]),
+                "numInstances": -1,
+            }
+        else:
+            records[my_id] = {
+                "id": my_id,
+                "leftChild": -1,
+                "rightChild": -1,
+                "indices": [],
+                "weights": [],
+                "offset": 0.0,
+                "numInstances": int(num_instances[slot]),
+            }
+        return my_id
+
+    walk(0)
+    return records
+
+
+
+def heap_preorder_columns(internal: np.ndarray):
+    """Vectorised heap -> pre-order conversion for a whole forest.
+
+    ``internal``: bool [T, M] (node at heap slot is internal). Returns
+    ``(trees, slots, pre_id, left_id, right_id)`` — flat arrays over all
+    existing nodes, ordered (tree, pre-order id), where ``left_id/right_id``
+    are pre-order child ids (-1 at leaves). This replaces the recursive
+    per-node Python walk of :func:`standard_tree_to_records` on the save
+    fast path: pre-order ids satisfy ``id(left) = id + 1`` and
+    ``id(right) = id + 1 + subtree_size(left)``, so subtree sizes (one
+    reverse level sweep) and ids (one forward level sweep) vectorise over
+    the whole [T, M] table.
+    """
+    t_n, m = internal.shape
+    h = int(np.log2(m + 1)) - 1
+    exists = np.zeros((t_n, m), bool)
+    exists[:, 0] = True
+    for level in range(h):
+        start, width = (1 << level) - 1, 1 << level
+        parent_int = exists[:, start : start + width] & internal[:, start : start + width]
+        child = 2 * start + 1
+        exists[:, child : child + 2 * width : 2] = parent_int
+        exists[:, child + 1 : child + 1 + 2 * width : 2] = parent_int
+    size = exists.astype(np.int64)
+    for level in range(h - 1, -1, -1):
+        start, width = (1 << level) - 1, 1 << level
+        child = 2 * start + 1
+        size[:, start : start + width] += (
+            size[:, child : child + 2 * width : 2]
+            + size[:, child + 1 : child + 1 + 2 * width : 2]
+        ) * internal[:, start : start + width]
+    pre_id = np.full((t_n, m), np.iinfo(np.int64).max, np.int64)
+    pre_id[:, 0] = 0
+    for level in range(h):
+        start, width = (1 << level) - 1, 1 << level
+        child = 2 * start + 1
+        base = pre_id[:, start : start + width]
+        left_sz = size[:, child : child + 2 * width : 2]
+        pre_id[:, child : child + 2 * width : 2] = base + 1
+        pre_id[:, child + 1 : child + 1 + 2 * width : 2] = base + 1 + left_sz
+    pre_id = np.where(exists, pre_id, np.iinfo(np.int64).max)
+    order = np.argsort(pre_id, axis=1, kind="stable")  # existing slots first
+    counts = exists.sum(axis=1)
+    keep = np.arange(m)[None, :] < counts[:, None]  # first count[t] of each row
+    trees = np.repeat(np.arange(t_n, dtype=np.int32), counts)
+    slots = order[keep]
+    flat = (np.arange(t_n)[:, None] * m + order)[keep]  # (t, slot) flat index
+    pre_flat = pre_id.reshape(-1)[flat].astype(np.int32)
+    int_flat = internal.reshape(-1)[flat]
+    left_slot = np.minimum(2 * (flat % m) + 1, m - 1)
+    right_slot = np.minimum(2 * (flat % m) + 2, m - 1)
+    base_flat = (flat // m) * m
+    left_id = np.where(
+        int_flat, pre_id.reshape(-1)[base_flat + left_slot], -1
+    ).astype(np.int32)
+    right_id = np.where(
+        int_flat, pre_id.reshape(-1)[base_flat + right_slot], -1
+    ).astype(np.int32)
+    return trees, slots.astype(np.int32), pre_flat, left_id, right_id
+
+
+# A tree of depth d occupies 2^(d+1)-1 heap slots. Reference-conformant trees
+# have depth <= ceil(log2(maxSamples)) (IsolationTree.scala:60-61), so even
+# maxSamples = 10^6 stays under 21. A corrupt or adversarial node table
+# encoding a deep chain would otherwise force a 2^depth allocation.
+_MAX_TREE_DEPTH = 24
+
+
+def _check_depth(depth: int) -> None:
+    if depth > _MAX_TREE_DEPTH:
+        raise ValueError(
+            f"refusing to materialise a tree of depth {depth} (> {_MAX_TREE_DEPTH}): "
+            f"the implicit-heap layout would need 2^{depth + 1} slots; "
+            "the node table is corrupt or not a valid isolation-forest model"
+        )
+
+
+def _assign_heap_slots(records: List[dict]) -> Tuple[dict, int]:
+    """Pre-order records -> {node id: heap slot}; validates contiguous ids
+    (the reference's buildTreeFromNodes contract,
+    IsolationForestModelReadWrite.scala:179-205)."""
+    by_id = {r["id"]: r for r in records}
+    if sorted(by_id) != list(range(len(records))):
+        raise ValueError("corrupt model data: node ids are not 0..N-1")
+    slots: dict = {}
+    max_depth = 0
+    stack = [(0, 0, 0)]  # (node id, heap slot, depth)
+    while stack:
+        rid, slot, depth = stack.pop()
+        _check_depth(depth)  # in-loop: terminates cycles and deep chains alike
+        slots[rid] = slot
+        max_depth = max(max_depth, depth)
+        r = by_id[rid]
+        if r["leftChild"] >= 0:
+            stack.append((r["leftChild"], 2 * slot + 1, depth + 1))
+            stack.append((r["rightChild"], 2 * slot + 2, depth + 1))
+    return slots, max_depth
+
+
+def records_to_standard_forest(
+    trees: List[List[dict]], threshold_dtype=np.float32
+) -> StandardForest:
+    """``threshold_dtype=np.float64`` preserves the reference's Double split
+    values exactly (inspection / golden-structure checks); compute uses f32."""
+    depths = []
+    slot_maps = []
+    for records in trees:
+        slots, depth = _assign_heap_slots(records)
+        slot_maps.append(slots)
+        depths.append(depth)
+    height = max(depths) if depths else 0
+    _check_depth(height)
+    M = 2 ** (height + 1) - 1
+    T = len(trees)
+    feature = np.full((T, M), -1, np.int32)
+    threshold = np.zeros((T, M), threshold_dtype)
+    num_instances = np.full((T, M), -1, np.int32)
+    for t, records in enumerate(trees):
+        slots = slot_maps[t]
+        for r in records:
+            slot = slots[r["id"]]
+            if r["leftChild"] >= 0:
+                feature[t, slot] = r["splitAttribute"]
+                threshold[t, slot] = r["splitValue"]
+            else:
+                num_instances[t, slot] = r["numInstances"]
+    return StandardForest(
+        feature=feature, threshold=threshold, num_instances=num_instances
+    )
+
+
+def records_to_extended_forest(
+    trees: List[List[dict]], offset_dtype=np.float32
+) -> ExtendedForest:
+    depths = []
+    slot_maps = []
+    k = 1
+    for records in trees:
+        slots, depth = _assign_heap_slots(records)
+        slot_maps.append(slots)
+        depths.append(depth)
+        for r in records:
+            if r["leftChild"] >= 0:
+                k = max(k, len(r["indices"]))
+    height = max(depths) if depths else 0
+    _check_depth(height)
+    M = 2 ** (height + 1) - 1
+    T = len(trees)
+    indices = np.full((T, M, k), -1, np.int32)
+    weights = np.zeros((T, M, k), np.float32)
+    offset = np.zeros((T, M), offset_dtype)
+    num_instances = np.full((T, M), -1, np.int32)
+    for t, records in enumerate(trees):
+        slots = slot_maps[t]
+        for r in records:
+            slot = slots[r["id"]]
+            if r["leftChild"] >= 0:
+                nk = len(r["indices"])
+                indices[t, slot, :nk] = r["indices"]
+                weights[t, slot, :nk] = r["weights"]
+                offset[t, slot] = r["offset"]
+            else:
+                num_instances[t, slot] = r["numInstances"]
+    return ExtendedForest(
+        indices=indices, weights=weights, offset=offset, num_instances=num_instances
+    )
+
+
+# --------------------------------------------------------------------------- #
+# directory layout helpers
+# --------------------------------------------------------------------------- #
+
+
+def _prepare_dir(path: str, overwrite: bool) -> None:
+    if os.path.exists(path):
+        if not overwrite:
+            raise FileExistsError(
+                f"path {path} already exists; pass overwrite=True to replace"
+            )
+        shutil.rmtree(path)
+    os.makedirs(os.path.join(path, "metadata"))
+
+
+def _write_metadata(path: str, metadata: dict) -> None:
+    with open(os.path.join(path, "metadata", "part-00000"), "w") as fh:
+        fh.write(json.dumps(metadata, separators=(",", ":")))
+        fh.write("\n")
+    open(os.path.join(path, "metadata", "_SUCCESS"), "w").close()
+
+
+def _read_metadata(path: str) -> dict:
+    # first line of the metadata file (loadMetadata,
+    # core/IsolationForestModelReadWriteUtils.scala:97-104)
+    meta_dir = os.path.join(path, "metadata")
+    part = os.path.join(meta_dir, "part-00000")
+    if not os.path.exists(part):
+        parts = sorted(
+            f for f in os.listdir(meta_dir) if f.startswith("part-")
+        )
+        if not parts:
+            raise FileNotFoundError(f"no metadata part files under {meta_dir}")
+        part = os.path.join(meta_dir, parts[0])
+    with open(part) as fh:
+        return json.loads(fh.readline())
+
+
+def _data_part_path(path: str) -> str:
+    """Spark-layout framing shared by both save paths: data dir + single
+    part file; caller writes it, then :func:`_mark_success` seals it."""
+    data_dir = os.path.join(path, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    return os.path.join(data_dir, f"part-00000-{uuid.uuid4()}-c000.avro")
+
+
+def _mark_success(path: str) -> None:
+    open(os.path.join(path, "data", "_SUCCESS"), "w").close()
+
+
+def _write_data(path: str, schema: dict, records: List[dict]) -> None:
+    avro.write_container(_data_part_path(path), schema, records)
+    _mark_success(path)
+
+
+def _read_data(path: str) -> List[dict]:
+    data_dir = os.path.join(path, "data")
+    records: List[dict] = []
+    for fname in sorted(os.listdir(data_dir)):
+        if fname.endswith(".avro"):
+            _, recs = avro.read_container(os.path.join(data_dir, fname))
+            records.extend(recs)
+    if not records:
+        raise FileNotFoundError(f"no avro data files under {data_dir}")
+    return records
+
+
+# --------------------------------------------------------------------------- #
+# native columnar load fast path
+# --------------------------------------------------------------------------- #
+
+
+def _preorder_slots(is_internal_list: List[bool]) -> Tuple[List[int], int]:
+    """Heap slots for a tree's nodes given their pre-order internal flags.
+
+    Pre-order with contiguous ids makes child lookup unnecessary: walk the
+    sequence with an explicit slot stack (left child visited immediately
+    after its parent). Returns (slots, max_depth)."""
+    slots = [0] * len(is_internal_list)
+    stack = [0]
+    max_slot = 0
+    slot_cap = (1 << (_MAX_TREE_DEPTH + 2)) - 1  # in-loop depth enforcement
+    for i, internal in enumerate(is_internal_list):
+        slot = stack.pop()
+        if slot > slot_cap:
+            _check_depth(_MAX_TREE_DEPTH + 1)
+        slots[i] = slot
+        if slot > max_slot:
+            max_slot = slot
+        if internal:
+            stack.append(2 * slot + 2)  # right pops after the left subtree
+            stack.append(2 * slot + 1)
+    if stack:
+        raise ValueError("corrupt model data: pre-order walk did not consume tree")
+    depth = 0
+    while (1 << (depth + 1)) - 1 <= max_slot:
+        depth += 1
+    return slots, depth
+
+
+def _native_node_columns(path: str, kind: str):
+    """Decode the node table into numpy columns via the C++ accelerator;
+    None when the native library is unavailable. ``kind``: 'standard' |
+    'extended'."""
+    from .. import native
+
+    if not native.available():
+        return None
+    data_dir = os.path.join(path, "data")
+    col_parts = []
+    flat_parts = []
+    for fname in sorted(os.listdir(data_dir)):
+        if not fname.endswith(".avro"):
+            continue
+        _, blocks = avro.read_blocks(os.path.join(data_dir, fname))
+        for count, body in blocks:
+            if kind == "standard":
+                cols = native.decode_standard_block(body, count)
+                col_parts.append(cols)
+            else:
+                cols, flat_idx, flat_w, lens = native.decode_extended_block(body, count)
+                cols = dict(cols)
+                cols["_hyper_len"] = lens
+                col_parts.append(cols)
+                flat_parts.append((flat_idx, flat_w))
+    if not col_parts:
+        raise FileNotFoundError(f"no avro data files under {data_dir}")
+    merged = {
+        k: np.concatenate([c[k] for c in col_parts]) for k in col_parts[0]
+    }
+    if np.any(merged["id"] == -2):
+        raise ValueError("corrupt model data: null nodeData rows")
+    if kind == "extended":
+        merged["_flat_indices"] = np.concatenate([f for f, _ in flat_parts])
+        merged["_flat_weights"] = np.concatenate([w for _, w in flat_parts])
+    return merged
+
+
+def _column_tree_ranges(tree_id: np.ndarray, node_id: np.ndarray):
+    """Sort columns by (treeID, id); validate contiguity; return sorted order
+    and per-tree [start, end) ranges."""
+    order = np.lexsort((node_id, tree_id))
+    tid = tree_id[order]
+    nid = node_id[order]
+    tree_ids = np.unique(tid)
+    if not np.array_equal(tree_ids, np.arange(len(tree_ids))):
+        raise ValueError("corrupt model data: treeIDs are not contiguous 0..T-1")
+    starts = np.searchsorted(tid, np.arange(len(tree_ids) + 1))
+    for t in range(len(tree_ids)):
+        s, e = starts[t], starts[t + 1]
+        if not np.array_equal(nid[s:e], np.arange(e - s)):
+            raise ValueError("corrupt model data: node ids are not 0..N-1")
+    return order, starts
+
+
+def columns_to_standard_forest(cols, threshold_dtype=np.float32) -> StandardForest:
+    order, starts = _column_tree_ranges(cols["treeID"], cols["id"])
+    lc = cols["leftChild"][order]
+    sa = cols["splitAttribute"][order]
+    sv = cols["splitValue"][order]
+    ni = cols["numInstances"][order]
+    T = len(starts) - 1
+    internal = (lc >= 0).tolist()
+    all_slots = np.empty(len(lc), np.int64)
+    height = 0
+    for t in range(T):
+        s, e = starts[t], starts[t + 1]
+        slots, depth = _preorder_slots(internal[s:e])
+        all_slots[s:e] = slots
+        height = max(height, depth)
+    _check_depth(height)
+    M = 2 ** (height + 1) - 1
+    feature = np.full((T, M), -1, np.int32)
+    threshold = np.zeros((T, M), threshold_dtype)
+    num_instances = np.full((T, M), -1, np.int32)
+    tree_of = np.repeat(np.arange(T), np.diff(starts))
+    is_int = lc >= 0
+    feature[tree_of[is_int], all_slots[is_int]] = sa[is_int]
+    threshold[tree_of[is_int], all_slots[is_int]] = sv[is_int]
+    num_instances[tree_of[~is_int], all_slots[~is_int]] = ni[~is_int]
+    return StandardForest(
+        feature=feature, threshold=threshold, num_instances=num_instances
+    )
+
+
+def columns_to_extended_forest(cols, offset_dtype=np.float32) -> ExtendedForest:
+    order, starts = _column_tree_ranges(cols["treeID"], cols["id"])
+    lc = cols["leftChild"][order]
+    off = cols["offset"][order]
+    ni = cols["numInstances"][order]
+    lens = cols["_hyper_len"][order]
+    # flat hyperplane buffers are in original record order
+    flat_starts = np.zeros(len(lc) + 1, np.int64)
+    np.cumsum(cols["_hyper_len"], out=flat_starts[1:])
+    T = len(starts) - 1
+    internal = (lc >= 0).tolist()
+    all_slots = np.empty(len(lc), np.int64)
+    height = 0
+    for t in range(T):
+        s, e = starts[t], starts[t + 1]
+        slots, depth = _preorder_slots(internal[s:e])
+        all_slots[s:e] = slots
+        height = max(height, depth)
+    _check_depth(height)
+    M = 2 ** (height + 1) - 1
+    k = int(lens.max()) if len(lens) else 1
+    k = max(k, 1)
+    indices = np.full((T, M, k), -1, np.int32)
+    weights = np.zeros((T, M, k), np.float32)
+    offset = np.zeros((T, M), offset_dtype)
+    num_instances = np.full((T, M), -1, np.int32)
+    tree_of = np.repeat(np.arange(T), np.diff(starts))
+    flat_idx = cols["_flat_indices"]
+    flat_w = cols["_flat_weights"]
+    for pos in range(len(lc)):
+        orig = order[pos]
+        t = tree_of[pos]
+        slot = all_slots[pos]
+        if lc[pos] >= 0:
+            n_k = int(cols["_hyper_len"][orig])
+            fs = flat_starts[orig]
+            indices[t, slot, :n_k] = flat_idx[fs : fs + n_k]
+            weights[t, slot, :n_k] = flat_w[fs : fs + n_k]
+            offset[t, slot] = off[pos]
+        else:
+            num_instances[t, slot] = ni[pos]
+    return ExtendedForest(
+        indices=indices, weights=weights, offset=offset, num_instances=num_instances
+    )
+
+
+def _group_trees(records: List[dict], payload_field: str) -> List[List[dict]]:
+    """groupByKey(treeID) + sortByKey equivalent
+    (IsolationForestModelReadWrite.scala:282-288)."""
+    trees: dict = {}
+    for rec in records:
+        trees.setdefault(rec["treeID"], []).append(rec[payload_field])
+    tree_ids = sorted(trees)
+    if tree_ids != list(range(len(tree_ids))):
+        raise ValueError("corrupt model data: treeIDs are not contiguous 0..T-1")
+    return [sorted(trees[t], key=lambda r: r["id"]) for t in tree_ids]
+
+
+def _check_class(metadata: dict, expected: str) -> None:
+    cls = metadata.get("class")
+    if cls != expected:
+        raise ValueError(
+            f"metadata class mismatch: expected {expected}, found {cls}"
+        )
+
+
+# --------------------------------------------------------------------------- #
+# model save / load
+# --------------------------------------------------------------------------- #
+
+
+def _model_metadata(model, class_name: str) -> dict:
+    return {
+        "class": class_name,
+        "timestamp": int(time.time() * 1000),
+        "sparkVersion": SPARK_VERSION_STRING,
+        "uid": model.uid,
+        "paramMap": model.params.to_param_map(),
+        # extras (IsolationForestModelReadWrite.scala:220-224)
+        "outlierScoreThreshold": model.outlier_score_threshold
+        if model.outlier_score_threshold >= 0
+        else -1.0,
+        "numSamples": model.num_samples,
+        "numFeatures": model.num_features,
+        "totalNumFeatures": model.total_num_features,
+    }
+
+
+def _write_data_raw(path: str, schema: dict, body: bytes, count: int) -> None:
+    avro.write_container_raw(_data_part_path(path), schema, [(count, body)])
+    _mark_success(path)
+
+
+def _fast_standard_body(forest):
+    """Vectorised pre-order + native columnar encode; None if unavailable."""
+    from .. import native
+
+    if not native.available():
+        return None
+    feature = np.asarray(forest.feature)
+    threshold = np.asarray(forest.threshold)
+    num_instances = np.asarray(forest.num_instances)
+    m = feature.shape[1]
+    trees, slots, pre, left, right = heap_preorder_columns(feature >= 0)
+    flat = trees.astype(np.int64) * m + slots
+    attr = feature.reshape(-1)[flat]
+    is_int = attr >= 0
+    # leaf sentinels per IsolationForestModelReadWrite.scala:36-67
+    val = np.where(is_int, threshold.reshape(-1)[flat].astype(np.float64), 0.0)
+    ni = np.where(is_int, -1, num_instances.reshape(-1)[flat]).astype(np.int64)
+    body = native.encode_standard_records(trees, pre, left, right, attr, val, ni)
+    if body is None:
+        return None
+    return body, len(trees)
+
+
+def save_standard_model(model, path: str, overwrite: bool = False) -> None:
+    _prepare_dir(path, overwrite)
+    _write_metadata(path, _model_metadata(model, STANDARD_MODEL_CLASS))
+    fast = _fast_standard_body(model.forest)
+    if fast is not None:
+        _write_data_raw(path, STANDARD_SCHEMA, *fast)
+        logger.info(
+            "saved IsolationForestModel (%d trees) to %s (native encoder)",
+            model.forest.num_trees,
+            path,
+        )
+        return
+    feature = np.asarray(model.forest.feature)
+    threshold = np.asarray(model.forest.threshold)
+    num_instances = np.asarray(model.forest.num_instances)
+    records = []
+    for t in range(model.forest.num_trees):
+        for node in standard_tree_to_records(feature[t], threshold[t], num_instances[t]):
+            records.append({"treeID": t, "nodeData": node})
+    _write_data(path, STANDARD_SCHEMA, records)
+    logger.info("saved IsolationForestModel (%d trees) to %s", len(feature), path)
+
+
+def _fast_extended_body(forest):
+    """EIF variant of :func:`_fast_standard_body`."""
+    from .. import native
+
+    if not native.available():
+        return None
+    indices = np.asarray(forest.indices)
+    weights = np.asarray(forest.weights)
+    offset = np.asarray(forest.offset)
+    num_instances = np.asarray(forest.num_instances)
+    t_n, m, k = indices.shape
+    trees, slots, pre, left, right = heap_preorder_columns(indices[:, :, 0] >= 0)
+    flat = trees.astype(np.int64) * m + slots
+    idx_rows = indices.reshape(-1, k)[flat]  # [n, k]
+    w_rows = weights.reshape(-1, k)[flat]
+    valid = idx_rows >= 0
+    hyper_len = valid.sum(axis=1).astype(np.int32)
+    flat_idx = idx_rows[valid].astype(np.int32)
+    flat_w = w_rows[valid].astype(np.float32)
+    is_int = idx_rows[:, 0] >= 0
+    off = np.where(is_int, offset.reshape(-1)[flat].astype(np.float64), 0.0)
+    ni = np.where(is_int, -1, num_instances.reshape(-1)[flat]).astype(np.int64)
+    body = native.encode_extended_records(
+        trees, pre, left, right, off, ni, hyper_len, flat_idx, flat_w
+    )
+    if body is None:
+        return None
+    return body, len(trees)
+
+
+def save_extended_model(model, path: str, overwrite: bool = False) -> None:
+    _prepare_dir(path, overwrite)
+    meta = _model_metadata(model, EXTENDED_MODEL_CLASS)
+    # resolved extensionLevel always persists on the model (even when the
+    # estimator left it unset — ExtendedIsolationForest.scala:102)
+    meta["paramMap"]["extensionLevel"] = int(model.extension_level)
+    _write_metadata(path, meta)
+    fast = _fast_extended_body(model.forest)
+    if fast is not None:
+        _write_data_raw(path, EXTENDED_SCHEMA, *fast)
+        logger.info(
+            "saved ExtendedIsolationForestModel (%d trees) to %s (native encoder)",
+            model.forest.num_trees,
+            path,
+        )
+        return
+    indices = np.asarray(model.forest.indices)
+    weights = np.asarray(model.forest.weights)
+    offset = np.asarray(model.forest.offset)
+    num_instances = np.asarray(model.forest.num_instances)
+    records = []
+    for t in range(model.forest.num_trees):
+        for node in extended_tree_to_records(
+            indices[t], weights[t], offset[t], num_instances[t]
+        ):
+            records.append({"treeID": t, "extendedNodeData": node})
+    _write_data(path, EXTENDED_SCHEMA, records)
+    logger.info("saved ExtendedIsolationForestModel (%d trees) to %s", len(indices), path)
+
+
+def _load_common(path: str, expected_class: str):
+    metadata = _read_metadata(path)
+    _check_class(metadata, expected_class)
+    if "totalNumFeatures" in metadata:
+        total_num_features = int(metadata["totalNumFeatures"])
+    else:
+        # legacy fallback (IsolationForestModelReadWrite.scala:298-306)
+        logger.warning(
+            "loading legacy model without totalNumFeatures; feature-width "
+            "validation disabled (sentinel -1)"
+        )
+        total_num_features = UNKNOWN_TOTAL_NUM_FEATURES
+    return metadata, total_num_features
+
+
+def load_standard_model(path: str):
+    from ..models.isolation_forest import IsolationForestModel
+
+    metadata, total_num_features = _load_common(path, STANDARD_MODEL_CLASS)
+    params = IsolationForestParams.from_param_map(metadata["paramMap"])
+    try:  # native columnar fast path (~5x on 1000-tree models)
+        cols = _native_node_columns(path, "standard")
+    except (ImportError, OSError):
+        cols = None
+    if cols is not None:
+        forest = columns_to_standard_forest(cols)
+    else:
+        trees = _group_trees(_read_data(path), "nodeData")
+        forest = records_to_standard_forest(trees)
+    model = IsolationForestModel(
+        forest=forest,
+        params=params,
+        num_samples=int(metadata["numSamples"]),
+        num_features=int(metadata["numFeatures"]),
+        total_num_features=total_num_features,
+        uid=metadata.get("uid"),
+    )
+    threshold = float(metadata.get("outlierScoreThreshold", -1.0))
+    if threshold >= 0:
+        model.set_outlier_score_threshold(threshold)
+    return model
+
+
+def load_extended_model(path: str):
+    from ..models.extended import ExtendedIsolationForestModel
+
+    metadata, total_num_features = _load_common(path, EXTENDED_MODEL_CLASS)
+    params = ExtendedIsolationForestParams.from_param_map(metadata["paramMap"])
+    try:
+        cols = _native_node_columns(path, "extended")
+    except (ImportError, OSError):
+        cols = None
+    if cols is not None:
+        forest = columns_to_extended_forest(cols)
+    else:
+        trees = _group_trees(_read_data(path), "extendedNodeData")
+        forest = records_to_extended_forest(trees)
+    model = ExtendedIsolationForestModel(
+        forest=forest,
+        params=params,
+        num_samples=int(metadata["numSamples"]),
+        num_features=int(metadata["numFeatures"]),
+        extension_level=int(params.extension_level)
+        if params.extension_level is not None
+        else forest.k - 1,
+        total_num_features=total_num_features,
+        uid=metadata.get("uid"),
+    )
+    threshold = float(metadata.get("outlierScoreThreshold", -1.0))
+    if threshold >= 0:
+        model.set_outlier_score_threshold(threshold)
+    return model
+
+
+# --------------------------------------------------------------------------- #
+# estimator save / load (params-only metadata, IsolationForest.scala:114-125)
+# --------------------------------------------------------------------------- #
+
+
+def save_estimator(estimator, path: str, class_name: str, overwrite: bool = False) -> None:
+    _prepare_dir(path, overwrite)
+    metadata = {
+        "class": class_name,
+        "timestamp": int(time.time() * 1000),
+        "sparkVersion": SPARK_VERSION_STRING,
+        "uid": estimator.uid,
+        "paramMap": estimator.params.to_param_map(),
+    }
+    _write_metadata(path, metadata)
+
+
+def load_estimator(path: str, params_cls, expected_class: str):
+    metadata = _read_metadata(path)
+    _check_class(metadata, expected_class)
+    params = params_cls.from_param_map(metadata["paramMap"])
+    return params, metadata.get("uid")

@@ -1,0 +1,163 @@
+"""Extended isolation forest Estimator / Model (random hyperplane splits).
+
+Parity with ``extended/ExtendedIsolationForest.scala:40-136`` and
+``extended/ExtendedIsolationForestModel.scala:37-175``: identical fit
+orchestration to the standard estimator plus fit-time ``extensionLevel``
+resolution (default ``numFeatures - 1``; the estimator itself is never
+mutated — the resolved level is recorded on the model only,
+ExtendedIsolationForest.scala:56-69,102).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.bagging import bagged_indices, feature_subsets, per_tree_keys
+from ..ops.ext_growth import ExtendedForest, grow_extended_forest
+from ..utils import (
+    ExtendedIsolationForestParams,
+    UNKNOWN_TOTAL_NUM_FEATURES,
+    extract_features,
+    height_limit,
+    logger,
+    phase,
+    resolve_extension_level,
+    resolve_params,
+)
+from .isolation_forest import (
+    IsolationForestModel,
+    _ParamSetters,
+    _compute_and_set_threshold,
+    _new_uid,
+)
+
+_REFERENCE_MODEL_CLASS = (
+    "com.linkedin.relevance.isolationforest.extended.ExtendedIsolationForestModel"
+)
+_REFERENCE_ESTIMATOR_CLASS = (
+    "com.linkedin.relevance.isolationforest.extended.ExtendedIsolationForest"
+)
+
+
+class ExtendedIsolationForest(_ParamSetters):
+    """Estimator: ``fit(data) -> ExtendedIsolationForestModel``."""
+
+    def __init__(
+        self,
+        params: Optional[ExtendedIsolationForestParams] = None,
+        uid=None,
+        **kw,
+    ):
+        self.params = (
+            params if params is not None else ExtendedIsolationForestParams(**kw)
+        )
+        self.uid = uid or _new_uid("extended-isolation-forest")
+
+    def set_extension_level(self, v: int):
+        return self._set(extension_level=v)
+
+    def fit(self, data, mesh=None) -> "ExtendedIsolationForestModel":
+        p = self.params
+        X, _ = extract_features(data, p.features_col)
+        total_rows, total_feats = int(X.shape[0]), int(X.shape[1])
+        resolved = resolve_params(p, total_feats, total_rows)
+        ext_level = resolve_extension_level(p.extension_level, resolved.num_features)
+        logger.info(
+            "resolved: numSamples=%d numFeatures=%d extensionLevel=%d",
+            resolved.num_samples, resolved.num_features, ext_level,
+        )
+
+        h = height_limit(resolved.num_samples)
+        key = jax.random.PRNGKey(np.uint32(p.random_seed & 0xFFFFFFFF))
+        k_bag, k_feat, k_grow = jax.random.split(key, 3)
+
+        Xd = jnp.asarray(X, jnp.float32)
+        with phase("extended_isolation_forest.fit.bagging"):
+            bag = bagged_indices(
+                k_bag, total_rows, resolved.num_samples, p.num_estimators, p.bootstrap
+            )
+            fidx = feature_subsets(
+                k_feat, total_feats, resolved.num_features, p.num_estimators
+            )
+        tree_keys = per_tree_keys(k_grow, p.num_estimators)
+        with phase("extended_isolation_forest.fit.grow"):
+            if mesh is not None:
+                from ..parallel.sharded import sharded_grow_extended_forest
+
+                forest = sharded_grow_extended_forest(
+                    mesh, tree_keys, Xd, bag, fidx, h, ext_level
+                )
+            else:
+                forest = jax.jit(
+                    grow_extended_forest,
+                    static_argnames=("height", "extension_level"),
+                )(tree_keys, Xd, bag, fidx, height=h, extension_level=ext_level)
+            forest = jax.tree_util.tree_map(jax.block_until_ready, forest)
+
+        model = ExtendedIsolationForestModel(
+            forest=forest,
+            params=p,
+            num_samples=resolved.num_samples,
+            num_features=resolved.num_features,
+            extension_level=ext_level,
+            total_num_features=total_feats,
+        )
+        _compute_and_set_threshold(model, Xd, mesh=mesh)
+        return model
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from ..io.persistence import save_estimator
+
+        save_estimator(self, path, _REFERENCE_ESTIMATOR_CLASS, overwrite=overwrite)
+
+    @classmethod
+    def load(cls, path: str) -> "ExtendedIsolationForest":
+        from ..io.persistence import load_estimator
+
+        params, uid = load_estimator(
+            path, ExtendedIsolationForestParams, _REFERENCE_ESTIMATOR_CLASS
+        )
+        return cls(params=params, uid=uid)
+
+
+class ExtendedIsolationForestModel(IsolationForestModel):
+    """Fitted EIF model. Scoring dispatches on the forest type (hyperplane
+    traversal, ExtendedIsolationForestModel.scala:98-135); only persistence
+    and the recorded ``extension_level`` differ from the base model."""
+
+    def __init__(
+        self,
+        forest: ExtendedForest,
+        params: ExtendedIsolationForestParams,
+        num_samples: int,
+        num_features: int,
+        extension_level: int,
+        total_num_features: int = UNKNOWN_TOTAL_NUM_FEATURES,
+        outlier_score_threshold: float = -1.0,
+        uid: Optional[str] = None,
+    ):
+        super().__init__(
+            forest=forest,
+            params=params,
+            num_samples=num_samples,
+            num_features=num_features,
+            total_num_features=total_num_features,
+            outlier_score_threshold=outlier_score_threshold,
+            uid=uid or _new_uid("extended-isolation-forest"),
+        )
+        self.extension_level = int(extension_level)
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from ..io.persistence import save_extended_model
+
+        save_extended_model(self, path, overwrite=overwrite)
+
+    @classmethod
+    def load(cls, path: str) -> "ExtendedIsolationForestModel":
+        from ..io.persistence import load_extended_model
+
+        return load_extended_model(path)

@@ -1,0 +1,319 @@
+"""Standard isolation forest Estimator / Model.
+
+API parity with the reference's spark.ml pair
+(``IsolationForest.scala:25-125`` / ``IsolationForestModel.scala:37-192``):
+same hyper-parameters, defaults, validators, fit orchestration
+(``core/SharedTrainLogic.scala``) and scoring semantics — re-hosted on JAX.
+``fit``/``transform`` accept an ``[N, F]`` array or a pandas DataFrame with a
+vector-valued features column (the Dataset analogue); ``transform`` appends
+``outlierScore`` and ``predictedLabel`` columns exactly like the reference's
+``withColumn`` pipeline (IsolationForestModel.scala:142-148).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.bagging import bagged_indices, feature_subsets, per_tree_keys
+from ..ops.quantile import contamination_threshold, observed_contamination
+from ..ops.traversal import score_matrix
+from ..ops.tree_growth import StandardForest, grow_forest
+from ..utils import (
+    IsolationForestParams,
+    UNKNOWN_TOTAL_NUM_FEATURES,
+    extract_features,
+    height_limit,
+    logger,
+    phase,
+    resolve_params,
+    validate_feature_vector_size,
+)
+
+_REFERENCE_MODEL_CLASS = "com.linkedin.relevance.isolationforest.IsolationForestModel"
+_REFERENCE_ESTIMATOR_CLASS = "com.linkedin.relevance.isolationforest.IsolationForest"
+
+
+def _new_uid(prefix: str) -> str:
+    return f"{prefix}_{uuid.uuid4().hex[:12]}"
+
+
+class _ParamSetters:
+    """Fluent setters mirroring the reference's Params traits
+    (IsolationForestParamsBase.scala:8-110)."""
+
+    params: IsolationForestParams
+
+    def _set(self, **kw):
+        self.params = self.params.replace(**kw)
+        return self
+
+    def set_num_estimators(self, v: int):
+        return self._set(num_estimators=v)
+
+    def set_max_samples(self, v: float):
+        return self._set(max_samples=v)
+
+    def set_contamination(self, v: float):
+        return self._set(contamination=v)
+
+    def set_contamination_error(self, v: float):
+        return self._set(contamination_error=v)
+
+    def set_max_features(self, v: float):
+        return self._set(max_features=v)
+
+    def set_bootstrap(self, v: bool):
+        return self._set(bootstrap=v)
+
+    def set_random_seed(self, v: int):
+        return self._set(random_seed=v)
+
+    def set_features_col(self, v: str):
+        return self._set(features_col=v)
+
+    def set_prediction_col(self, v: str):
+        return self._set(prediction_col=v)
+
+    def set_score_col(self, v: str):
+        return self._set(score_col=v)
+
+
+class IsolationForest(_ParamSetters):
+    """Estimator: ``fit(data) -> IsolationForestModel`` (IsolationForest.scala:46-105)."""
+
+    def __init__(self, params: Optional[IsolationForestParams] = None, uid=None, **kw):
+        self.params = params if params is not None else IsolationForestParams(**kw)
+        self.uid = uid or _new_uid("isolation-forest")
+
+    def fit(self, data, mesh=None) -> "IsolationForestModel":
+        """Train. With ``mesh`` (a `jax.sharding.Mesh` with a ``'trees'`` axis),
+        tree growth is sharded across devices (SURVEY.md §2.4 tree parallelism);
+        otherwise a single-device vmap over the tree axis."""
+        p = self.params
+        X, _ = extract_features(data, p.features_col)
+        total_rows, total_feats = int(X.shape[0]), int(X.shape[1])
+        resolved = resolve_params(p, total_feats, total_rows)
+        logger.info(
+            "resolved params: numSamples=%d numFeatures=%d (of %d rows x %d features)",
+            resolved.num_samples, resolved.num_features, total_rows, total_feats,
+        )
+
+        h = height_limit(resolved.num_samples)
+        key = jax.random.PRNGKey(np.uint32(p.random_seed & 0xFFFFFFFF))
+        k_bag, k_feat, k_grow = jax.random.split(key, 3)
+
+        Xd = jnp.asarray(X, jnp.float32)
+        with phase("isolation_forest.fit.bagging"):
+            bag = bagged_indices(
+                k_bag, total_rows, resolved.num_samples, p.num_estimators, p.bootstrap
+            )
+            fidx = feature_subsets(
+                k_feat, total_feats, resolved.num_features, p.num_estimators
+            )
+        tree_keys = per_tree_keys(k_grow, p.num_estimators)
+        with phase("isolation_forest.fit.grow"):
+            if mesh is not None:
+                from ..parallel.sharded import sharded_grow_forest
+
+                forest = sharded_grow_forest(mesh, tree_keys, Xd, bag, fidx, h)
+            else:
+                forest = jax.jit(grow_forest, static_argnames=("height",))(
+                    tree_keys, Xd, bag, fidx, height=h
+                )
+            forest = jax.tree_util.tree_map(jax.block_until_ready, forest)
+
+        model = IsolationForestModel(
+            forest=forest,
+            params=p,
+            num_samples=resolved.num_samples,
+            num_features=resolved.num_features,
+            total_num_features=total_feats,
+        )
+        _compute_and_set_threshold(model, Xd, mesh=mesh)
+        return model
+
+    # -- persistence (estimator: params-only metadata, IsolationForest.scala:114-125)
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from ..io.persistence import save_estimator
+
+        save_estimator(self, path, _REFERENCE_ESTIMATOR_CLASS, overwrite=overwrite)
+
+    @classmethod
+    def load(cls, path: str) -> "IsolationForest":
+        from ..io.persistence import load_estimator
+
+        params, uid = load_estimator(
+            path, IsolationForestParams, _REFERENCE_ESTIMATOR_CLASS
+        )
+        return cls(params=params, uid=uid)
+
+
+def _compute_and_set_threshold(model, Xd, mesh=None) -> None:
+    """Contamination thresholding (SharedTrainLogic.scala:175-242):
+    skip when contamination == 0 (threshold stays -1, all labels 0);
+    else threshold = quantile(train scores, 1 - contamination) within
+    ``contaminationError``, then verify observed contamination."""
+    p = model.params
+    if p.contamination == 0.0:
+        return
+    with phase("isolation_forest.fit.threshold"):
+        scores = model.score(np.asarray(Xd), mesh=mesh)
+        thr = contamination_threshold(scores, p.contamination, p.contamination_error)
+        model.set_outlier_score_threshold(thr)
+        observed = observed_contamination(scores, thr)
+        verification_error = (
+            p.contamination_error
+            if p.contamination_error > 0
+            else 0.01 * p.contamination
+        )
+        if abs(observed - p.contamination) > verification_error:
+            logger.warning(
+                "observed contamination %.6f deviates from requested %.6f by more "
+                "than %.6f (SharedTrainLogic verification)",
+                observed, p.contamination, verification_error,
+            )
+
+
+class IsolationForestModel:
+    """Fitted model: broadcast-free scoring over the heap-tensor forest.
+
+    Construction contract mirrors IsolationForestModel.scala:37-78: requires a
+    non-empty forest and ``numSamples >= 2``; ``outlierScoreThreshold`` starts
+    at ``-1`` (unset) and labels are all-zero until it is set (:142-148).
+    """
+
+    def __init__(
+        self,
+        forest: StandardForest,
+        params: IsolationForestParams,
+        num_samples: int,
+        num_features: int,
+        total_num_features: int = UNKNOWN_TOTAL_NUM_FEATURES,
+        outlier_score_threshold: float = -1.0,
+        uid: Optional[str] = None,
+    ):
+        if forest.num_trees < 1:
+            raise ValueError("model requires a non-empty forest")
+        if num_samples < 2:
+            raise ValueError(f"numSamples must be >= 2, got {num_samples}")
+        self.forest = forest
+        self.params = params
+        self.num_samples = int(num_samples)
+        self.num_features = int(num_features)
+        self.total_num_features = int(total_num_features)
+        self.outlier_score_threshold = float(outlier_score_threshold)
+        self.uid = uid or _new_uid("isolation-forest")
+
+    def set_outlier_score_threshold(self, value: float) -> "IsolationForestModel":
+        """Manually override the threshold (IsolationForestModel.scala:86-95)."""
+        if not (0.0 <= value <= 1.0 or value == -1.0):
+            raise ValueError(
+                f"outlierScoreThreshold must be in [0, 1] (or -1 = unset), got {value}"
+            )
+        self.outlier_score_threshold = float(value)
+        return self
+
+    # ------------------------------------------------------------------ #
+
+    def score(self, X, mesh=None) -> np.ndarray:
+        """Outlier scores ``2^(-E[h(x)]/c(n))`` for an ``[N, F]`` matrix."""
+        X = np.asarray(X, np.float32)
+        validate_feature_vector_size(X.shape[1], self.total_num_features)
+        if mesh is not None:
+            from ..parallel.sharded import sharded_score
+
+            return sharded_score(mesh, self.forest, X, self.num_samples)
+        return score_matrix(self.forest, X, self.num_samples)
+
+    def warmup(
+        self,
+        batch_sizes=(1024,),
+        strategy: str = "auto",
+        width: Optional[int] = None,
+        mesh=None,
+    ) -> "IsolationForestModel":
+        """Pre-compile the scoring programs for the given batch sizes so
+        latency-sensitive serving never pays XLA compilation on a live
+        request. Returns self.
+
+        Warm with the SAME configuration the serving path will use: the
+        default ``strategy="auto"`` resolves identically here and in
+        :meth:`score` (env var, else the per-platform default — the native
+        C++ walker on CPU, whose per-forest prep this warms instead of an
+        XLA program; dense on TPU), and pass ``mesh`` if serving scores
+        through a mesh (the sharded program is compiled separately). Batch
+        sizes dedupe to their power-of-two buckets, matching
+        :func:`~isoforest_tpu.ops.traversal.score_matrix` bucketing. Legacy
+        models with unknown ``totalNumFeatures`` must pass ``width`` (the
+        serving input's feature count) explicitly.
+        """
+        if width is None:
+            if self.total_num_features == UNKNOWN_TOTAL_NUM_FEATURES:
+                raise ValueError(
+                    "this model does not record totalNumFeatures (legacy); "
+                    "pass width=<serving feature count> to warmup"
+                )
+            width = self.total_num_features
+        buckets = sorted(
+            {
+                max(1024, 1 << int(np.ceil(np.log2(max(int(n), 1)))))
+                for n in batch_sizes
+            }
+        )
+        for bucket in buckets:
+            dummy = np.zeros((bucket, max(width, 1)), np.float32)
+            if mesh is not None:
+                from ..parallel.sharded import sharded_score
+
+                sharded_score(mesh, self.forest, dummy, self.num_samples)
+            else:
+                score_matrix(
+                    self.forest, dummy, self.num_samples, strategy=strategy
+                )
+        return self
+
+    def predict(self, scores: np.ndarray) -> np.ndarray:
+        """Labels from scores: ``score >= threshold`` when a threshold is set,
+        else all zeros (IsolationForestModel.scala:142-148)."""
+        if self.outlier_score_threshold > 0:
+            return (scores >= self.outlier_score_threshold).astype(np.float64)
+        return np.zeros_like(scores, dtype=np.float64)
+
+    def transform(self, data, mesh=None):
+        """Append score + label columns (IsolationForestModel.scala:116-151).
+
+        DataFrame in -> DataFrame out (with ``scoreCol``/``predictionCol``
+        appended); array in -> dict of column arrays.
+        """
+        p = self.params
+        X, frame = extract_features(
+            data, p.features_col, output_cols=(p.score_col, p.prediction_col)
+        )
+        scores = self.score(X, mesh=mesh)
+        labels = self.predict(scores)
+        if frame is not None:
+            out = frame.copy()
+            out[p.score_col] = scores.astype(np.float64)
+            out[p.prediction_col] = labels
+            return out
+        return {p.score_col: scores.astype(np.float64), p.prediction_col: labels}
+
+    # ------------------------------------------------------------------ #
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        """Persist in the reference's Avro + JSON-metadata layout
+        (IsolationForestModelReadWrite.scala:210-249)."""
+        from ..io.persistence import save_standard_model
+
+        save_standard_model(self, path, overwrite=overwrite)
+
+    @classmethod
+    def load(cls, path: str) -> "IsolationForestModel":
+        from ..io.persistence import load_standard_model
+
+        return load_standard_model(path)

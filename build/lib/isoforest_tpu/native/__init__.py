@@ -1,0 +1,377 @@
+"""ctypes bindings for the native IO accelerator (build-on-demand).
+
+Compiles ``isoforest_io.cpp`` with the system C++ toolchain on first use and
+caches the shared object next to the source. Every entry point has a
+pure-Python fallback in :mod:`isoforest_tpu.io.avro`; absence of a compiler
+degrades gracefully to the portable path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import pathlib
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = pathlib.Path(__file__).parent
+_SRCS = (_HERE / "isoforest_io.cpp", _HERE / "scorer.cpp", _HERE / "encoder.cpp")
+
+
+def _source_digest() -> str:
+    h = hashlib.sha256()
+    for src in _SRCS:
+        h.update(src.read_bytes())
+    return h.hexdigest()[:12]
+
+
+# Output name derived from the source contents: dlopen dedupes by pathname
+# within a process, and get_library() trusts an existing file — so ANY source
+# change (not just the symbol set) must land at a fresh path or hosts with a
+# cached .so silently keep executing the old kernel.
+_SO = _HERE / f"_isoforest_native_{_source_digest()}.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    compiler = os.environ.get("CXX", "g++")
+    cmd = [
+        compiler,
+        "-O3",
+        # no FMA contraction: the scorer's hyperplane dot must round exactly
+        # like XLA's separate mul+add, or near-tie nodes route differently
+        # and e2e score parity (ONNX gate, strategy equivalence) breaks
+        "-ffp-contract=off",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        *map(str, _SRCS),
+        "-o",
+        str(_SO),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except Exception:
+        return None
+    for stale in _HERE.glob("_isoforest_native_*.so"):
+        if stale != _SO:
+            try:
+                stale.unlink()
+            except OSError:
+                pass
+    return ctypes.CDLL(str(_SO))
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    i64 = ctypes.c_int64
+
+    lib.if_snappy_uncompressed_len.restype = i64
+    lib.if_snappy_uncompressed_len.argtypes = [i8p, i64]
+    lib.if_snappy_decompress.restype = i64
+    lib.if_snappy_decompress.argtypes = [i8p, i64, i8p, i64]
+    lib.if_decode_standard.restype = i64
+    lib.if_decode_standard.argtypes = [
+        i8p, i64, i64, i32p, i32p, i32p, i32p, i32p, f64p, i64p,
+    ]
+    lib.if_decode_extended.restype = i64
+    lib.if_decode_extended.argtypes = [
+        i8p, i64, i64, i32p, i32p, i32p, i32p, f64p, i64p, i32p, i32p, f32p, i64,
+    ]
+    i32 = ctypes.c_int32
+    lib.if_score_standard.restype = None
+    lib.if_score_standard.argtypes = [
+        f32p, i64, i32, i32p, f32p, f32p, i64, i64, i32, f32p,
+    ]
+    lib.if_score_extended.restype = None
+    lib.if_score_extended.argtypes = [
+        f32p, i64, i32, i32p, f32p, f32p, f32p, i64, i64, i32, i32, f32p,
+    ]
+    lib.if_encode_standard.restype = i64
+    lib.if_encode_standard.argtypes = [
+        i32p, i32p, i32p, i32p, i32p, f64p, i64p, i64, i8p, i64,
+    ]
+    lib.if_encode_extended.restype = i64
+    lib.if_encode_extended.argtypes = [
+        i32p, i32p, i32p, i32p, f64p, i64p, i32p, i32p, f32p, i64, i8p, i64,
+    ]
+    return lib
+
+
+def get_library() -> Optional[ctypes.CDLL]:
+    """The bound native library, building it if needed; None if unavailable."""
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed or os.environ.get("ISOFOREST_TPU_NO_NATIVE"):
+        return None
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        lib = None
+        if _SO.exists():
+            try:
+                lib = ctypes.CDLL(str(_SO))
+            except OSError:
+                lib = None
+        if lib is None:
+            lib = _build()
+        if lib is None:
+            _build_failed = True
+            return None
+        try:
+            _lib = _bind(lib)
+        except AttributeError:  # symbol set mismatch: treat as unavailable
+            _build_failed = True
+            return None
+    return _lib
+
+
+def available() -> bool:
+    return get_library() is not None
+
+
+def _u8ptr(buf: np.ndarray):
+    return buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def snappy_decompress(data: bytes) -> Optional[bytes]:
+    """Native snappy block decode; None when the library is unavailable.
+    Raises ValueError on corrupt input (parity with the Python fallback)."""
+    lib = get_library()
+    if lib is None:
+        return None
+    src = np.frombuffer(data, np.uint8)
+    n = lib.if_snappy_uncompressed_len(_u8ptr(src), len(data))
+    if n < 0:
+        raise ValueError("corrupt snappy stream: bad length header")
+    out = np.empty(int(n), np.uint8)
+    written = lib.if_snappy_decompress(_u8ptr(src), len(data), _u8ptr(out), int(n))
+    if written != n:
+        raise ValueError("corrupt snappy stream")
+    return out.tobytes()
+
+
+def decode_standard_block(body: bytes, count: int):
+    """Decode `count` standard node records from an uncompressed Avro block
+    body -> dict of numpy columns; None if the library is unavailable."""
+    lib = get_library()
+    if lib is None:
+        return None
+    src = np.frombuffer(body, np.uint8)
+    # pre-fill with sentinels: null-union rows only write id (= -2), so every
+    # sibling column must hold defined values, not uninitialised memory
+    cols = {
+        "treeID": np.full(count, -1, np.int32),
+        "id": np.full(count, -2, np.int32),
+        "leftChild": np.full(count, -1, np.int32),
+        "rightChild": np.full(count, -1, np.int32),
+        "splitAttribute": np.full(count, -1, np.int32),
+        "splitValue": np.zeros(count, np.float64),
+        "numInstances": np.full(count, -1, np.int64),
+    }
+    consumed = lib.if_decode_standard(
+        _u8ptr(src), len(body), count,
+        cols["treeID"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["id"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["leftChild"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["rightChild"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["splitAttribute"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["splitValue"].ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        cols["numInstances"].ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if consumed != len(body):
+        raise ValueError("corrupt Avro block (standard node records)")
+    return cols
+
+
+def decode_extended_block(body: bytes, count: int):
+    """Extended-schema variant; returns (columns, flat_indices, flat_weights,
+    per_record_len) or None."""
+    lib = get_library()
+    if lib is None:
+        return None
+    src = np.frombuffer(body, np.uint8)
+    flat_cap = max(len(body), 16)  # safe upper bound: >= total array items
+    cols = {
+        "treeID": np.full(count, -1, np.int32),
+        "id": np.full(count, -2, np.int32),
+        "leftChild": np.full(count, -1, np.int32),
+        "rightChild": np.full(count, -1, np.int32),
+        "offset": np.zeros(count, np.float64),
+        "numInstances": np.full(count, -1, np.int64),
+    }
+    hyper_len = np.zeros(count, np.int32)
+    flat_indices = np.empty(flat_cap, np.int32)
+    flat_weights = np.empty(flat_cap, np.float32)
+    consumed = lib.if_decode_extended(
+        _u8ptr(src), len(body), count,
+        cols["treeID"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["id"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["leftChild"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["rightChild"].ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols["offset"].ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        cols["numInstances"].ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        hyper_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        flat_indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        flat_weights.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        flat_cap,
+    )
+    if consumed != len(body):
+        raise ValueError("corrupt Avro block (extended node records)")
+    total = int(hyper_len.sum())
+    return cols, flat_indices[:total], flat_weights[:total], hyper_len
+
+
+def _f32ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _i32ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+# Per-forest host-side prep (contiguous copies + leaf-value table) cached by
+# array identities, same policy as the Pallas prep cache: serving loops that
+# score many small batches must not re-copy the forest per call. Holding the
+# keyed arrays prevents id() reuse; bounded FIFO.
+_PREP_CACHE: dict = {}
+_PREP_CACHE_MAX = 8
+
+
+def _cached(arrays: tuple, build):
+    key = tuple(id(a) for a in arrays)
+    hit = _PREP_CACHE.get(key)
+    if hit is not None and all(a is b for a, b in zip(hit[0], arrays)):
+        return hit[1]
+    prep = build()
+    if len(_PREP_CACHE) >= _PREP_CACHE_MAX:
+        _PREP_CACHE.pop(next(iter(_PREP_CACHE)))
+    _PREP_CACHE[key] = (arrays, prep)
+    return prep
+
+
+def score_standard(feature, threshold, num_instances, X, height: int):
+    """Mean path length f32[N] via the native walker; None if unavailable.
+
+    Arrays follow ops/tree_growth.StandardForest layout ([T, M] i32/f32/i32).
+    """
+    lib = get_library()
+    if lib is None:
+        return None
+    from ..utils.math import leaf_value_table
+
+    X = np.ascontiguousarray(X, np.float32)
+    feature, threshold, leaf_value = _cached(
+        (feature, threshold, num_instances),
+        lambda: (
+            np.ascontiguousarray(feature, np.int32),
+            np.ascontiguousarray(threshold, np.float32),
+            leaf_value_table(num_instances, height),
+        ),
+    )
+    n, f = X.shape
+    t, m = feature.shape
+    out = np.empty(n, np.float32)
+    lib.if_score_standard(
+        _f32ptr(X), n, f, _i32ptr(feature), _f32ptr(threshold),
+        _f32ptr(leaf_value), t, m, height, _f32ptr(out),
+    )
+    return out
+
+
+def score_extended(indices, weights, offset, num_instances, X, height: int):
+    """Extended-forest variant ([T, M, k] hyperplanes); None if unavailable."""
+    lib = get_library()
+    if lib is None:
+        return None
+    from ..utils.math import leaf_value_table
+
+    X = np.ascontiguousarray(X, np.float32)
+    indices, weights, offset, leaf_value = _cached(
+        (indices, weights, offset, num_instances),
+        lambda: (
+            np.ascontiguousarray(indices, np.int32),
+            np.ascontiguousarray(weights, np.float32),
+            np.ascontiguousarray(offset, np.float32),
+            leaf_value_table(num_instances, height),
+        ),
+    )
+    n, f = X.shape
+    t, m, k = indices.shape
+    out = np.empty(n, np.float32)
+    lib.if_score_extended(
+        _f32ptr(X), n, f, _i32ptr(indices), _f32ptr(weights), _f32ptr(offset),
+        _f32ptr(leaf_value), t, m, k, height, _f32ptr(out),
+    )
+    return out
+
+
+def _i64ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f64ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def encode_standard_records(tree_id, node_id, left, right, attr, value, ni):
+    """Columns -> Avro binary body for (treeID, nodeData) rows; None if the
+    native library is unavailable."""
+    lib = get_library()
+    if lib is None:
+        return None
+    n = len(tree_id)
+    cap = 64 * n + 64
+    out = np.empty(cap, np.uint8)
+    written = lib.if_encode_standard(
+        _i32ptr(np.ascontiguousarray(tree_id, np.int32)),
+        _i32ptr(np.ascontiguousarray(node_id, np.int32)),
+        _i32ptr(np.ascontiguousarray(left, np.int32)),
+        _i32ptr(np.ascontiguousarray(right, np.int32)),
+        _i32ptr(np.ascontiguousarray(attr, np.int32)),
+        _f64ptr(np.ascontiguousarray(value, np.float64)),
+        _i64ptr(np.ascontiguousarray(ni, np.int64)),
+        n, _u8ptr(out), cap,
+    )
+    if written < 0:
+        return None
+    return out[:written].tobytes()
+
+
+def encode_extended_records(
+    tree_id, node_id, left, right, offset, ni, hyper_len, flat_idx, flat_w
+):
+    """Extended variant; hyperplanes flattened with per-record lengths."""
+    lib = get_library()
+    if lib is None:
+        return None
+    n = len(tree_id)
+    cap = 96 * n + 14 * len(flat_idx) + 64
+    out = np.empty(cap, np.uint8)
+    written = lib.if_encode_extended(
+        _i32ptr(np.ascontiguousarray(tree_id, np.int32)),
+        _i32ptr(np.ascontiguousarray(node_id, np.int32)),
+        _i32ptr(np.ascontiguousarray(left, np.int32)),
+        _i32ptr(np.ascontiguousarray(right, np.int32)),
+        _f64ptr(np.ascontiguousarray(offset, np.float64)),
+        _i64ptr(np.ascontiguousarray(ni, np.int64)),
+        _i32ptr(np.ascontiguousarray(hyper_len, np.int32)),
+        _i32ptr(np.ascontiguousarray(flat_idx, np.int32)),
+        _f32ptr(np.ascontiguousarray(flat_w, np.float32)),
+        n, _u8ptr(out), cap,
+    )
+    if written < 0:
+        return None
+    return out[:written].tobytes()

@@ -1,0 +1,335 @@
+"""Minimal protobuf wire-format codec for the ONNX subset the converter emits.
+
+The base image has no ``onnx`` package, so the converter serialises
+``ModelProto`` directly at the wire level (clean-room against the public
+onnx.proto field numbers, proto3 packed-repeated conventions). Only the
+messages the isolation-forest graph needs are modelled:
+
+    ModelProto{ir_version=1, producer_name=2, graph=7, opset_import=8}
+    OperatorSetIdProto{domain=1, version=2}
+    GraphProto{node=1, name=2, initializer=5, input=11, output=12}
+    NodeProto{input=1, output=2, name=3, op_type=4, attribute=5, domain=7}
+    AttributeProto{name=1, f=2, i=3, s=4, t=5, floats=7, ints=8, strings=9,
+                   type=20}
+    TensorProto{dims=1, data_type=2, name=8, raw_data=9}
+    ValueInfoProto{name=1, type=2}; TypeProto.tensor_type=1;
+    TypeProto.Tensor{elem_type=1, shape=2}; TensorShapeProto.dim=1;
+    Dimension{dim_value=1, dim_param=2}
+
+A generic decoder is included so the bundled numpy evaluator
+(:mod:`.runtime`) and the tests can parse the emitted bytes back without
+onnx/onnxruntime.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple
+
+# TensorProto.DataType
+FLOAT = 1
+INT32 = 6
+INT64 = 7
+STRING = 8
+BOOL = 9
+DOUBLE = 11
+
+# AttributeProto.AttributeType
+ATTR_FLOAT = 1
+ATTR_INT = 2
+ATTR_STRING = 3
+ATTR_TENSOR = 4
+ATTR_FLOATS = 6
+ATTR_INTS = 7
+ATTR_STRINGS = 8
+
+
+def _varint(value: int) -> bytes:
+    if value < 0:
+        value &= (1 << 64) - 1  # two's-complement 10-byte encoding
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def field_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(value)
+
+
+def field_bytes(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def field_string(field: int, value: str) -> bytes:
+    return field_bytes(field, value.encode())
+
+
+def field_packed_floats(field: int, values) -> bytes:
+    return field_bytes(field, struct.pack(f"<{len(values)}f", *values))
+
+
+def encode_varints(values) -> bytes:
+    """Batch protobuf varint encoding (numpy): byte-identical to
+    ``b"".join(_varint(v))`` for any sequence of **int64-range** values —
+    ~100x faster at the 500k-element attribute arrays a 1000-tree
+    TreeEnsembleRegressor carries. Negatives take the 64-bit
+    two's-complement (10-byte) form, same as :func:`_varint`. Narrower
+    domain than the scalar form: requires a sized sequence (not a bare
+    generator) of values in int64 range — protobuf ints are 64-bit, so
+    every legal attribute value qualifies."""
+    import numpy as np
+
+    u = np.asarray(values, dtype=np.int64).astype(np.uint64)
+    if u.size == 0:
+        return b""
+    # bytes per value: ceil(bitlength/7), min 1 (10 for negatives)
+    nbytes = np.ones(u.size, np.int64)
+    shifted = u >> np.uint64(7)
+    while shifted.any():
+        nbytes += (shifted > 0).astype(np.int64)
+        shifted >>= np.uint64(7)
+    offsets = np.zeros(u.size, np.int64)
+    np.cumsum(nbytes[:-1], out=offsets[1:])
+    total = int(offsets[-1] + nbytes[-1])
+    out = np.zeros(total, np.uint8)
+    for pos in range(10):
+        active = nbytes > pos
+        if not active.any():
+            break
+        idx = offsets[active] + pos
+        byte = ((u[active] >> np.uint64(7 * pos)) & np.uint64(0x7F)).astype(
+            np.uint8
+        )
+        cont = (nbytes[active] - 1 > pos).astype(np.uint8) << 7
+        out[idx] = byte | cont
+    return out.tobytes()
+
+
+def field_packed_varints(field: int, values) -> bytes:
+    return field_bytes(field, encode_varints(values))
+
+
+# --------------------------------------------------------------------------- #
+# message builders
+# --------------------------------------------------------------------------- #
+
+
+def attribute(name: str, value) -> bytes:
+    """Build an AttributeProto from a python value (type inferred)."""
+    out = field_string(1, name)
+    if isinstance(value, float):
+        out += _tag(2, 5) + struct.pack("<f", value)
+        out += field_varint(20, ATTR_FLOAT)
+    elif isinstance(value, bool) or isinstance(value, int):
+        out += field_varint(3, int(value))
+        out += field_varint(20, ATTR_INT)
+    elif isinstance(value, str):
+        out += field_bytes(4, value.encode())
+        out += field_varint(20, ATTR_STRING)
+    elif isinstance(value, bytes):  # pre-encoded TensorProto
+        out += field_bytes(5, value)
+        out += field_varint(20, ATTR_TENSOR)
+    elif isinstance(value, (list, tuple)) and value and isinstance(value[0], str):
+        # memoised join: nodes_modes carries ~nodes strings drawn from a
+        # two-value alphabet (BRANCH_LT/LEAF); per-string encode was a
+        # profile hotspot at 1000-tree scale
+        enc: dict = {}
+        out += b"".join(
+            enc.get(s) or enc.setdefault(s, field_bytes(9, s.encode()))
+            for s in value
+        )
+        out += field_varint(20, ATTR_STRINGS)
+    elif isinstance(value, (list, tuple)) and value and isinstance(value[0], float):
+        out += field_packed_floats(7, value)
+        out += field_varint(20, ATTR_FLOATS)
+    else:  # ints (possibly empty list -> INTS)
+        out += field_packed_varints(8, list(value))
+        out += field_varint(20, ATTR_INTS)
+    return out
+
+
+def tensor(name: str, dims, data_type: int, raw: bytes) -> bytes:
+    out = b""
+    if dims:
+        out += field_bytes(1, b"".join(_varint(d) for d in dims))
+    out += field_varint(2, data_type)
+    out += field_string(8, name)
+    out += field_bytes(9, raw)
+    return out
+
+
+def tensor_f32(name: str, values) -> bytes:
+    import numpy as np
+
+    arr = np.asarray(values, np.float32)
+    return tensor(name, list(arr.shape), FLOAT, arr.tobytes())
+
+
+def node(
+    op_type: str,
+    inputs: List[str],
+    outputs: List[str],
+    name: str = "",
+    domain: str = "",
+    attributes: List[bytes] = (),
+) -> bytes:
+    out = b""
+    for i in inputs:
+        out += field_string(1, i)
+    for o in outputs:
+        out += field_string(2, o)
+    if name:
+        out += field_string(3, name)
+    out += field_string(4, op_type)
+    for a in attributes:
+        out += field_bytes(5, a)
+    if domain:
+        out += field_string(7, domain)
+    return out
+
+
+def value_info(name: str, elem_type: int, shape) -> bytes:
+    """shape entries: int (dim_value) or str (dim_param, e.g. batch)."""
+    shape_proto = b""
+    for dim in shape:
+        if isinstance(dim, str):
+            shape_proto += field_bytes(1, field_string(2, dim))
+        else:
+            shape_proto += field_bytes(1, field_varint(1, int(dim)))
+    tensor_type = field_varint(1, elem_type) + field_bytes(2, shape_proto)
+    type_proto = field_bytes(1, tensor_type)
+    return field_string(1, name) + field_bytes(2, type_proto)
+
+
+def graph(
+    nodes: List[bytes],
+    name: str,
+    inputs: List[bytes],
+    outputs: List[bytes],
+    initializers: List[bytes] = (),
+) -> bytes:
+    out = b""
+    for n in nodes:
+        out += field_bytes(1, n)
+    out += field_string(2, name)
+    for t in initializers:
+        out += field_bytes(5, t)
+    for i in inputs:
+        out += field_bytes(11, i)
+    for o in outputs:
+        out += field_bytes(12, o)
+    return out
+
+
+def model(
+    graph_bytes: bytes,
+    opset_imports: List[Tuple[str, int]],
+    ir_version: int = 10,
+    producer_name: str = "isoforest-tpu",
+) -> bytes:
+    out = field_varint(1, ir_version)
+    out += field_string(2, producer_name)
+    out += field_bytes(7, graph_bytes)
+    for domain, version in opset_imports:
+        opset = b""
+        if domain:
+            opset += field_string(1, domain)
+        else:
+            opset += field_bytes(1, b"")
+        opset += field_varint(2, version)
+        out += field_bytes(8, opset)
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# generic decoder
+# --------------------------------------------------------------------------- #
+
+
+def decode_message(data: bytes) -> Dict[int, List[Tuple[int, Any]]]:
+    """Parse a protobuf message into {field_number: [(wire_type, value), ...]}.
+
+    wire 0 -> int, wire 2 -> bytes (caller interprets: submessage, string, or
+    packed scalars), wire 5 -> 4 raw bytes, wire 1 -> 8 raw bytes.
+    """
+    fields: Dict[int, List[Tuple[int, Any]]] = {}
+    pos = 0
+    n = len(data)
+    while pos < n:
+        key = 0
+        shift = 0
+        while True:
+            b = data[pos]
+            pos += 1
+            key |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        field, wire = key >> 3, key & 0x07
+        if wire == 0:
+            value = 0
+            shift = 0
+            while True:
+                b = data[pos]
+                pos += 1
+                value |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            if value >= 1 << 63:
+                value -= 1 << 64
+        elif wire == 2:
+            length = 0
+            shift = 0
+            while True:
+                b = data[pos]
+                pos += 1
+                length |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            value = data[pos : pos + length]
+            pos += length
+        elif wire == 5:
+            value = data[pos : pos + 4]
+            pos += 4
+        elif wire == 1:
+            value = data[pos : pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append((wire, value))
+    return fields
+
+
+def unpack_varints(payload: bytes) -> List[int]:
+    out = []
+    pos = 0
+    while pos < len(payload):
+        value = 0
+        shift = 0
+        while True:
+            b = payload[pos]
+            pos += 1
+            value |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        if value >= 1 << 63:
+            value -= 1 << 64
+        out.append(value)
+    return out
+
+
+def unpack_floats(payload: bytes) -> List[float]:
+    return list(struct.unpack(f"<{len(payload) // 4}f", payload))

@@ -1,0 +1,41 @@
+from .bagging import bagged_indices, feature_subsets, gather_tree_data
+from .dense_traversal import (
+    extended_path_lengths_dense,
+    path_lengths_dense,
+    standard_path_lengths_dense,
+)
+from .ext_growth import ExtendedForest, grow_extended_forest
+from .quantile import (
+    contamination_threshold,
+    exact_quantile,
+    histogram_quantile,
+    observed_contamination,
+)
+from .traversal import (
+    extended_path_lengths,
+    path_lengths,
+    score_matrix,
+    standard_path_lengths,
+)
+from .tree_growth import StandardForest, grow_forest
+
+__all__ = [
+    "bagged_indices",
+    "feature_subsets",
+    "gather_tree_data",
+    "extended_path_lengths_dense",
+    "path_lengths_dense",
+    "standard_path_lengths_dense",
+    "ExtendedForest",
+    "grow_extended_forest",
+    "contamination_threshold",
+    "exact_quantile",
+    "histogram_quantile",
+    "observed_contamination",
+    "extended_path_lengths",
+    "path_lengths",
+    "score_matrix",
+    "standard_path_lengths",
+    "StandardForest",
+    "grow_forest",
+]

@@ -1,0 +1,198 @@
+"""Sampling engine — per-tree bagged sample selection and feature subsets.
+
+TPU-native redesign of the reference's bagging pipeline
+(``core/BaggedPoint.scala:114-217`` + ``core/SharedTrainLogic.scala:99-153``):
+the reference draws a per-(datum, tree) membership weight — Poisson(rate) when
+``bootstrap`` (with replacement) else Binomial(1, rate) (without replacement)
+— flattens duplicates, shuffles each tree's partition and slices the first
+``numSamples`` points. The net effect is: **every tree independently receives
+``numSamples`` rows, uniformly at random, with replacement iff bootstrap.**
+
+Here no data moves at all (SURVEY.md §5.8): the feature matrix stays resident
+in HBM and each tree materialises only an ``int32[num_samples]`` index buffer.
+The Spark shuffle becomes a gather; per-partition reseeding
+(``seed + partitionIndex``, BaggedPoint.scala:169-177) becomes
+``jax.random.fold_in(key, tree_id)`` — a documented RNG-scheme deviation
+(bitwise parity with the JVM RNG chain is impossible and not required; the
+acceptance gates are statistical, SURVEY.md §7.4.3).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Below this many transient elements the full per-tree permutation is cheap;
+# above it, an N-independent sampler must take over.
+_PERMUTATION_MAX_ELEMS = 1 << 26
+# Floyd's algorithm is O(S^2) per tree as a sequential scan of length S —
+# unbeatable for the reference-default S=256 but pathological for huge bags;
+# beyond this S the chunked top-k sampler (O(N log S), bounded transient) wins.
+_FLOYD_MAX_SAMPLES = 1 << 12
+
+
+def per_tree_keys(key: jax.Array, num_trees: int) -> jax.Array:
+    """Independent PRNG keys per tree: ``fold_in(key, tree_id)`` over global
+    tree ids — the TPU analogue of the reference's per-partition reseeding
+    (``seed + partitionIndex``, BaggedPoint.scala:169-177). Computed over the
+    full tree axis so sharding trees across devices keeps streams disjoint."""
+    return jax.vmap(lambda t: jax.random.fold_in(key, t))(
+        jnp.arange(num_trees, dtype=jnp.uint32)
+    )
+
+
+def _floyd_sample(key: jax.Array, num_rows: int, num_samples: int) -> jax.Array:
+    """Exact uniform ``num_samples``-subset of ``[0, num_rows)`` via Floyd's
+    algorithm (Bentley & Floyd 1987): for j = N-S .. N-1 draw t ~ U[0, j]; keep
+    t unless already drawn, else keep j. Every S-subset is equally likely,
+    distinctness is guaranteed by construction, and cost is O(S^2) per tree
+    with O(S) memory — independent of N, so it stays exact in the large-N
+    regime where a full permutation would materialise [T, N] in HBM."""
+    start = num_rows - num_samples
+
+    def step(buf, i):
+        j = start + i
+        t = jax.random.randint(
+            jax.random.fold_in(key, i), (), 0, j + 1, dtype=jnp.int32
+        )
+        val = jnp.where(jnp.any(buf == t), j, t)
+        return buf.at[i].set(val), None
+
+    buf0 = jnp.full((num_samples,), -1, dtype=jnp.int32)
+    buf, _ = jax.lax.scan(step, buf0, jnp.arange(num_samples, dtype=jnp.int32))
+    return buf
+
+
+def _topk_sample(
+    tree_keys: jax.Array, num_rows: int, num_samples: int
+) -> jax.Array:
+    """Exact uniform subsets for the large-S regime: per tree, rank rows by a
+    64-bit random key (two uint32 draws compared lexicographically via a
+    two-key ``lax.sort``) and keep the ``num_samples`` highest-ranked — a
+    symmetric function of i.i.d. draws, so every S-subset is equally likely
+    (to within the ~2^-64 chance of a full 64-bit boundary tie) and indices
+    are distinct by construction. float32 keys would NOT work here: they take
+    only ~2^23 distinct values, and deterministic tie-breaking would bias
+    bags toward low row indices at exactly these row counts. Trees are
+    processed in ``lax.map`` chunks so the ``[chunk, N]`` transient stays
+    bounded instead of materialising [T, N]."""
+
+    def chunk_sample(keys_c):
+        def one(k):
+            k1, k2 = jax.random.split(k)
+            r1 = jax.random.bits(k1, (num_rows,), dtype=jnp.uint32)
+            r2 = jax.random.bits(k2, (num_rows,), dtype=jnp.uint32)
+            idx = jnp.arange(num_rows, dtype=jnp.int32)
+            _, _, sorted_idx = jax.lax.sort((r1, r2, idx), num_keys=2)
+            return sorted_idx[num_rows - num_samples :]
+
+        return jax.vmap(one)(keys_c)
+
+    num_trees = tree_keys.shape[0]
+    chunk = max(1, min(num_trees, _PERMUTATION_MAX_ELEMS // max(num_rows, 1)))
+    if chunk >= num_trees:
+        return chunk_sample(tree_keys)
+    pad = (-num_trees) % chunk
+    keys_p = (
+        jnp.concatenate([tree_keys, tree_keys[:pad]], axis=0) if pad else tree_keys
+    )
+    out = jax.lax.map(
+        chunk_sample, keys_p.reshape(-1, chunk, *tree_keys.shape[1:])
+    )
+    return out.reshape(-1, num_samples)[:num_trees]
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
+def _bagged_indices_jit(
+    key, num_rows, num_samples, num_trees, bootstrap, perm_max, floyd_max
+):
+    # the dispatch thresholds are static args (not read as globals) so tests
+    # that override them can't hit a stale compiled cache entry.
+    # Cost model (measured, 1-core CPU): Floyd ~S^2 cheap ops per tree;
+    # XLA sort (permutation) ~200 ops per element per tree — so Floyd wins
+    # whenever S^2 < 200*N, i.e. everywhere except huge-bag regimes.
+    tree_keys = per_tree_keys(key, num_trees)
+    if bootstrap:
+        sample = lambda k: jax.random.randint(
+            k, (num_samples,), 0, num_rows, dtype=jnp.int32
+        )
+    elif num_samples <= floyd_max and num_samples * num_samples <= 200 * num_rows:
+        sample = lambda k: _floyd_sample(k, num_rows, num_samples)
+    elif num_rows * num_trees <= perm_max:
+        sample = lambda k: jax.random.permutation(k, num_rows)[:num_samples].astype(
+            jnp.int32
+        )
+    elif num_samples <= floyd_max:
+        sample = lambda k: _floyd_sample(k, num_rows, num_samples)
+    else:
+        return _topk_sample(tree_keys, num_rows, num_samples)
+    return jax.vmap(sample)(tree_keys)
+
+
+def bagged_indices(
+    key: jax.Array,
+    num_rows: int,
+    num_samples: int,
+    num_trees: int,
+    bootstrap: bool,
+) -> jax.Array:
+    """Return ``int32[num_trees, num_samples]`` row indices, one bag per tree.
+
+    ``bootstrap=True`` samples with replacement (Poisson branch,
+    BaggedPoint.scala:122-129); ``bootstrap=False`` without replacement
+    (Binomial(1, rate) branch + shuffle/slice, BaggedPoint.scala:130-139 and
+    SharedTrainLogic.scala:283-287) — **exact at every N**: rows within a bag
+    are guaranteed distinct, matching the reference's Binomial(1, rate)
+    semantics, with no large-N approximation. Jitted (shape-static args):
+    eager re-tracing of the vmapped samplers cost seconds per fit; compiled
+    programs land in the persistent compilation cache.
+    """
+    if not bootstrap and num_samples > num_rows:
+        raise ValueError(
+            f"cannot draw {num_samples} distinct rows from {num_rows} without "
+            "replacement (bootstrap=False)"
+        )
+    return _bagged_indices_jit(
+        key,
+        num_rows,
+        num_samples,
+        num_trees,
+        bootstrap,
+        _PERMUTATION_MAX_ELEMS,
+        _FLOYD_MAX_SAMPLES,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def feature_subsets(
+    key: jax.Array,
+    total_num_features: int,
+    num_features: int,
+    num_trees: int,
+) -> jax.Array:
+    """Per-tree sorted random feature subsets, ``int32[num_trees, num_features]``.
+
+    Mirrors ``shuffle(0..F-1).take(numFeatures).sorted``
+    (SharedTrainLogic.scala:300-304). Sorted ascending so persisted
+    ``splitAttribute`` ids are canonical.
+    """
+    tree_keys = per_tree_keys(key, num_trees)
+
+    def subset(k):
+        perm = jax.random.permutation(k, total_num_features)[:num_features]
+        return jnp.sort(perm).astype(jnp.int32)
+
+    return jax.vmap(subset)(tree_keys)
+
+
+def gather_tree_data(X: jax.Array, bag_idx: jax.Array, feat_idx: jax.Array) -> jax.Array:
+    """Materialise per-tree training slabs ``f32[T, S, num_features]``.
+
+    ``X`` is the full ``[N, F]`` matrix (replicated or all-gathered in HBM);
+    the double gather replaces the reference's shuffle-to-partition data
+    movement (SharedTrainLogic.scala:140-145).
+    """
+    rows = X[bag_idx]  # [T, S, F]
+    return jnp.take_along_axis(rows, feat_idx[:, None, :], axis=2)

@@ -1,0 +1,197 @@
+"""Dense (gather-free) path-length scoring — the TPU-native fast path.
+
+The pointer-walk formulation of :mod:`.traversal` performs ``height`` rounds
+of data-dependent gathers per (row, tree). TPUs have no fast per-lane vector
+gather (dynamic indexing in the hardware is slice-granular), so that lowering
+serialises; CPUs fare little better on scattered access. This module
+restructures scoring as pure dense algebra over the implicit heap:
+
+  1. **Node comparisons without gathers**: the go-right bit of node ``n`` for
+     row ``c`` is ``B[c, n] = x[c, feat[n]] >= thr[n]``. Two formulations,
+     dispatched on feature count (crossover measured on a live v5e chip,
+     ``tools/dense_experiments.py``):
+
+     * ``F <= _SELECT_MAX_FEATURES``: per-level *select* — ``F`` masked
+       lane-broadcast passes build ``x[c, feat[n]]`` with no matmul and no
+       ``[C, M]`` materialisation; every op fuses into the level walk
+       (0.35 s vs the HIGHEST-precision contraction's 0.46 s at 524k rows
+       x 100 trees, F=3, live v5e).
+     * large ``F``: one-hot feature-selection contraction ``X @ FOH^T`` at
+       ``lax.Precision.HIGHEST``. The MXU's *default* f32 precision is
+       bfloat16-mantissa passes — measured 0.24 max path-length error vs the
+       exact walk — so the full-precision contraction is mandatory, not a
+       nicety (0.20 s vs the select loop's 1.20 s at F=274).
+
+     For the extended forest the per-node test is ``dot(x, w_n) >= offset_n``
+     — a *real* matmul per heap level (``X @ W_l^T``, HIGHEST) that lands on
+     the MXU (BASELINE.json north star: "hyperplane splits lower directly to
+     XLA matmul").
+  2. **Reachability by level**: a row reaches heap slot ``2i+1+b`` iff it
+     reaches ``i`` and its bit matches. Expanding level ``l`` to ``l+1`` is a
+     mask-and-interleave of the ``[C, 2^l]`` reach matrix — stack + reshape,
+     no indexing at all.
+  3. **Path length**: sum over levels of ``reach * leaf * (l + c(n))`` — a
+     masked elementwise reduction (kept off the MXU so leaf values never
+     round through bf16).
+
+Work per tree is ``O(C * M)`` dense ops versus ``O(C * h)`` gathers — a
+~57x op-count increase (M=511, h=8) that is nonetheless far faster on vector
+hardware because every op is a fused, full-width VPU/MXU instruction. Trees
+are processed under ``lax.scan`` (constant memory in T), rows chunked by the
+caller.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.math import avg_path_length, height_of as _height_of
+from .ext_growth import ExtendedForest
+from .tree_growth import StandardForest
+
+# Feature-count crossover between the fused per-level select formulation and
+# the one-hot HIGHEST-precision contraction. Measured on a v5e chip
+# (tools/dense_experiments.py + on-chip sweep, 2026-07-29): F=3 select
+# 0.35 s vs matmul 0.46 s (524k rows); at 262k rows F=8 select 0.43 vs
+# 0.46, F=16 select 0.82 vs matmul 0.79, F=24 1.22 vs 1.11, F=274 select
+# 1.20 s vs matmul 0.20 s — the flip sits between 8 and 16.
+_SELECT_MAX_FEATURES = 12
+
+# Multi-tree blocking of the tree scan (VERDICT r2 item 1): each lax.scan
+# step is an XLA While iteration whose per-step dispatch and [C, width] walk
+# intermediates are paid per tree; ``unroll=G`` processes G trees per
+# iteration so XLA fuses across tree bodies and the row chunk stays live.
+# ``None`` means the measured default; tools/unroll_sweep.py overrides the
+# module global. Measured on a live v5e (2026-07-29, 524k rows x 100
+# trees): G=1 0.532s; G in {2..100} 0.55-0.61s — unrolling is a wash-to-
+# loss on every platform, so the per-step dispatch is NOT the dense
+# bottleneck (the [C, width] walk intermediates are; benchmarks/README.md
+# round-3 section). Default therefore 1 everywhere, with no device probe.
+_SCAN_UNROLL: int | None = None
+
+
+def _scan_unroll(num_trees: int) -> int:
+    g = 1 if _SCAN_UNROLL is None else _SCAN_UNROLL
+    return max(1, min(int(g), num_trees))
+
+
+def _level_walk(bits_fn, is_internal: jax.Array, leaf_value: jax.Array, C: int, h: int):
+    """Shared reach-propagation over the implicit heap.
+
+    ``bits_fn(start, width)`` returns the ``[C, width]`` go-right bits of one
+    heap level (lazy so the select formulation never materialises ``[C, M]``);
+    ``is_internal``: [M]; ``leaf_value``: [M] (``depth + c(numInstances)`` at
+    leaves, 0 elsewhere). Returns [C] path lengths. Python loop over levels is
+    static (h+1 iterations) and fuses into one XLA computation.
+    """
+    total = jnp.zeros((C,), jnp.float32)
+    reach = jnp.ones((C, 1), jnp.bool_)
+    for level in range(h + 1):
+        start = (1 << level) - 1
+        width = 1 << level
+        value_l = leaf_value[start : start + width]  # [W]
+        # leaves contribute once, where reached (elementwise, not einsum:
+        # MXU default precision would round leaf values to bf16 mantissas)
+        total = total + jnp.sum(jnp.where(reach, value_l[None, :], 0.0), axis=1)
+        if level < h:
+            B_l = bits_fn(start, width)
+            alive = reach & is_internal[start : start + width][None, :]
+            left = alive & ~B_l
+            right = alive & B_l
+            reach = jnp.stack([left, right], axis=2).reshape(C, 2 * width)
+    return total
+
+
+def _leaf_values(num_instances: jax.Array, h: int) -> jax.Array:
+    """Per-slot ``depth + c(numInstances)`` at leaves, 0 elsewhere."""
+    depth = jnp.concatenate(
+        [jnp.full(((1 << level),), float(level), jnp.float32) for level in range(h + 1)]
+    )  # exact static per-slot depth (slot levels of the implicit heap)
+    is_leaf = num_instances >= 0
+    return jnp.where(is_leaf, depth + avg_path_length(num_instances), 0.0)
+
+
+def standard_path_lengths_dense(forest: StandardForest, X: jax.Array) -> jax.Array:
+    """Dense scoring for the standard forest; ``f32[C]`` mean path lengths."""
+    h = _height_of(forest.max_nodes)
+    C, F = X.shape
+
+    def one_tree(carry, tree):
+        feature, threshold, num_instances = tree
+
+        if F <= _SELECT_MAX_FEATURES:
+
+            def bits(start, width):
+                feat_l = feature[start : start + width]
+                thr_l = threshold[start : start + width]
+                xv = jnp.zeros((C, width), X.dtype)
+                for f in range(F):
+                    xv = jnp.where(feat_l[None, :] == f, X[:, f][:, None], xv)
+                return xv >= thr_l[None, :]
+
+        else:
+            # one-hot feature selection: xv[c, n] = X[c, feature[n]]
+            foh = jax.nn.one_hot(jnp.maximum(feature, 0), F, dtype=X.dtype)  # [M, F]
+            xv_all = jnp.einsum(
+                "cf,mf->cm", X, foh, precision=lax.Precision.HIGHEST
+            )
+            B_all = xv_all >= threshold[None, :]
+
+            def bits(start, width):
+                return B_all[:, start : start + width]
+
+        leaf_value = _leaf_values(num_instances, h)
+        pl = _level_walk(bits, feature >= 0, leaf_value, C, h)
+        return carry + pl, None
+
+    total, _ = lax.scan(
+        one_tree,
+        jnp.zeros((C,), jnp.float32),
+        (forest.feature, forest.threshold, forest.num_instances),
+        unroll=_scan_unroll(forest.num_trees),
+    )
+    return total / forest.num_trees
+
+
+def extended_path_lengths_dense(forest: ExtendedForest, X: jax.Array) -> jax.Array:
+    """Dense EIF scoring: per-level hyperplane tests as HIGHEST-precision
+    MXU matmuls (f32 dot parity with ExtendedUtils.scala:46-55; measured
+    7.6e-6 max path-length deviation from the elementwise walk vs 0.24 at
+    the TPU default bf16 passes)."""
+    h = _height_of(forest.max_nodes)
+    C, F = X.shape
+
+    def one_tree(carry, tree):
+        indices, weights, offset, num_instances = tree
+        # densify the sparse hyperplanes: W[n, f] = sum_j w[n,j][indices[n,j]==f]
+        foh = jax.nn.one_hot(jnp.maximum(indices, 0), F, dtype=X.dtype)  # [M,k,F]
+        valid = (indices >= 0).astype(X.dtype)
+        W = jnp.einsum(
+            "mk,mkf->mf", weights * valid, foh, precision=lax.Precision.HIGHEST
+        )  # [M, F]
+
+        def bits(start, width):
+            W_l = W[start : start + width]  # [W, F]
+            off_l = offset[start : start + width]
+            dots = jnp.matmul(X, W_l.T, precision=lax.Precision.HIGHEST)  # [C, W]
+            return dots >= off_l[None, :]
+
+        leaf_value = _leaf_values(num_instances, h)
+        pl = _level_walk(bits, indices[:, 0] >= 0, leaf_value, C, h)
+        return carry + pl, None
+
+    total, _ = lax.scan(
+        one_tree,
+        jnp.zeros((C,), jnp.float32),
+        (forest.indices, forest.weights, forest.offset, forest.num_instances),
+        unroll=_scan_unroll(forest.num_trees),
+    )
+    return total / forest.num_trees
+
+
+def path_lengths_dense(forest, X: jax.Array) -> jax.Array:
+    if isinstance(forest, StandardForest):
+        return standard_path_lengths_dense(forest, X)
+    return extended_path_lengths_dense(forest, X)

@@ -1,0 +1,220 @@
+"""Extended isolation-tree growth (random hyperplane splits, Hariri et al. 2018).
+
+Level-synchronous fixed-shape redesign of ``ExtendedIsolationTree.scala:112-260``,
+sharing the implicit-heap layout of :mod:`.tree_growth`. Per split node:
+
+  * ``k = min(extensionLevel + 1, dim)`` non-zero coordinates
+    (ExtendedIsolationTree.scala:157), chosen as a random distinct subset,
+    canonicalised sorted ascending (:220-226);
+  * Gaussian weights on those coordinates, L2-normalised in float32
+    (:169-195); an exactly-zero norm turns the node into a leaf (:183-184);
+  * intercept point drawn per-coordinate uniform in the node's ``[min, max]``
+    (``min == max`` degenerates to the constant), ``offset = sum(w_i * p_i)``
+    (:201-217);
+  * routing ``dot(x, w) < offset`` -> left (:230-232); **no retry on
+    degenerate splits** — an empty side becomes a ``numInstances = 0`` leaf
+    (ExtendedNodes.scala:32-35), which is exactly why ExtendedIF_0 differs
+    statistically from StandardIF (reference README benchmark note).
+
+Storage is the reference's sparse hyperplane form (``ExtendedUtils.scala:21-34``):
+``indices`` int32[T, M, k] (sorted, ``-1`` marks leaves/non-existent slots) and
+``weights`` float32[T, M, k], with float32 dots matching the reference's
+float-cast dot (ExtendedUtils.scala:46-55).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import level_window as lw
+from .bagging import gather_tree_data
+
+
+class ExtendedForest(NamedTuple):
+    """Struct-of-arrays EIF forest over ``[num_trees, max_nodes]`` heap slots."""
+
+    indices: jax.Array  # i32 [T, M, k]; indices[..., 0] == -1 at leaves
+    weights: jax.Array  # f32 [T, M, k]
+    offset: jax.Array  # f32 [T, M]
+    num_instances: jax.Array  # i32 [T, M]; leaf size, -1 internal/non-existent
+
+    @property
+    def num_trees(self) -> int:
+        return self.indices.shape[0]
+
+    @property
+    def max_nodes(self) -> int:
+        return self.indices.shape[1]
+
+    @property
+    def k(self) -> int:
+        return self.indices.shape[2]
+
+    @property
+    def is_internal(self) -> jax.Array:
+        return self.indices[..., 0] >= 0
+
+    @property
+    def is_leaf(self) -> jax.Array:
+        return self.num_instances >= 0
+
+    @property
+    def exists(self) -> jax.Array:
+        return self.is_internal | self.is_leaf
+
+
+def _grow_one_extended_tree(key: jax.Array, x: jax.Array, h: int, k_nonzero: int):
+    """EIF single-tree growth with bounded per-level memory (shared
+    :mod:`.level_window` scaffolding): the per-node uniform k-subset streams
+    across feature chunks via a running Gumbel top-k, and per-node statistics
+    are computed only at the k chosen coordinates via a per-sample gather ->
+    [W, k] scatter — no [M, F] (or even [W, F]) transient anywhere."""
+    S, F = x.shape
+    M = 2 ** (h + 1) - 1
+    W = 2**h
+    geom = lw.chunk_features(x)
+    x, Fc, pad, n_chunks = geom.x, geom.chunk, geom.pad, geom.n_chunks
+    level_keys = jax.random.split(key, h + 1)
+
+    state = dict(
+        node_id=jnp.zeros((S,), jnp.int32),
+        settled=jnp.zeros((S,), jnp.bool_),
+        indices=jnp.full((M, k_nonzero), -1, jnp.int32),
+        weights=jnp.zeros((M, k_nonzero), jnp.float32),
+        offset=jnp.zeros((M,), jnp.float32),
+        num_instances=jnp.full((M,), -1, jnp.int32),
+        exists=jnp.zeros((M,), jnp.bool_).at[0].set(True),
+    )
+
+    def level_step(l, st):
+        k_sub, k_w, k_p = jax.random.split(level_keys[l], 3)
+        win = lw.level_window(l, W, st["node_id"], st["settled"])
+        idx_w = win.idx_of_sample
+        cnt = jnp.zeros((W,), jnp.int32).at[idx_w].add(1, mode="drop")
+
+        # --- subspace choice per node: uniform k distinct coordinates
+        # (ExtendedIsolationTree.scala:157-160) as a streaming Gumbel top-k
+        # over feature chunks; padded columns draw -inf and are never picked
+        best_g = jnp.full((W, k_nonzero), -jnp.inf, jnp.float32)
+        best_i = jnp.zeros((W, k_nonzero), jnp.int32)
+        for c in range(n_chunks):
+            g = jax.random.gumbel(
+                jax.random.fold_in(k_sub, c), (W, Fc), jnp.float32
+            )
+            if pad and c == n_chunks - 1:
+                real = jnp.arange(Fc) < (F - c * Fc)
+                g = jnp.where(real[None, :], g, -jnp.inf)
+            cat_g = jnp.concatenate([best_g, g], axis=1)
+            cat_i = jnp.concatenate(
+                [
+                    best_i,
+                    jnp.broadcast_to(
+                        c * Fc + jnp.arange(Fc, dtype=jnp.int32), (W, Fc)
+                    ),
+                ],
+                axis=1,
+            )
+            best_g, top_pos = jax.lax.top_k(cat_g, k_nonzero)
+            best_i = jnp.take_along_axis(cat_i, top_pos, axis=1)
+        sub = jnp.sort(best_i, axis=1)  # canonical ascending (:220-226)
+
+        # --- per-node stats ONLY at the chosen coordinates: gather each
+        # sample's k values for its node's subspace, scatter-min/max [W, k]
+        sub_of_sample = jnp.take(
+            sub, jnp.clip(idx_w, 0, W - 1), axis=0
+        )  # [S, k]
+        xv_s = jnp.take_along_axis(x, sub_of_sample, axis=1)  # [S, k]
+        mn = jnp.full((W, k_nonzero), jnp.inf, jnp.float32).at[idx_w].min(
+            xv_s, mode="drop"
+        )
+        mx = jnp.full((W, k_nonzero), -jnp.inf, jnp.float32).at[idx_w].max(
+            xv_s, mode="drop"
+        )
+
+        # --- hyperplane draw (ExtendedIsolationTree.scala:155-226) ---
+        w = jax.random.normal(k_w, (W, k_nonzero), jnp.float32)
+        nrm = jnp.sqrt(jnp.sum(w * w, axis=1))
+        zero_norm = nrm == 0.0
+        w = w / jnp.maximum(nrm, jnp.float32(1e-37))[:, None]
+
+        # empty nodes have inf stats; mask so the offset math stays finite
+        finite = cnt > 0
+        mn = jnp.where(finite[:, None], mn, 0.0)
+        mx = jnp.where(finite[:, None], mx, 0.0)
+        u = jax.random.uniform(k_p, (W, k_nonzero), jnp.float32)
+        p = mn + u * (mx - mn)
+        off = jnp.sum(w * p, axis=1)
+
+        exists_w = lw.window_slice(st["exists"], win.start, W)
+        can_split = exists_w & win.in_level & (cnt > 1) & (l < h) & ~zero_norm
+        new_leaf = exists_w & win.in_level & ~can_split
+
+        indices = lw.patch(st["indices"], sub, can_split, win.start)
+        weights = lw.patch(st["weights"], w, can_split, win.start)
+        offset = lw.patch(st["offset"], off, can_split, win.start)
+        num_instances = lw.patch(st["num_instances"], cnt, new_leaf, win.start)
+
+        exists = lw.spawn_children(st["exists"], can_split, win.slots, M)
+
+        # --- route: dot(x, w) < offset -> left (:230-232) ---
+        nd = st["node_id"]
+        j_s = jnp.clip(nd - win.start, 0, W - 1)
+        split_here = jnp.take(can_split, j_s) & ~st["settled"]
+        dot = jnp.sum(xv_s * jnp.take(w, j_s, axis=0), axis=1)
+        go_right = dot >= jnp.take(off, j_s)
+        node_id = jnp.where(split_here, 2 * nd + 1 + go_right.astype(jnp.int32), nd)
+        settled = st["settled"] | ~split_here
+
+        return dict(
+            node_id=node_id,
+            settled=settled,
+            indices=indices,
+            weights=weights,
+            offset=offset,
+            num_instances=num_instances,
+            exists=exists,
+        )
+
+    state = lax.fori_loop(0, h + 1, level_step, state)
+    return state["indices"], state["weights"], state["offset"], state["num_instances"]
+
+
+def grow_extended_forest(
+    tree_keys: jax.Array,
+    X: jax.Array,
+    bag_idx: jax.Array,
+    feat_idx: jax.Array,
+    height: int,
+    extension_level: int,
+) -> ExtendedForest:
+    """Grow ``T`` extended isolation trees, ``vmap`` over the tree axis.
+
+    ``tree_keys``: pre-derived per-tree PRNG keys (shardable along the tree
+    axis). ``extension_level`` is the *resolved* level
+    (ExtendedIsolationForest.scala:56-69); the per-split non-zero count is
+    ``min(extension_level + 1, F_sub)``. Local subset coordinates are mapped
+    back to global feature ids.
+    """
+    x_trees = gather_tree_data(X, bag_idx, feat_idx)  # [T, S, F_sub]
+    num_trees, _, f_sub = x_trees.shape
+    k_nonzero = min(extension_level + 1, f_sub)
+    indices_local, weights, offset, num_instances = jax.vmap(
+        lambda k, x: _grow_one_extended_tree(k, x, height, k_nonzero)
+    )(tree_keys, x_trees)
+
+    # map local subset coords -> global feature ids; keep -1 sentinels
+    flat_local = jnp.maximum(indices_local, 0).reshape(num_trees, -1)
+    flat_global = jnp.take_along_axis(feat_idx, flat_local, axis=1).reshape(
+        indices_local.shape
+    )
+    indices_global = jnp.where(indices_local >= 0, flat_global, -1).astype(jnp.int32)
+    return ExtendedForest(
+        indices=indices_global,
+        weights=weights,
+        offset=offset,
+        num_instances=num_instances,
+    )

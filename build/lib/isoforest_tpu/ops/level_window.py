@@ -1,0 +1,89 @@
+"""Shared level-window scaffolding for the level-synchronous growth kernels.
+
+Both growth kernels (:mod:`.tree_growth`, :mod:`.ext_growth`) materialise
+per-level state in a ``W = 2^h`` window instead of the full ``M``-slot heap
+(the r1 kernels' ``[M, F]`` transients were the memory wall at the high-F
+stress corner). This module holds the window bookkeeping they share so the
+two kernels cannot silently diverge: feature-chunk geometry, the per-level
+window view, and the write-back patch.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+# Feature-chunk width for streaming per-level statistics/draws: transients
+# are [W, _FEATURE_CHUNK] regardless of F.
+FEATURE_CHUNK = 64
+
+
+class ChunkGeometry(NamedTuple):
+    x: jnp.ndarray  # [S, F + pad] (zero-padded; padded cols are constant)
+    chunk: int  # chunk width Fc
+    pad: int  # zero columns appended
+    n_chunks: int
+
+
+def chunk_features(x, feature_chunk: int = FEATURE_CHUNK) -> ChunkGeometry:
+    """Pad ``x: [S, F]`` to a multiple of the chunk width."""
+    f = x.shape[1]
+    fc = min(f, feature_chunk)
+    pad = (-f) % fc
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad)))
+    return ChunkGeometry(x=x, chunk=fc, pad=pad, n_chunks=(f + pad) // fc)
+
+
+class LevelWindow(NamedTuple):
+    start: jnp.ndarray  # first heap slot of level l
+    width: jnp.ndarray  # number of real nodes at level l (2^l)
+    in_level: jnp.ndarray  # bool [W]: window row is a real level-l node
+    slots: jnp.ndarray  # i32 [W]: global heap slot per window row
+    idx_of_sample: jnp.ndarray  # i32 [S]: window row per sample; W = dropped
+
+
+def level_window(l, w: int, node_id, settled) -> LevelWindow:
+    """Window view of level ``l`` (traced) of a ``W``-row state.
+
+    Unsettled samples sit exactly at level ``l`` by the level-synchronous
+    invariant, so their window index is ``node_id - start``; settled samples
+    map to the out-of-range sentinel ``W`` (dropped by scatter mode="drop").
+    """
+    start = (jnp.int32(1) << l) - 1
+    width = jnp.int32(1) << l
+    j = jnp.arange(w, dtype=jnp.int32)
+    return LevelWindow(
+        start=start,
+        width=width,
+        in_level=j < width,
+        slots=start + j,
+        idx_of_sample=jnp.where(settled, w, node_id - start),
+    )
+
+
+def patch(arr, new_w, mask, start):
+    """Write ``new_w`` (a ``[W, ...]`` window) into ``arr`` at heap offset
+    ``start`` where ``mask`` holds; rows outside the mask keep their values.
+    Works for 1-D and n-D node tables."""
+    offsets = (start,) + (0,) * (arr.ndim - 1)
+    sizes = (new_w.shape[0],) + arr.shape[1:]
+    old = lax.dynamic_slice(arr, offsets, sizes)
+    mask_b = mask.reshape((new_w.shape[0],) + (1,) * (arr.ndim - 1))
+    return lax.dynamic_update_slice(arr, jnp.where(mask_b, new_w, old), offsets)
+
+
+def window_slice(arr, start, w: int):
+    """Read the ``[W]`` window of a 1-D heap array at ``start``."""
+    return lax.dynamic_slice(arr, (start,), (w,))
+
+
+def spawn_children(exists, can_split, slots, m: int):
+    """Mark children of splitting window rows as existing heap slots."""
+    child_l = jnp.where(can_split, 2 * slots + 1, m)
+    child_r = jnp.where(can_split, 2 * slots + 2, m)
+    return (
+        exists.at[child_l].set(True, mode="drop").at[child_r].set(True, mode="drop")
+    )

@@ -1,0 +1,467 @@
+"""Pallas TPU kernel for dense forest scoring.
+
+Same gather-free algorithm as :mod:`.dense_traversal`, hand-blocked for the
+TPU memory hierarchy: the grid is ``(row_blocks, trees)`` with trees minor,
+so each row-block's accumulator stays resident in VMEM while the per-tree
+node tables (a few KB each) stream HBM -> VMEM. Every instruction is a
+full-width VPU op or (for the extended forest's hyperplane tests) an MXU
+matmul; there is no data-dependent indexing anywhere.
+
+Correctness is pinned against the XLA dense path in interpret mode (tests run
+CPU-only); on TPU hardware select it via ``score_matrix(strategy="pallas")``
+or ``ISOFOREST_TPU_STRATEGY=pallas``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces are unavailable when lowering for CPU interpret mode
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+from ..utils.math import height_of as _height_of
+from .tree_growth import StandardForest
+
+_ROW_BLOCK = 1024
+# Shared feature-count crossover (measured on a live v5e): below this,
+# per-feature select passes beat the lane-padded one-hot contraction (which
+# runs [C, 128] @ [128, M] regardless of true F). Imported so the dispatch
+# boundary cannot drift between the XLA and Pallas paths (ADVICE r2):
+# ``f_raw`` is a static kernel arg, so this stays a compile-time constant.
+from .dense_traversal import _SELECT_MAX_FEATURES
+# Mosaic tiles f32 as (8, 128) sublane x lane; node tables and the feature
+# axis are padded to lane multiples so every block is natively tileable
+# (511-wide tables and raw F were the round-1 hardware-compile risk).
+_LANES = 128
+
+
+def _pad_lanes(n: int) -> int:
+    return max(_LANES, -(-n // _LANES) * _LANES)
+
+
+@functools.lru_cache(maxsize=None)
+def _concat_order(m: int) -> tuple:
+    """Heap node index held by each table slot in the LEVEL-CONCAT layout.
+
+    The kernel's level walk stores the children of a level as
+    ``[all left children | all right children]`` rather than interleaved
+    ``[L0, R0, L1, R1, ...]`` heap order: the interleave needs a
+    ``stack(..., axis=2).reshape`` that Mosaic cannot lower (observed on
+    hardware: ``tpu.reshape vector<1024x2x2xf32> -> vector<1024x4xf32>``
+    "unsupported shape cast"), while the concat form is a plain lane-axis
+    ``jnp.concatenate``. Within level ``l+1`` the left child of in-level
+    parent ``p`` sits at in-level slot ``p`` and the right child at
+    ``w + p``. All node tables are permuted into this layout host-side at
+    prep time; scores are layout-invariant."""
+    h = int(np.log2(m + 1)) - 1
+    assert (1 << (h + 1)) - 1 == m, f"node table size {m} is not a full heap"
+    order = [0]
+    prev = [0]
+    for _ in range(h):
+        nxt = [2 * n + 1 for n in prev] + [2 * n + 2 for n in prev]
+        order.extend(nxt)
+        prev = nxt
+    return tuple(order)
+
+
+def _leaf_value_tables(num_instances: np.ndarray, h: int, m_pad: int) -> jax.Array:
+    """[T, 1, m_pad] leaf-value table (:func:`..utils.math.leaf_value_table`
+    padded; pad slots contribute 0 to every walk). The unit middle axis makes
+    each per-tree block's trailing two dims equal the array dims, which
+    Mosaic's block-shape rules require."""
+    from ..utils.math import leaf_value_table
+
+    return jnp.asarray(_pad_table(leaf_value_table(num_instances, h), m_pad, 0.0))
+
+
+def _pad_table(arr: np.ndarray, m_pad: int, fill: float) -> np.ndarray:
+    """Permute a [T, M] heap-order node table into the level-concat layout
+    (:func:`_concat_order`) and pad to [T, 1, m_pad] with ``fill``."""
+    t, m = arr.shape
+    out = np.full((t, m_pad), fill, arr.dtype)
+    out[:, :m] = arr[:, list(_concat_order(m))]
+    return out[:, None, :]
+
+
+def _walk_levels(B, internal_f32, leaf_value, h: int):
+    """Reach propagation on [C_blk, M] blocks — same recurrence as
+    dense_traversal but over tables in the level-concat layout
+    (:func:`_concat_order`): the next level's reach is a lane-axis concat,
+    the one child-ordering Mosaic can lower."""
+    C = B.shape[0]
+    total = jnp.zeros((C,), jnp.float32)
+    reach = jnp.ones((C, 1), jnp.float32)
+    for level in range(h + 1):
+        start = (1 << level) - 1
+        width = 1 << level
+        total = total + jnp.sum(reach * leaf_value[:, start : start + width], axis=1)
+        if level < h:
+            B_l = B[:, start : start + width]
+            alive = reach * internal_f32[:, start : start + width]
+            left = alive * (1.0 - B_l)
+            right = alive * B_l
+            reach = jnp.concatenate([left, right], axis=1)
+    return total
+
+
+def _bcast_rows(row, c: int, precision=None):
+    """Materialize a [1, M] node-table row to [c, M] via a rank-1 MXU
+    contraction. A plain ``row + zeros`` broadcast leaves the value in a
+    sublane-broadcast layout that crashes Mosaic's layout inference when the
+    walk later takes narrow lane slices of it (observed on hardware:
+    ``Check failed: limits[i] <= dim(i) (128 vs. 1)``; a broadcasting
+    multiply by a [c, 1] ones column hits the same class of crash in the
+    *remote* compile helper even though the local chipless AOT pipeline
+    accepts it — the helper runs a different Mosaic build, so only
+    remote-proven formulations ship). ``precision``: the standard kernel
+    passes HIGHEST so leaf/internal table values do not round through bf16
+    mantissas (proven to compile remotely 2026-07-29); the EIF kernels keep
+    the default — HIGHEST inside them crashes the remote helper, and they
+    are the measured losers vs dense anyway (benchmarks/README.md)."""
+    ones = jnp.ones((c, 1), jnp.float32)
+    return jax.lax.dot_general(
+        ones, row, (((1,), (0,)), ((), ())),
+        precision=precision, preferred_element_type=jnp.float32,
+    )
+
+
+def _standard_kernel(h, T, f_raw, x_ref, feat_ref, thr_ref, leaf_ref, out_ref):
+    t = pl.program_id(1)
+    x = x_ref[...]  # [C_blk, F_pad]
+    # node-table refs are [1, 1, M_pad] blocks (trailing two dims equal the
+    # [T, 1, M_pad] array dims — a Mosaic block-shape requirement); drop the
+    # leading tree axis
+    feature = feat_ref[0]  # [1, M_pad] int32 (feature id; -1 leaf/pad)
+    thr = thr_ref[0]
+    f_pad = x.shape[1]
+    m_pad = feature.shape[1]
+    c_blk = x.shape[0]
+    if f_raw <= _SELECT_MAX_FEATURES:
+        # Per-feature select chain (pure VPU), mirroring dense_traversal's
+        # small-F dispatch. The one-hot contraction below runs over the
+        # lane-PADDED F axis — [C, 128] @ [128, M] at HIGHEST precision is
+        # ~42x the needed flops at F=3 and dominated the measured 1.04 s
+        # pallas score at 1M rows; F masked passes over [C_blk, M_pad] are
+        # O(F * C * M) VPU work with no padding amplification. (The round-1
+        # worry about this loop was F=274 configs — those still take the
+        # matmul branch.)
+        xv = jnp.zeros((c_blk, m_pad), jnp.float32)
+        for f in range(f_raw):
+            xv = jnp.where(feature == f, x[:, f : f + 1], xv)
+    else:
+        # One-hot feature selection as a single MXU contraction (the
+        # formulation dense_traversal.py uses for wide F).
+        # sel[f, m] = 1 iff node m splits on feature f; padded slots match
+        # no f. Mosaic requires integer iota, hence the int32 feature table.
+        iota_f = jax.lax.broadcasted_iota(jnp.int32, (f_pad, m_pad), 0)
+        sel = (iota_f == feature).astype(jnp.float32)  # [F_pad, M_pad]
+        xv = jax.lax.dot_general(
+            x, sel, (((1,), (0,)), ((), ())), precision=jax.lax.Precision.HIGHEST, preferred_element_type=jnp.float32
+        )  # [C_blk, M_pad]
+    B = (xv >= thr).astype(jnp.float32)
+    hp = jax.lax.Precision.HIGHEST
+    internal = _bcast_rows((feature >= 0).astype(jnp.float32), c_blk, hp)
+    pl_len = _walk_levels(B, internal, _bcast_rows(leaf_ref[0], c_blk, hp), h)
+
+    @pl.when(t == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += pl_len[:, None] / T
+
+
+def _extended_kernel_sparse(
+    h, T, x_ref, idx_ref, w_ref, off_ref, internal_ref, leaf_ref, out_ref
+):
+    """EIF scoring from SPARSE hyperplane tables: densify in VMEM (k one-hot
+    accumulation passes, pure VPU) instead of materialising [T, M_pad, F_pad]
+    in HBM — at T=1000, F=274 the precomputed dense table cost ~786 MB; the
+    sparse tables are ~2k/F of that. Used when k is small (the common sparse
+    extension levels); large k dispatches to :func:`_extended_kernel_dense`
+    where the HBM table is no bigger than the sparse form anyway."""
+    t = pl.program_id(1)
+    x = x_ref[...]  # [C_blk, F_pad]
+    idx = idx_ref[0]  # [k, M_pad] sparse hyperplane coordinates (-1 pad)
+    w = w_ref[0]  # [k, M_pad]
+    f_pad = x.shape[1]
+    m_pad = idx.shape[1]
+    k = idx.shape[0]
+    # Padded coordinates (-1) match no iota row, contributing zero weight.
+    iota_f = jax.lax.broadcasted_iota(jnp.int32, (f_pad, m_pad), 0)
+    w_dense = jnp.zeros((f_pad, m_pad), jnp.float32)
+    for q in range(k):
+        sel = (iota_f == idx[q][None, :]).astype(jnp.float32)  # [F_pad, M_pad]
+        w_dense = w_dense + sel * w[q][None, :]
+    # NOTE: default matmul precision (bf16 passes) — Precision.HIGHEST on
+    # this contraction crashes the Mosaic compile helper on real hardware
+    # (observed 2026-07-29: tpu_compile_helper exit 1; the standard kernel's
+    # HIGHEST contraction compiles fine). The EIF pallas path is already the
+    # measured loser vs dense (benchmarks/README.md) — kept compilable for
+    # the record rather than bit-exact.
+    dots = jax.lax.dot_general(
+        x, w_dense, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [C_blk, M_pad] — MXU
+    B = (dots >= off_ref[0]).astype(jnp.float32)
+    c_blk = dots.shape[0]
+    internal = _bcast_rows(internal_ref[0], c_blk)
+    pl_len = _walk_levels(B, internal, _bcast_rows(leaf_ref[0], c_blk), h)
+
+    @pl.when(t == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += pl_len[:, None] / T
+
+
+def _extended_kernel_dense(
+    h, T, x_ref, w_ref, off_ref, internal_ref, leaf_ref, out_ref
+):
+    """EIF scoring from a precomputed dense [T, M_pad, F_pad] table — for
+    near-fully-extended forests, where sparse storage saves nothing and the
+    in-kernel densify would redo k~F one-hot passes per row block."""
+    t = pl.program_id(1)
+    x = x_ref[...]  # [C_blk, F_pad]
+    W = w_ref[0]  # [M_pad, F_pad]
+    # default precision for the same Mosaic-compile reason as the sparse
+    # EIF kernel above
+    dots = jax.lax.dot_general(
+        x, W, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [C_blk, M_pad] — MXU
+    B = (dots >= off_ref[0]).astype(jnp.float32)
+    c_blk = dots.shape[0]
+    internal = _bcast_rows(internal_ref[0], c_blk)
+    pl_len = _walk_levels(B, internal, _bcast_rows(leaf_ref[0], c_blk), h)
+
+    @pl.when(t == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += pl_len[:, None] / T
+
+
+def _vmem_spec(block_shape, index_map):
+    kw = {"memory_space": _VMEM} if _VMEM is not None else {}
+    return pl.BlockSpec(block_shape, index_map, **kw)
+
+
+@functools.partial(jax.jit, static_argnames=("h", "f_raw", "interpret"))
+def _standard_pallas(X, feature_f32, threshold, leaf_value, h, f_raw, interpret=False):
+    C, Fp = X.shape
+    T, _, Mp = threshold.shape
+    grid = (C // _ROW_BLOCK, T)
+    table = _vmem_spec((1, 1, Mp), lambda rb, t: (t, 0, 0))
+    return pl.pallas_call(
+        functools.partial(_standard_kernel, h, T, f_raw),
+        grid=grid,
+        in_specs=[
+            _vmem_spec((_ROW_BLOCK, Fp), lambda rb, t: (rb, 0)),
+            table,
+            table,
+            table,
+        ],
+        out_specs=_vmem_spec((_ROW_BLOCK, 1), lambda rb, t: (rb, 0)),
+        out_shape=jax.ShapeDtypeStruct((C, 1), jnp.float32),
+        interpret=interpret,
+    )(X, feature_f32, threshold, leaf_value)[:, 0]
+
+
+# In-kernel densify beyond this many nonzero coordinates loses: the per-row-
+# block one-hot passes approach the matmul's own cost, and sparse storage
+# (2 * k entries/node) stops being smaller than the dense F_pad table.
+_SPARSE_K_MAX = 32
+
+
+@functools.partial(jax.jit, static_argnames=("h", "interpret"))
+def _extended_pallas_sparse(
+    X, indices, weights, offset, internal, leaf_value, h, interpret=False
+):
+    C, Fp = X.shape
+    T, _, Mp = offset.shape
+    k = indices.shape[1]
+    grid = (C // _ROW_BLOCK, T)
+    table = _vmem_spec((1, 1, Mp), lambda rb, t: (t, 0, 0))
+    # [1, k, Mp] blocks: minor dim lane-aligned, k rides the sublane axis
+    sparse = _vmem_spec((1, k, Mp), lambda rb, t: (t, 0, 0))
+    return pl.pallas_call(
+        functools.partial(_extended_kernel_sparse, h, T),
+        grid=grid,
+        in_specs=[
+            _vmem_spec((_ROW_BLOCK, Fp), lambda rb, t: (rb, 0)),
+            sparse,
+            sparse,
+            table,
+            table,
+            table,
+        ],
+        out_specs=_vmem_spec((_ROW_BLOCK, 1), lambda rb, t: (rb, 0)),
+        out_shape=jax.ShapeDtypeStruct((C, 1), jnp.float32),
+        interpret=interpret,
+    )(X, indices, weights, offset, internal, leaf_value)[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("h", "interpret"))
+def _extended_pallas_dense(
+    X, W_dense, offset, internal, leaf_value, h, interpret=False
+):
+    C, Fp = X.shape
+    T, _, Mp = offset.shape
+    grid = (C // _ROW_BLOCK, T)
+    table = _vmem_spec((1, 1, Mp), lambda rb, t: (t, 0, 0))
+    return pl.pallas_call(
+        functools.partial(_extended_kernel_dense, h, T),
+        grid=grid,
+        in_specs=[
+            _vmem_spec((_ROW_BLOCK, Fp), lambda rb, t: (rb, 0)),
+            _vmem_spec((1, Mp, Fp), lambda rb, t: (t, 0, 0)),
+            table,
+            table,
+            table,
+        ],
+        out_specs=_vmem_spec((_ROW_BLOCK, 1), lambda rb, t: (rb, 0)),
+        out_shape=jax.ShapeDtypeStruct((C, 1), jnp.float32),
+        interpret=interpret,
+    )(X, W_dense, offset, internal, leaf_value)[:, 0]
+
+
+# The forest is immutable once trained/loaded, but the kernel needs host-side
+# prep (padded node tables, leaf values; sparse [T, k, M_pad] or — above
+# _SPARSE_K_MAX — dense [T, M_pad, F_pad] hyperplane tables for EIF). Cache
+# prep per forest, keyed by the identities of ALL its arrays (a _replace of
+# any single field must miss); holding strong references to the keyed arrays
+# prevents id() reuse. Bounded FIFO.
+_PREP_CACHE: dict = {}
+_PREP_CACHE_MAX = 8
+
+
+def _cached_prep(forest, build, extra_key=()):
+    """``extra_key`` distinguishes preps that depend on call-site statics
+    beyond the forest arrays (e.g. the dense EIF table's feature padding)."""
+    arrays = tuple(forest)
+    key = (tuple(id(a) for a in arrays), tuple(forest[0].shape), extra_key)
+    hit = _PREP_CACHE.get(key)
+    if hit is not None and all(a is b for a, b in zip(hit[0], arrays)):
+        return hit[1]
+    prep = build()
+    if len(_PREP_CACHE) >= _PREP_CACHE_MAX:
+        _PREP_CACHE.pop(next(iter(_PREP_CACHE)))
+    _PREP_CACHE[key] = (arrays, prep)
+    return prep
+
+
+def standard_tables(forest, m_pad: int, h: int):
+    """Kernel-layout node tables for a standard forest: ``(feature, threshold,
+    leaf_value)`` permuted/padded ``[T, 1, m_pad]``. Single source for the
+    production prep, the TPU-lowering tests, and the Mosaic machine-compile
+    worker so they cannot diverge. Pads: feature -1 (no one-hot match,
+    non-internal), threshold +inf (go-right bit 0), leaf value 0."""
+    return (
+        jnp.asarray(_pad_table(np.asarray(forest.feature, np.int32), m_pad, -1)),
+        jnp.asarray(
+            _pad_table(np.asarray(forest.threshold, np.float32), m_pad, np.inf)
+        ),
+        _leaf_value_tables(forest.num_instances, h, m_pad),
+    )
+
+
+def extended_common_tables(forest, m_pad: int, h: int):
+    """Kernel-layout ``(offset, internal, leaf_value)`` tables shared by both
+    extended kernels — same single-source rationale as :func:`standard_tables`."""
+    indices = np.asarray(forest.indices)
+    return (
+        jnp.asarray(_pad_table(np.asarray(forest.offset, np.float32), m_pad, np.inf)),
+        jnp.asarray(
+            _pad_table((indices[..., 0] >= 0).astype(np.float32), m_pad, 0.0)
+        ),
+        _leaf_value_tables(forest.num_instances, h, m_pad),
+    )
+
+
+def sparse_hyperplane_tables(forest, m_pad: int):
+    """Node-axis-padded sparse hyperplane tables in the kernel layout
+    ``[T, k, m_pad]`` (coordinates -1, weights 0 at padding) — shared by the
+    production prep and the TPU-lowering tests so they cannot diverge."""
+    indices = np.asarray(forest.indices)
+    weights = np.asarray(forest.weights, np.float32)
+    t_n, m, k = indices.shape
+    order = list(_concat_order(m))
+    idx_p = np.full((t_n, m_pad, k), -1, np.int32)
+    idx_p[:, :m] = indices[:, order]
+    w_p = np.zeros((t_n, m_pad, k), np.float32)
+    w_p[:, :m] = weights[:, order]
+    return (
+        jnp.asarray(np.ascontiguousarray(idx_p.transpose(0, 2, 1))),
+        jnp.asarray(np.ascontiguousarray(w_p.transpose(0, 2, 1))),
+    )
+
+
+def dense_hyperplane_table(forest, m_pad: int, f_pad: int):
+    """Densified ``[T, m_pad, f_pad]`` hyperplane table for the large-k
+    kernel. Duplicate coordinates accumulate (matching the dense XLA path's
+    einsum; numpy fancy-index += would silently drop them)."""
+    indices = np.asarray(forest.indices)
+    order = list(_concat_order(indices.shape[1]))
+    indices = indices[:, order]
+    weights = np.asarray(forest.weights, np.float32)[:, order]
+    t_n, m, k = indices.shape
+    W = np.zeros((t_n, m_pad, f_pad), np.float32)
+    t_ix, m_ix, k_ix = np.nonzero(indices >= 0)
+    np.add.at(W, (t_ix, m_ix, indices[t_ix, m_ix, k_ix]), weights[t_ix, m_ix, k_ix])
+    return jnp.asarray(W)
+
+
+def path_lengths_pallas(forest, X, interpret: bool = False) -> jax.Array:
+    """Mean path lengths via the Pallas kernel. Rows are padded to the row
+    block and the node/feature axes to lane multiples internally; pass
+    ``interpret=True`` off-TPU."""
+    X = jnp.asarray(X, jnp.float32)
+    n, F = X.shape
+    f_pad = _pad_lanes(F)
+    pad = (-n) % _ROW_BLOCK
+    if pad or f_pad != F:
+        X = jnp.pad(X, ((0, pad), (0, f_pad - F)))
+    h = _height_of(forest.max_nodes)
+    m_pad = _pad_lanes(forest.max_nodes)
+    if isinstance(forest, StandardForest):
+
+        def build_standard():
+            return standard_tables(forest, m_pad, h)
+
+        feature_f32, threshold, leaf_value = _cached_prep(forest, build_standard)
+        out = _standard_pallas(
+            X, feature_f32, threshold, leaf_value, h, F, interpret=interpret
+        )
+    else:
+
+        k = forest.indices.shape[2]
+        sparse = k <= _SPARSE_K_MAX
+
+        def build_extended():
+            common = extended_common_tables(forest, m_pad, h)
+            if sparse:
+                return sparse_hyperplane_tables(forest, m_pad) + common
+            return (dense_hyperplane_table(forest, m_pad, f_pad),) + common
+
+        prep = _cached_prep(
+            forest, build_extended, extra_key=("sparse",) if sparse else ("dense", f_pad)
+        )
+        if sparse:
+            idx_p, w_p, offset, internal, leaf_value = prep
+            out = _extended_pallas_sparse(
+                X, idx_p, w_p, offset, internal, leaf_value, h, interpret=interpret
+            )
+        else:
+            W, offset, internal, leaf_value = prep
+            out = _extended_pallas_dense(
+                X, W, offset, internal, leaf_value, h, interpret=interpret
+            )
+    return out[:n]

@@ -1,0 +1,216 @@
+"""Standard isolation-tree growth as a fixed-shape, level-synchronous XLA program.
+
+The reference grows pointer-based trees recursively, one tree per Spark
+partition (``IsolationTree.scala:83-183``). That shape-dynamic recursion
+cannot compile to XLA; instead each tree is a **struct-of-arrays implicit
+heap** of ``max_nodes = 2**(h+1)-1`` slots with children of slot ``i`` at
+``2i+1``/``2i+2`` (SURVEY.md §7.1), and growth proceeds level-synchronously:
+at level ``l`` every sample scatters its feature vector into per-node
+min/max/count statistics, every level-``l`` node draws its split, and every
+sample routes one step down. The whole loop is a ``lax.fori_loop`` of
+``h+1`` fixed-shape iterations under ``jit``, ``vmap``-ed over the tree axis.
+
+Reference semantics preserved:
+  * height limit ``ceil(log2(n))`` (IsolationTree.scala:60-61);
+  * split feature drawn uniformly among *non-constant* features — the
+    reference's retry-loop-with-constant-feature-removal
+    (IsolationTree.scala:124-150) is equivalent to a uniform draw over the
+    features with ``min != max``, realised here as a Gumbel-argmax over the
+    non-constant mask;
+  * terminate when no splittable feature remains, the height limit is hit, or
+    ``n <= 1`` (IsolationTree.scala:155-156);
+  * split threshold uniform in ``[min, max)`` of the node's data; routing
+    ``x < t`` left / ``x >= t`` right (IsolationTree.scala:158-159).
+
+Known deviation: thresholds are float32 (the reference keeps Double). In the
+measure-zero event that a threshold rounds onto the node minimum, an empty
+child becomes a ``numInstances = 0`` leaf (``avg_path_length(0) = 0``) rather
+than being impossible — same convention the extended forest already uses
+(ExtendedNodes.scala:32-35).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import level_window as lw
+from .bagging import gather_tree_data
+
+
+class StandardForest(NamedTuple):
+    """Struct-of-arrays forest over ``[num_trees, max_nodes]`` heap slots.
+
+    ``feature``: int32 global split-feature id; ``-1`` at leaves and
+    non-existent slots. ``threshold``: float32 split value (reference:
+    ``splitValue`` Double, Nodes.scala:47-66). ``num_instances``: int32 leaf
+    size; ``-1`` at internal and non-existent slots (matching the Avro
+    sentinels, IsolationForestModelReadWrite.scala:36-67).
+    """
+
+    feature: jax.Array  # i32 [T, M]
+    threshold: jax.Array  # f32 [T, M]
+    num_instances: jax.Array  # i32 [T, M]
+
+    @property
+    def num_trees(self) -> int:
+        return self.feature.shape[0]
+
+    @property
+    def max_nodes(self) -> int:
+        return self.feature.shape[1]
+
+    @property
+    def is_internal(self) -> jax.Array:
+        return self.feature >= 0
+
+    @property
+    def is_leaf(self) -> jax.Array:
+        return self.num_instances >= 0
+
+    @property
+    def exists(self) -> jax.Array:
+        return self.is_internal | self.is_leaf
+
+
+def _grow_one_tree(key: jax.Array, x: jax.Array, h: int):
+    """Grow one tree over ``x: f32[S, F]``; returns local-feature-indexed arrays.
+
+    Per-level statistics are [level_width, feature_chunk] windows instead of
+    [max_nodes, F] (the r1 kernel's ~1.1 GB/level transient at T=1000,
+    F=274), using the shared scaffolding in :mod:`.level_window`. The
+    uniform choice among non-constant features streams across chunks via a
+    running Gumbel-argmax — distributionally identical to a single
+    Gumbel-argmax over all F.
+    """
+    S, F = x.shape
+    M = 2 ** (h + 1) - 1
+    W = 2**h  # widest level; per-level stats never need more rows
+    geom = lw.chunk_features(x)
+    x, Fc, n_chunks = geom.x, geom.chunk, geom.n_chunks
+    level_keys = jax.random.split(key, h + 1)
+
+    state = dict(
+        node_id=jnp.zeros((S,), jnp.int32),
+        settled=jnp.zeros((S,), jnp.bool_),
+        feature=jnp.full((M,), -1, jnp.int32),
+        threshold=jnp.zeros((M,), jnp.float32),
+        num_instances=jnp.full((M,), -1, jnp.int32),
+        exists=jnp.zeros((M,), jnp.bool_).at[0].set(True),
+    )
+
+    def level_step(l, st):
+        k_feat, k_thr = jax.random.split(level_keys[l])
+        win = lw.level_window(l, W, st["node_id"], st["settled"])
+        idx_w = win.idx_of_sample
+        cnt = jnp.zeros((W,), jnp.int32).at[idx_w].add(1, mode="drop")
+
+        # --- streaming per-node statistics + feature choice, F in chunks ---
+        # (IsolationTree.scala:124-156: uniform draw among non-constant
+        # features == Gumbel-argmax over the non-constant mask; the running
+        # max across chunks keeps that exact distribution)
+        best_g = jnp.full((W,), -jnp.inf, jnp.float32)
+        best_f = jnp.zeros((W,), jnp.int32)
+        best_mn = jnp.zeros((W,), jnp.float32)
+        best_mx = jnp.zeros((W,), jnp.float32)
+        any_nc = jnp.zeros((W,), jnp.bool_)
+        for c in range(n_chunks):
+            xc = x[:, c * Fc : (c + 1) * Fc]
+            mn_c = jnp.full((W, Fc), jnp.inf, jnp.float32).at[idx_w].min(
+                xc, mode="drop"
+            )
+            mx_c = jnp.full((W, Fc), -jnp.inf, jnp.float32).at[idx_w].max(
+                xc, mode="drop"
+            )
+            nc = mn_c < mx_c
+            g = jnp.where(
+                nc,
+                jax.random.gumbel(jax.random.fold_in(k_feat, c), (W, Fc), jnp.float32),
+                -jnp.inf,
+            )
+            fj = jnp.argmax(g, axis=1).astype(jnp.int32)
+            gj = jnp.take_along_axis(g, fj[:, None], axis=1)[:, 0]
+            mnj = jnp.take_along_axis(mn_c, fj[:, None], axis=1)[:, 0]
+            mxj = jnp.take_along_axis(mx_c, fj[:, None], axis=1)[:, 0]
+            upd = gj > best_g
+            best_g = jnp.where(upd, gj, best_g)
+            best_f = jnp.where(upd, c * Fc + fj, best_f)
+            best_mn = jnp.where(upd, mnj, best_mn)
+            best_mx = jnp.where(upd, mxj, best_mx)
+            any_nc = any_nc | jnp.any(nc, axis=1)
+
+        # --- split decision per level-l node (IsolationTree.scala:124-156) ---
+        exists_w = lw.window_slice(st["exists"], win.start, W)
+        can_split = exists_w & win.in_level & (cnt > 1) & (l < h) & any_nc
+        u = jax.random.uniform(k_thr, (W,), jnp.float32)
+        thr_w = best_mn + u * (best_mx - best_mn)
+        new_leaf = exists_w & win.in_level & ~can_split
+
+        feature = lw.patch(st["feature"], best_f, can_split, win.start)
+        threshold = lw.patch(st["threshold"], thr_w, can_split, win.start)
+        num_instances = lw.patch(st["num_instances"], cnt, new_leaf, win.start)
+
+        # children of split nodes materialise at the next level
+        exists = lw.spawn_children(st["exists"], can_split, win.slots, M)
+
+        # --- route unsettled samples one level down (x < t left / >= right) ---
+        nd = st["node_id"]
+        j_s = jnp.clip(nd - win.start, 0, W - 1)
+        split_here = jnp.take(can_split, j_s) & ~st["settled"]
+        f_s = jnp.take(best_f, j_s)
+        go_right = (
+            jnp.take_along_axis(x, f_s[:, None], axis=1)[:, 0]
+            >= jnp.take(thr_w, j_s)
+        )
+        node_id = jnp.where(split_here, 2 * nd + 1 + go_right.astype(jnp.int32), nd)
+        settled = st["settled"] | ~split_here
+
+        return dict(
+            node_id=node_id,
+            settled=settled,
+            feature=feature,
+            threshold=threshold,
+            num_instances=num_instances,
+            exists=exists,
+        )
+
+    state = lax.fori_loop(0, h + 1, level_step, state)
+    return state["feature"], state["threshold"], state["num_instances"]
+
+
+def grow_forest(
+    tree_keys: jax.Array,
+    X: jax.Array,
+    bag_idx: jax.Array,
+    feat_idx: jax.Array,
+    height: int,
+) -> StandardForest:
+    """Grow ``T`` standard isolation trees; ``vmap`` over the tree axis.
+
+    ``tree_keys``: per-tree PRNG keys ``[T, ...]`` (see
+    :func:`..bagging.per_tree_keys` — passed pre-derived so the tree axis can
+    be sharded across devices with disjoint streams); ``X``: f32[N, F_total];
+    ``bag_idx``: i32[T, S]; ``feat_idx``: i32[T, F_sub] sorted global feature
+    ids; ``height`` static. Local split indices are mapped back to global
+    feature ids so persisted ``splitAttribute`` matches the reference layout.
+    """
+    x_trees = gather_tree_data(X, bag_idx, feat_idx)  # [T, S, F_sub]
+    feature_local, threshold, num_instances = jax.vmap(
+        lambda k, x: _grow_one_tree(k, x, height)
+    )(tree_keys, x_trees)
+
+    feature_global = jnp.where(
+        feature_local >= 0,
+        jnp.take_along_axis(
+            feat_idx, jnp.maximum(feature_local, 0), axis=1
+        ),
+        -1,
+    ).astype(jnp.int32)
+    return StandardForest(
+        feature=feature_global,
+        threshold=threshold,
+        num_instances=num_instances,
+    )

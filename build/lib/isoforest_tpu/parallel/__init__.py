@@ -1,0 +1,19 @@
+from .mesh import DATA_AXIS, TREES_AXIS, create_mesh, initialize_distributed
+from .sharded import (
+    sharded_grow_extended_forest,
+    sharded_grow_forest,
+    sharded_score,
+)
+from .train_step import TrainStepResult, make_train_step
+
+__all__ = [
+    "DATA_AXIS",
+    "TREES_AXIS",
+    "create_mesh",
+    "initialize_distributed",
+    "sharded_grow_extended_forest",
+    "sharded_grow_forest",
+    "sharded_score",
+    "TrainStepResult",
+    "make_train_step",
+]

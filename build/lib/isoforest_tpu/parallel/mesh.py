@@ -1,0 +1,71 @@
+"""Device meshes for tree- and row-parallel isolation forests.
+
+The reference's distribution model is one-tree-per-Spark-partition plus
+row-partitioned scoring with a broadcast forest (SURVEY.md §0, §2.4). The
+TPU-native mapping is a 2-D ``jax.sharding.Mesh``:
+
+  * axis ``'trees'`` — ensemble parallelism: each device grows an equal slab
+    of trees (replaces ``HashPartitioner(numEstimators)`` + ``collect()``,
+    SharedTrainLogic.scala:140-141,317); trained tree tensors are combined
+    with an ``all_gather`` over ICI instead of a driver collect;
+  * axis ``'data'`` — row parallelism for scoring: rows sharded, forest
+    replicated (replaces ``sparkContext.broadcast`` of the forest,
+    IsolationForestModel.scala:129).
+
+Multi-host: call :func:`initialize_distributed` first (``jax.distributed``
+over DCN), then build the mesh over ``jax.devices()`` — the same code path
+scales from 1 chip to a pod slice.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+TREES_AXIS = "trees"
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bring up the multi-host runtime (``jax.distributed.initialize``) — the
+    TPU analogue of the reference's implicit SparkSession bring-up
+    (SURVEY.md §3.5). No-op in single-process runs."""
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def create_mesh(
+    devices: Optional[Sequence] = None,
+    data_parallelism: Optional[int] = None,
+) -> Mesh:
+    """Build a ``(data, trees)`` mesh over the given (default: all) devices.
+
+    ``data_parallelism`` fixes the size of the ``'data'`` axis; by default the
+    device count is factored as evenly as possible (e.g. 8 -> 2 x 4). With a
+    single device both axes are size 1 — the same sharded program runs
+    unmodified.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data_parallelism is None:
+        data_parallelism = 1
+        for cand in range(int(np.sqrt(n)), 0, -1):
+            if n % cand == 0:
+                data_parallelism = cand
+                break
+    if n % data_parallelism != 0:
+        raise ValueError(f"{n} devices not divisible by data_parallelism={data_parallelism}")
+    arr = np.asarray(devices).reshape(data_parallelism, n // data_parallelism)
+    return Mesh(arr, (DATA_AXIS, TREES_AXIS))

@@ -1,0 +1,115 @@
+"""shard_map kernels: tree-parallel growth and row-parallel scoring.
+
+Replaces the reference's three distribution primitives (SURVEY.md §5.8):
+Spark shuffle -> on-device gather of bagged indices; driver ``collect()`` of
+trees -> ``all_gather`` of fixed-shape tree tensors over ICI (here expressed
+as sharded-out / replicated-in specs, letting GSPMD insert the collectives);
+forest ``broadcast`` -> replicated sharding of the forest pytree.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.ext_growth import ExtendedForest, grow_extended_forest
+from ..ops.traversal import path_lengths
+from ..ops.tree_growth import StandardForest, grow_forest
+from ..utils.math import score_from_path_length
+from .mesh import DATA_AXIS, TREES_AXIS
+
+
+def _pad_axis(arr, axis: int, multiple: int):
+    """Pad ``axis`` up to a multiple by repeating the last slice (padding trees
+    are grown redundantly and sliced off; padding rows are scored and dropped)."""
+    size = arr.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return arr, 0
+    last = jax.lax.slice_in_dim(arr, size - 1, size, axis=axis)
+    reps = [1] * arr.ndim
+    reps[axis] = pad
+    return jnp.concatenate([arr, jnp.tile(last, reps)], axis=axis), pad
+
+
+def sharded_grow_forest(mesh, tree_keys, X, bag_idx, feat_idx, height: int):
+    """Tree-parallel growth: each device grows ``T / n_trees_axis`` trees over
+    a replicated (HBM-resident) feature matrix."""
+    n_shards = mesh.shape[TREES_AXIS] * mesh.shape[DATA_AXIS]
+    tree_keys, pad = _pad_axis(tree_keys, 0, n_shards)
+    bag_idx, _ = _pad_axis(bag_idx, 0, n_shards)
+    feat_idx, _ = _pad_axis(feat_idx, 0, n_shards)
+
+    tree_spec = P((DATA_AXIS, TREES_AXIS))
+    grow = functools.partial(grow_forest, height=height)
+    f = jax.jit(
+        jax.shard_map(
+            grow,
+            mesh=mesh,
+            in_specs=(tree_spec, P(), tree_spec, tree_spec),
+            out_specs=StandardForest(tree_spec, tree_spec, tree_spec),
+            check_vma=False,
+        )
+    )
+    forest = f(tree_keys, X, bag_idx, feat_idx)
+    if pad:
+        forest = jax.tree_util.tree_map(lambda a: a[: a.shape[0] - pad], forest)
+    return forest
+
+
+def sharded_grow_extended_forest(
+    mesh, tree_keys, X, bag_idx, feat_idx, height: int, extension_level: int
+):
+    n_shards = mesh.shape[TREES_AXIS] * mesh.shape[DATA_AXIS]
+    tree_keys, pad = _pad_axis(tree_keys, 0, n_shards)
+    bag_idx, _ = _pad_axis(bag_idx, 0, n_shards)
+    feat_idx, _ = _pad_axis(feat_idx, 0, n_shards)
+
+    tree_spec = P((DATA_AXIS, TREES_AXIS))
+    grow = functools.partial(
+        grow_extended_forest, height=height, extension_level=extension_level
+    )
+    f = jax.jit(
+        jax.shard_map(
+            grow,
+            mesh=mesh,
+            in_specs=(tree_spec, P(), tree_spec, tree_spec),
+            out_specs=ExtendedForest(tree_spec, tree_spec, tree_spec, tree_spec),
+            check_vma=False,
+        )
+    )
+    forest = f(tree_keys, X, bag_idx, feat_idx)
+    if pad:
+        forest = jax.tree_util.tree_map(lambda a: a[: a.shape[0] - pad], forest)
+    return forest
+
+
+def sharded_score(mesh, forest, X, num_samples: int) -> np.ndarray:
+    """Row-parallel scoring: rows sharded over *all* mesh devices, forest
+    replicated (the broadcast analogue). Returns host scores ``f32[N]``."""
+    n_devices = mesh.shape[DATA_AXIS] * mesh.shape[TREES_AXIS]
+    X = jnp.asarray(X, jnp.float32)
+    n = X.shape[0]
+    Xp, pad = _pad_axis(X, 0, n_devices)
+
+    row_spec = P((DATA_AXIS, TREES_AXIS), None)
+    forest_spec = jax.tree_util.tree_map(lambda _: P(), forest)
+
+    def score_local(forest_rep, x_local):
+        return score_from_path_length(path_lengths(forest_rep, x_local), num_samples)
+
+    f = jax.jit(
+        jax.shard_map(
+            score_local,
+            mesh=mesh,
+            in_specs=(forest_spec, row_spec),
+            out_specs=P((DATA_AXIS, TREES_AXIS)),
+            check_vma=False,
+        )
+    )
+    scores = f(forest, Xp)
+    return np.asarray(scores[:n])

@@ -1,0 +1,129 @@
+"""Whole-pipeline distributed training step under a single ``jit``.
+
+Bagging -> sharded tree growth -> row-sharded scoring of the training set ->
+contamination quantile, as one compiled program over a ``(data, trees)`` mesh.
+This is the end-to-end multi-chip path the driver dry-runs
+(``__graft_entry__.dryrun_multichip``); it is also the fast path for
+fit-then-threshold training runs where the intermediate forest never needs to
+leave the device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.bagging import bagged_indices, feature_subsets, per_tree_keys
+from ..ops.ext_growth import ExtendedForest, grow_extended_forest
+from ..ops.traversal import path_lengths
+from ..ops.tree_growth import StandardForest, grow_forest
+from ..utils.math import height_limit, score_from_path_length
+from .mesh import DATA_AXIS, TREES_AXIS
+
+
+class TrainStepResult(NamedTuple):
+    forest: StandardForest | ExtendedForest
+    scores: jax.Array  # f32[N] training-set scores
+    threshold: jax.Array  # f32 scalar; -1 when contamination == 0
+
+
+def make_train_step(
+    mesh,
+    *,
+    num_rows: int,
+    num_features_total: int,
+    num_trees: int,
+    num_samples: int,
+    num_features: int,
+    bootstrap: bool = False,
+    contamination: float = 0.0,
+    contamination_error: float = 0.0,
+    extended: bool = False,
+    extension_level: int = 0,
+):
+    """Build a jitted ``(key, X) -> TrainStepResult`` over ``mesh``.
+
+    ``num_trees`` and ``num_rows`` must divide the total device count (the
+    whole pipeline is shape-fused; pad upstream otherwise — see
+    :func:`isoforest_tpu.parallel.sharded._pad_axis`).
+
+    Threshold computation (``contamination > 0``): with
+    ``contamination_error == 0`` an exact rank pick over the globally sorted
+    scores (GSPMD all-gathers — fine up to tens of millions of rows); with an
+    error budget, a fixed-range histogram whose counts reduce with a single
+    ``psum``-shaped collective per refinement pass — the ICI-native
+    replacement for Spark's distributed approxQuantile (SURVEY.md §5.8) that
+    never materialises the global score vector on one device.
+    """
+    n_devices = mesh.shape[DATA_AXIS] * mesh.shape[TREES_AXIS]
+    if num_trees % n_devices or num_rows % n_devices:
+        raise ValueError(
+            f"num_trees={num_trees} and num_rows={num_rows} must divide the "
+            f"device count {n_devices} for the fused train step"
+        )
+    h = height_limit(num_samples)
+    tree_spec = P((DATA_AXIS, TREES_AXIS))
+    row_spec = P((DATA_AXIS, TREES_AXIS), None)
+
+    if extended:
+        grow = functools.partial(
+            grow_extended_forest, height=h, extension_level=extension_level
+        )
+        forest_specs = ExtendedForest(tree_spec, tree_spec, tree_spec, tree_spec)
+    else:
+        grow = functools.partial(grow_forest, height=h)
+        forest_specs = StandardForest(tree_spec, tree_spec, tree_spec)
+
+    grow_sharded = jax.shard_map(
+        grow,
+        mesh=mesh,
+        in_specs=(tree_spec, P(), tree_spec, tree_spec),
+        out_specs=forest_specs,
+        check_vma=False,
+    )
+
+    def score_local(forest_rep, x_local):
+        return score_from_path_length(path_lengths(forest_rep, x_local), num_samples)
+
+    score_sharded = jax.shard_map(
+        score_local,
+        mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(), forest_specs), row_spec),
+        out_specs=P((DATA_AXIS, TREES_AXIS)),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def train_step(key, X):
+        k_bag, k_feat, k_grow = jax.random.split(key, 3)
+        bag = bagged_indices(k_bag, num_rows, num_samples, num_trees, bootstrap)
+        fidx = feature_subsets(k_feat, num_features_total, num_features, num_trees)
+        tree_keys = per_tree_keys(k_grow, num_trees)
+        forest = grow_sharded(tree_keys, X, bag, fidx)
+        scores = score_sharded(forest, X)
+        if contamination > 0.0 and contamination_error > 0.0:
+            # psum-able histogram sketch: scores stay row-sharded
+            from ..ops.quantile import histogram_quantile_jit
+
+            threshold = histogram_quantile_jit(
+                scores, 1.0 - contamination, eps=contamination_error
+            )
+        elif contamination > 0.0:
+            # exact rank pick == approxQuantile with error budget 0
+            # (SharedTrainLogic.scala:187-197); GSPMD all-gathers the sharded
+            # score vector for the sort.
+            rank = jnp.clip(
+                jnp.ceil((1.0 - contamination) * num_rows).astype(jnp.int32) - 1,
+                0,
+                num_rows - 1,
+            )
+            threshold = jnp.sort(scores)[rank]
+        else:
+            threshold = jnp.float32(-1.0)
+        return TrainStepResult(forest=forest, scores=scores, threshold=threshold)
+
+    return train_step

@@ -1,0 +1,115 @@
+"""scikit-learn adapter — the Pipeline-integration analogue.
+
+The reference plugs into ``spark.ml`` as an Estimator/Model usable inside
+``Pipeline``s (README.md:31-52). The Python-ecosystem equivalent is the
+scikit-learn estimator protocol: this module wraps the TPU models as
+``BaseEstimator``/``OutlierMixin`` classes so they compose with
+``sklearn.pipeline.Pipeline``, ``GridSearchCV``, etc., while running all
+compute through the JAX kernels.
+
+sklearn conventions honoured: ``fit(X, y=None)`` returns self;
+``score_samples`` returns the *negated* anomaly score (higher = more normal,
+matching ``sklearn.ensemble.IsolationForest``); ``predict`` returns +1
+(inlier) / -1 (outlier); ``decision_function = score_samples - offset_``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+try:
+    from sklearn.base import BaseEstimator, OutlierMixin
+    from sklearn.exceptions import NotFittedError
+except Exception:  # pragma: no cover - sklearn is in the base image
+    class BaseEstimator:  # type: ignore
+        pass
+
+    class OutlierMixin:  # type: ignore
+        pass
+
+    class NotFittedError(Exception):  # type: ignore
+        pass
+
+from .models import ExtendedIsolationForest, IsolationForest
+from .utils import ExtendedIsolationForestParams, IsolationForestParams
+
+
+class TpuIsolationForest(BaseEstimator, OutlierMixin):
+    """Drop-in sklearn outlier detector backed by the TPU isolation forest."""
+
+    def __init__(
+        self,
+        n_estimators: int = 100,
+        max_samples: float = 256.0,
+        contamination: float = 0.0,
+        contamination_error: float = 0.0,
+        max_features: float = 1.0,
+        bootstrap: bool = False,
+        random_state: int = 1,
+        extension_level: Optional[int] = None,
+    ):
+        self.n_estimators = n_estimators
+        self.max_samples = max_samples
+        self.contamination = contamination
+        self.contamination_error = contamination_error
+        self.max_features = max_features
+        self.bootstrap = bootstrap
+        self.random_state = random_state
+        self.extension_level = extension_level
+
+    # ------------------------------------------------------------------ #
+
+    def _build_estimator(self):
+        common = dict(
+            num_estimators=self.n_estimators,
+            max_samples=float(self.max_samples),
+            contamination=self.contamination,
+            contamination_error=self.contamination_error,
+            max_features=float(self.max_features),
+            bootstrap=self.bootstrap,
+            random_seed=self.random_state,
+        )
+        if self.extension_level is not None:
+            return ExtendedIsolationForest(
+                params=ExtendedIsolationForestParams(
+                    extension_level=self.extension_level, **common
+                )
+            )
+        return IsolationForest(params=IsolationForestParams(**common))
+
+    def fit(self, X, y=None, mesh=None):
+        X = np.asarray(X, np.float32)
+        self.model_ = self._build_estimator().fit(X, mesh=mesh)
+        thr = self.model_.outlier_score_threshold
+        # decision_function offset: sklearn flags decision_function < 0
+        self.offset_ = -thr if thr > 0 else -0.5
+        self.n_features_in_ = X.shape[1]
+        return self
+
+    def score_samples(self, X) -> np.ndarray:
+        """Negated anomaly score (sklearn convention: higher = more normal)."""
+        self._check_fitted()
+        return -self.model_.score(np.asarray(X, np.float32))
+
+    def decision_function(self, X) -> np.ndarray:
+        return self.score_samples(X) - self.offset_
+
+    def predict(self, X) -> np.ndarray:
+        """+1 inlier / -1 outlier (sklearn convention)."""
+        return np.where(self.decision_function(X) < 0, -1, 1)
+
+    def fit_predict(self, X, y=None) -> np.ndarray:
+        return self.fit(X).predict(X)
+
+    def anomaly_score(self, X) -> np.ndarray:
+        """The reference's raw outlier score in [0, 1] (not negated)."""
+        self._check_fitted()
+        return self.model_.score(np.asarray(X, np.float32))
+
+    def _check_fitted(self):
+        if not hasattr(self, "model_"):
+            raise NotFittedError(
+                "This TpuIsolationForest instance is not fitted yet; call fit first"
+            )

@@ -1,0 +1,39 @@
+from .math import (
+    EULER_GAMMA,
+    avg_path_length,
+    height_limit,
+    max_nodes_for,
+    score_from_path_length,
+)
+from .params import (
+    ExtendedIsolationForestParams,
+    IsolationForestParams,
+    ResolvedParams,
+    resolve_extension_level,
+    resolve_params,
+)
+from .validation import (
+    UNKNOWN_TOTAL_NUM_FEATURES,
+    extract_features,
+    validate_feature_vector_size,
+)
+from .logging import logger, phase, trace
+
+__all__ = [
+    "EULER_GAMMA",
+    "avg_path_length",
+    "height_limit",
+    "max_nodes_for",
+    "score_from_path_length",
+    "ExtendedIsolationForestParams",
+    "IsolationForestParams",
+    "ResolvedParams",
+    "resolve_extension_level",
+    "resolve_params",
+    "UNKNOWN_TOTAL_NUM_FEATURES",
+    "extract_features",
+    "validate_feature_vector_size",
+    "logger",
+    "phase",
+    "trace",
+]

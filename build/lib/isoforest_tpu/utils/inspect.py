@@ -1,0 +1,120 @@
+"""Model-inspection utilities: reference-format tree stringification.
+
+The reference pins golden tree structures as recursive ``toString`` dumps
+(``expectedTreeStructure.txt`` / ``expectedExtendedTreeStructure.txt``,
+asserted by IsolationForestModelWriteReadTest.scala:391-408). Reproducing the
+exact format — including JVM ``Double.toString`` / ``Float.toString`` shortest
+round-trip decimal rendering — lets this framework assert byte-identical
+structure against those committed golden files after loading the fixture
+models, the strongest load-fidelity gate available.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _java_sci(digits: str, exp10: int) -> str:
+    """d.ddd...E±e from a shortest-digit string and decimal exponent."""
+    mantissa = digits[0] + "." + (digits[1:] or "0")
+    return f"{mantissa}E{exp10}"
+
+
+def _format_java(value: float, shortest: str) -> str:
+    """Render like JVM Double/Float.toString given a shortest round-trip
+    decimal string: plain decimal in [1e-3, 1e7), else scientific with 'E'."""
+    if value == 0:
+        return "-0.0" if np.signbit(value) else "0.0"
+    neg = shortest.startswith("-")
+    s = shortest.lstrip("-")
+    if "e" in s or "E" in s:
+        mant, _, exp = s.replace("E", "e").partition("e")
+        digits = mant.replace(".", "").lstrip("0") or "0"
+        point = mant.find(".")
+        int_digits = len(mant[:point] if point >= 0 else mant)
+        exp10 = int(exp) + int_digits - 1
+    else:
+        intpart, _, frac = s.partition(".")
+        if intpart.strip("0"):
+            digits = (intpart + frac).rstrip("0") or "0"
+            exp10 = len(intpart) - 1
+        else:
+            lead = len(frac) - len(frac.lstrip("0"))
+            digits = frac.lstrip("0").rstrip("0") or "0"
+            exp10 = -(lead + 1)
+    digits = digits.rstrip("0") or "0"
+    av = abs(value)
+    sign = "-" if neg else ""
+    if 1e-3 <= av < 1e7:
+        if exp10 >= 0:
+            intp = digits[: exp10 + 1].ljust(exp10 + 1, "0")
+            frac = digits[exp10 + 1 :] or "0"
+            return f"{sign}{intp}.{frac}"
+        return f"{sign}0.{'0' * (-exp10 - 1)}{digits}"
+    return sign + _java_sci(digits, exp10)
+
+
+def java_double_str(value: float) -> str:
+    """JVM ``Double.toString`` rendering."""
+    return _format_java(float(value), repr(float(value)))
+
+
+def java_float_str(value) -> str:
+    """JVM ``Float.toString`` rendering (shortest float32 round trip)."""
+    v32 = np.float32(value)
+    return _format_java(float(v32), np.format_float_positional(v32, unique=True, trim="-"))
+
+
+def standard_tree_string(feature, threshold, num_instances, slot: int = 0) -> str:
+    """Recursive reference-format dump of one standard tree
+    (Nodes.scala toString shape)."""
+    if feature[slot] >= 0:
+        left = standard_tree_string(feature, threshold, num_instances, 2 * slot + 1)
+        right = standard_tree_string(feature, threshold, num_instances, 2 * slot + 2)
+        return (
+            f"InternalNode(splitAttribute = {int(feature[slot])}, "
+            f"splitValue = {java_double_str(threshold[slot])}, "
+            f"leftChild = ({left}), rightChild = ({right}))"
+        )
+    return f"ExternalNode(numInstances = {int(num_instances[slot])})"
+
+
+def extended_tree_string(indices, weights, offset, num_instances, slot: int = 0) -> str:
+    """Recursive reference-format dump of one extended tree
+    (ExtendedNodes.scala / SplitHyperplane toString shape)."""
+    if indices[slot, 0] >= 0:
+        valid = indices[slot] >= 0
+        idx_str = ", ".join(str(int(v)) for v in indices[slot][valid])
+        w_str = ", ".join(java_float_str(v) for v in weights[slot][valid])
+        left = extended_tree_string(indices, weights, offset, num_instances, 2 * slot + 1)
+        right = extended_tree_string(indices, weights, offset, num_instances, 2 * slot + 2)
+        return (
+            f"ExtendedInternalNode(splitHyperplane = SplitHyperplane("
+            f"indices = ({idx_str}), weights = ({w_str}), "
+            f"offset = {java_double_str(offset[slot])}), "
+            f"leftChild = ({left}), rightChild = ({right}))"
+        )
+    return f"ExtendedExternalNode(numInstances = {int(num_instances[slot])})"
+
+
+def tree_structure_string(model, tree_id: int = 0) -> str:
+    """Reference-format structure dump of one tree of a fitted/loaded model."""
+    from ..ops.tree_growth import StandardForest
+
+    forest = model.forest
+    if not (0 <= tree_id < forest.num_trees):
+        raise IndexError(
+            f"tree_id {tree_id} out of range for a {forest.num_trees}-tree forest"
+        )
+    if isinstance(forest, StandardForest):
+        return standard_tree_string(
+            np.asarray(forest.feature[tree_id]),
+            np.asarray(forest.threshold[tree_id]),
+            np.asarray(forest.num_instances[tree_id]),
+        )
+    return extended_tree_string(
+        np.asarray(forest.indices[tree_id]),
+        np.asarray(forest.weights[tree_id]),
+        np.asarray(forest.offset[tree_id]),
+        np.asarray(forest.num_instances[tree_id]),
+    )

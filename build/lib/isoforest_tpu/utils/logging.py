@@ -1,0 +1,55 @@
+"""Logging and phase tracing.
+
+The reference only logs at phase boundaries via Spark's ``Logging`` mixin
+(SURVEY.md §5.1/§5.5 — e.g. SharedTrainLogic.scala:39-42,118-126,147-150).
+The TPU build upgrades that to (a) a standard library logger and (b) optional
+``jax.profiler`` trace annotations around each phase so traces show up in
+TensorBoard/XProf when profiling on real hardware.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+
+logger = logging.getLogger("isoforest_tpu")
+if not logger.handlers:
+    _h = logging.StreamHandler()
+    _h.setFormatter(logging.Formatter("%(asctime)s %(name)s %(levelname)s: %(message)s"))
+    logger.addHandler(_h)
+    logger.setLevel(os.environ.get("ISOFOREST_TPU_LOGLEVEL", "WARNING").upper())
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a jax profiler trace (TensorBoard/XProf-viewable) around a
+    block — the deep-profiling layer the reference lacks (SURVEY.md §5.1):
+
+        with isoforest_tpu.utils.trace("/tmp/trace"):
+            model = IsolationForest().fit(X)
+    """
+    import jax.profiler as _prof
+
+    _prof.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        _prof.stop_trace()
+        logger.info("profiler trace written to %s", log_dir)
+
+
+@contextlib.contextmanager
+def phase(name: str, log_level: int = logging.INFO):
+    """Time a named phase; annotate it in any active jax profiler trace."""
+    try:
+        import jax.profiler as _prof
+
+        ctx = _prof.TraceAnnotation(name)
+    except Exception:  # pragma: no cover
+        ctx = contextlib.nullcontext()
+    start = time.perf_counter()
+    with ctx:
+        yield
+    logger.log(log_level, "phase %s took %.3fs", name, time.perf_counter() - start)

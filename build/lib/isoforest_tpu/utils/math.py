@@ -1,0 +1,89 @@
+"""Numeric primitives shared by the standard and extended isolation forests.
+
+TPU-native re-design of the reference's ``core/Utils.scala`` primitives
+(reference: isolation-forest/src/main/scala/com/linkedin/relevance/isolationforest/core/Utils.scala:74-92).
+Everything here is pure, shape-polymorphic JAX so it can live inside ``jit``,
+``vmap`` and ``shard_map`` regions.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Euler-Mascheroni constant, single precision — matches the reference's
+# ``EulerConstant = 0.5772156649f`` (core/Utils.scala:74).
+EULER_GAMMA = np.float32(0.5772156649)
+
+
+def avg_path_length(num_instances) -> jnp.ndarray:
+    """Expected path length ``c(n)`` of an unsuccessful BST search over ``n`` points.
+
+    ``c(n) = 2 * (ln(n - 1) + gamma) - 2 * (n - 1) / n`` for ``n > 1`` and
+    ``0`` otherwise — the normalisation constant of Liu et al. 2008, identical
+    to the reference implementation (core/Utils.scala:85-92). Computed in
+    float32 to match the reference's ``Float`` arithmetic; the golden pins of
+    ``core/UtilsTest.scala:12-16`` (c(2)=0.15443134, c(10)=3.7488806,
+    c(2^63-1)=86.49098) hold exactly.
+
+    Accepts scalars or arrays (any integer/float dtype); returns float32.
+    """
+    n = jnp.asarray(num_instances, dtype=jnp.float32)
+    safe = jnp.maximum(n, jnp.float32(2.0))
+    c = (
+        jnp.float32(2.0) * (jnp.log(safe - jnp.float32(1.0)) + EULER_GAMMA)
+        - jnp.float32(2.0) * (safe - jnp.float32(1.0)) / safe
+    )
+    return jnp.where(n > jnp.float32(1.0), c, jnp.float32(0.0))
+
+
+def height_limit(num_samples: int) -> int:
+    """Tree height limit ``ceil(log2(n))`` (IsolationTree.scala:60-61).
+
+    Static Python computation — it fixes the compiled tree-tensor shapes
+    (``max_nodes = 2**(height_limit+1) - 1``).
+    """
+    if num_samples < 2:
+        return 0
+    return int(np.ceil(np.log2(float(num_samples))))
+
+
+def height_of(max_nodes: int) -> int:
+    """Inverse of :func:`max_nodes_for`: tree height of an ``max_nodes``-slot
+    implicit heap (``log2(M + 1) - 1``)."""
+    return int(np.log2(max_nodes + 1)) - 1
+
+
+def max_nodes_for(num_samples: int) -> int:
+    """Slot count of the implicit-heap tree tensor for ``num_samples`` points.
+
+    A tree grown over ``n`` points with height limit ``h = ceil(log2(n))``
+    has at most ``2**(h+1) - 1`` nodes; children of heap slot ``i`` live at
+    ``2i+1`` / ``2i+2``. This fixed shape is the core representational
+    decision that lets tree growth and traversal compile to XLA (SURVEY.md
+    §7.1) instead of the reference's pointer-chasing ``Nodes.scala:47-66``.
+    """
+    return 2 ** (height_limit(num_samples) + 1) - 1
+
+
+def score_from_path_length(mean_path_length, num_samples) -> jnp.ndarray:
+    """Anomaly score ``s = 2^(-E[h(x)] / c(n))`` (IsolationForestModel.scala:135-138)."""
+    c = avg_path_length(num_samples)
+    return jnp.exp2(-jnp.asarray(mean_path_length, jnp.float32) / c)
+
+
+def leaf_value_table(num_instances, height: int) -> np.ndarray:
+    """Per-heap-slot ``depth + c(numInstances)`` at leaves, 0 elsewhere —
+    ``f32[T, M]`` (numpy, host-side).
+
+    The shared precompute of the dense/Pallas/native scorers: a walk that
+    ends at slot ``m`` contributes exactly this table entry (slot depth is
+    static in the implicit heap; IsolationTree.scala:213-229 leaf semantics).
+    """
+    depth = np.concatenate(
+        [np.full((1 << lv,), float(lv), np.float32) for lv in range(height + 1)]
+    )
+    ni = np.asarray(num_instances)
+    return np.where(
+        ni >= 0, depth[None, :] + np.asarray(avg_path_length(ni)), 0.0
+    ).astype(np.float32)

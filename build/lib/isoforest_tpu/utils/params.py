@@ -1,0 +1,227 @@
+"""Hyper-parameter objects with the reference's names, defaults and validators.
+
+Mirrors ``core/IsolationForestParamsBase.scala:8-110`` (10 base params) and
+``extended/ExtendedIsolationForestParams.scala:9-29`` (``extensionLevel``),
+including the fraction-vs-count dual semantics of ``maxSamples``/``maxFeatures``
+resolved at fit time (``core/SharedTrainLogic.scala:33-77``).
+
+The params objects are plain frozen dataclasses (host-side config — they never
+enter a jit trace); resolved integer counts feed the static shapes of the
+compiled kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+
+# camelCase aliases used in persisted metadata JSON (paramMap) — the on-disk
+# names must match the reference exactly for model interop
+# (core/IsolationForestModelReadWriteUtils.scala:163-187).
+_PARAM_JSON_NAMES = {
+    "num_estimators": "numEstimators",
+    "max_samples": "maxSamples",
+    "contamination": "contamination",
+    "contamination_error": "contaminationError",
+    "max_features": "maxFeatures",
+    "bootstrap": "bootstrap",
+    "random_seed": "randomSeed",
+    "features_col": "featuresCol",
+    "prediction_col": "predictionCol",
+    "score_col": "scoreCol",
+}
+
+
+@dataclass(frozen=True)
+class IsolationForestParams:
+    """Base hyper-parameters (defaults: IsolationForestParamsBase.scala:98-109)."""
+
+    num_estimators: int = 100
+    max_samples: float = 256.0
+    contamination: float = 0.0
+    contamination_error: float = 0.0
+    max_features: float = 1.0
+    bootstrap: bool = False
+    random_seed: int = 1
+    features_col: str = "features"
+    prediction_col: str = "predictedLabel"
+    score_col: str = "outlierScore"
+
+    def __post_init__(self):
+        if not isinstance(self.num_estimators, int) or self.num_estimators <= 0:
+            raise ValueError(
+                f"numEstimators must be a positive int, got {self.num_estimators}"
+            )
+        if not self.max_samples > 0:
+            raise ValueError(f"maxSamples must be > 0, got {self.max_samples}")
+        if not (0.0 <= self.contamination < 0.5):
+            # range [0, 0.5) per IsolationForestParamsBase.scala contamination validator
+            raise ValueError(
+                f"contamination must be in [0, 0.5), got {self.contamination}"
+            )
+        if not (0.0 <= self.contamination_error <= 1.0):
+            raise ValueError(
+                f"contaminationError must be in [0, 1], got {self.contamination_error}"
+            )
+        if not self.max_features > 0:
+            raise ValueError(f"maxFeatures must be > 0, got {self.max_features}")
+        if not isinstance(self.bootstrap, bool):
+            raise ValueError(f"bootstrap must be a bool, got {self.bootstrap!r}")
+
+    # ------------------------------------------------------------------ #
+
+    def replace(self, **kw) -> "IsolationForestParams":
+        return dataclasses.replace(self, **kw)
+
+    def to_param_map(self) -> dict:
+        """camelCase paramMap dict as persisted in model metadata JSON."""
+        out = {}
+        for field, json_name in _PARAM_JSON_NAMES.items():
+            out[json_name] = getattr(self, field)
+        # The reference persists maxSamples/maxFeatures as doubles (e.g. 256.0).
+        out["maxSamples"] = float(out["maxSamples"])
+        out["maxFeatures"] = float(out["maxFeatures"])
+        return out
+
+    @classmethod
+    def from_param_map(cls, param_map: dict) -> "IsolationForestParams":
+        """Re-hydrate from a persisted paramMap (mirror of Param.jsonDecode usage,
+        core/IsolationForestModelReadWriteUtils.scala:72-84)."""
+        kw = {}
+        inverse = {v: k for k, v in _PARAM_JSON_NAMES.items()}
+        for json_name, value in param_map.items():
+            field = inverse.get(json_name)
+            if field is None:
+                continue
+            if field in ("num_estimators", "random_seed"):
+                value = int(value)
+            elif field == "bootstrap":
+                value = bool(value)
+            elif field in ("max_samples", "contamination", "contamination_error", "max_features"):
+                value = float(value)
+            kw[field] = value
+        return cls(**kw)
+
+
+@dataclass(frozen=True)
+class ExtendedIsolationForestParams(IsolationForestParams):
+    """Adds ``extensionLevel`` (>= 0, unset by default; resolved at fit to
+    ``numFeatures - 1`` = fully extended — ExtendedIsolationForest.scala:56-69)."""
+
+    extension_level: Optional[int] = None
+
+    def __post_init__(self):
+        super().__post_init__()
+        if self.extension_level is not None and (
+            not isinstance(self.extension_level, int) or self.extension_level < 0
+        ):
+            raise ValueError(
+                f"extensionLevel must be an int >= 0, got {self.extension_level}"
+            )
+
+    def to_param_map(self) -> dict:
+        out = super().to_param_map()
+        if self.extension_level is not None:
+            out["extensionLevel"] = int(self.extension_level)
+        return out
+
+    @classmethod
+    def from_param_map(cls, param_map: dict) -> "ExtendedIsolationForestParams":
+        base = IsolationForestParams.from_param_map(param_map)
+        ext = param_map.get("extensionLevel")
+        return cls(
+            **dataclasses.asdict(base),
+            extension_level=None if ext is None else int(ext),
+        )
+
+
+@dataclass(frozen=True)
+class ResolvedParams:
+    """Fit-time resolution of fraction-vs-count semantics
+    (core/Utils.scala:12-17 ``ResolvedParams`` + SharedTrainLogic.scala:33-77).
+
+    ``num_samples``/``num_features`` are the static per-tree sample count and
+    feature-subset size used to shape the compiled kernels.
+    """
+
+    num_samples: int
+    num_features: int
+    total_num_samples: int
+    total_num_features: int
+
+
+def resolve_params(
+    params: IsolationForestParams,
+    total_num_features: int,
+    total_num_samples: int,
+) -> ResolvedParams:
+    """Resolve maxSamples/maxFeatures to integer counts.
+
+    Semantics (SharedTrainLogic.scala:33-77): a value > 1.0 is an absolute
+    count (floored); a value <= 1.0 is a fraction of the total (floored).
+    Requires ``num_features > 0`` and ``num_samples >= 2`` (the reference's
+    ``maxSamples -> 1`` throw, IsolationForestTest.scala:241-266).
+    """
+    if total_num_features <= 0:
+        raise ValueError(f"dataset has no features (totalNumFeatures={total_num_features})")
+    if total_num_samples <= 0:
+        raise ValueError(f"dataset is empty (totalNumSamples={total_num_samples})")
+
+    if params.max_features > 1.0:
+        num_features = int(math.floor(params.max_features))
+    else:
+        num_features = int(math.floor(params.max_features * total_num_features))
+    if params.max_samples > 1.0:
+        num_samples = int(math.floor(params.max_samples))
+    else:
+        num_samples = int(math.floor(params.max_samples * total_num_samples))
+
+    if num_features <= 0:
+        raise ValueError(
+            f"resolved numFeatures must be > 0 (maxFeatures={params.max_features}, "
+            f"totalNumFeatures={total_num_features})"
+        )
+    if num_features > total_num_features:
+        raise ValueError(
+            f"resolved numFeatures={num_features} exceeds totalNumFeatures={total_num_features}"
+        )
+    if num_samples < 2:
+        raise ValueError(
+            f"resolved numSamples must be >= 2 (maxSamples={params.max_samples}, "
+            f"totalNumSamples={total_num_samples})"
+        )
+    # Fixed-shape kernels need exactly num_samples points per tree; the
+    # reference tolerates short partitions with a warning
+    # (SharedTrainLogic.scala:293-299) — we cap at the dataset size instead.
+    num_samples = min(num_samples, total_num_samples)
+
+    return ResolvedParams(
+        num_samples=num_samples,
+        num_features=num_features,
+        total_num_samples=total_num_samples,
+        total_num_features=total_num_features,
+    )
+
+
+def resolve_extension_level(
+    extension_level: Optional[int], num_features: int
+) -> int:
+    """Resolve the EIF extension level at fit time.
+
+    Default (unset) -> ``num_features - 1`` (fully extended); a user value must
+    satisfy ``0 <= extensionLevel <= num_features - 1``
+    (ExtendedIsolationForest.scala:56-69; the estimator is NOT mutated — the
+    resolved value is set on the model only).
+    """
+    max_level = num_features - 1
+    if extension_level is None:
+        return max_level
+    if extension_level > max_level:
+        raise ValueError(
+            f"extensionLevel={extension_level} exceeds maximum {max_level} for "
+            f"{num_features} features"
+        )
+    return int(extension_level)

@@ -1,0 +1,101 @@
+"""Input validation — the TPU-native analogue of the reference's schema checks.
+
+Mirrors ``core/Utils.scala:35-72``: the features column must be vector-valued,
+the output (score / predicted-label) columns must not already exist, and at
+scoring time the feature-vector width must match the training width when it is
+known (``validateFeatureVectorSize``, Utils.scala:67-72;
+``UnknownTotalNumFeatures = -1``, IsolationForestModel.scala:171).
+
+Inputs here are numpy/JAX arrays or pandas DataFrames instead of Spark
+Datasets; the same invariants are enforced eagerly on the host before any
+device computation is traced.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+UNKNOWN_TOTAL_NUM_FEATURES = -1
+
+
+def extract_features(
+    data,
+    features_col: str = "features",
+    output_cols: Tuple[str, ...] = (),
+) -> Tuple[np.ndarray, Optional[object]]:
+    """Normalise user input to a float32 ``[N, F]`` matrix.
+
+    Accepts:
+      * an ``[N, F]`` array-like (numpy / JAX / nested lists) — returned as-is;
+      * a pandas DataFrame with a vector-valued ``features_col`` (each cell an
+        array-like), mirroring the reference's VectorType column contract
+        (core/Utils.scala:35-65).
+
+    Returns ``(X, frame_or_None)`` where the frame is passed back so
+    ``transform`` can append score/label columns to it. Raises if any
+    ``output_cols`` already exist on the frame (Utils.scala:47-58).
+    """
+    try:
+        import pandas as pd
+    except Exception:  # pragma: no cover - pandas is in the base image
+        pd = None
+
+    if pd is not None and isinstance(data, pd.DataFrame):
+        if features_col not in data.columns:
+            raise ValueError(
+                f"features column {features_col!r} not found in input DataFrame "
+                f"(columns: {list(data.columns)})"
+            )
+        for col in output_cols:
+            if col in data.columns:
+                raise ValueError(
+                    f"output column {col!r} already exists in the input DataFrame"
+                )
+        first = data[features_col].iloc[0] if len(data) else None
+        if first is not None and np.ndim(first) == 0:
+            raise ValueError(
+                f"features column {features_col!r} must be vector-valued "
+                f"(each cell an array of floats), got scalar {type(first).__name__}"
+            )
+        X = np.asarray(
+            np.stack(data[features_col].to_numpy()) if len(data) else np.zeros((0, 0)),
+            dtype=np.float32,
+        )
+        _warn_non_finite(X)
+        return X, data
+
+    X = np.asarray(data, dtype=np.float32)
+    if X.ndim != 2:
+        raise ValueError(f"expected a 2-D [num_rows, num_features] matrix, got shape {X.shape}")
+    _warn_non_finite(X)
+    return X, None
+
+
+def _warn_non_finite(X: np.ndarray) -> None:
+    """Non-finite features silently poison per-node min/max statistics during
+    growth (NaN comparisons are all-false, like the JVM's) — surface it once
+    per call instead of producing quietly degraded trees."""
+    if not X.size:
+        return
+    finite = np.isfinite(X)
+    if not finite.all():
+        from .logging import logger
+
+        bad = int(X.size - finite.sum())
+        logger.warning(
+            "input contains %d non-finite feature values (nan/inf); isolation "
+            "trees treat them as incomparable and scores may be degraded",
+            bad,
+        )
+
+
+def validate_feature_vector_size(num_features: int, expected: int) -> None:
+    """Scoring-time width check (core/Utils.scala:67-72): skipped when the
+    training width is unknown (legacy models, sentinel -1)."""
+    if expected != UNKNOWN_TOTAL_NUM_FEATURES and num_features != expected:
+        raise ValueError(
+            f"feature vector has {num_features} features, but the model was "
+            f"trained on {expected}"
+        )
