@@ -3,6 +3,7 @@ from .sharded import (
     sharded_grow_extended_forest,
     sharded_grow_forest,
     sharded_score,
+    sharded_score_2d,
 )
 from .train_step import TrainStepResult, make_train_step
 
@@ -14,6 +15,7 @@ __all__ = [
     "sharded_grow_extended_forest",
     "sharded_grow_forest",
     "sharded_score",
+    "sharded_score_2d",
     "TrainStepResult",
     "make_train_step",
 ]

@@ -16,6 +16,7 @@ from isoforest_tpu.parallel import (
     make_train_step,
     sharded_grow_forest,
     sharded_score,
+    sharded_score_2d,
 )
 from isoforest_tpu.utils import height_limit
 
@@ -89,6 +90,35 @@ class TestShardedEqualsLocal:
         assert sharded.shape == (4093,)
         local = score_matrix(model.forest, odd, model.num_samples)
         np.testing.assert_allclose(local, sharded, rtol=1e-6)
+
+    def test_score_2d_tree_sharded_equal(self, mesh, data):
+        """The tree x row variant (forest stays sharded, psum over the trees
+        axis; VERDICT r2 item 8) must agree with local scoring — equality up
+        to float summation order (psum of per-shard partials)."""
+        model = IsolationForest(num_estimators=16, max_samples=64.0).fit(data)
+        local = score_matrix(model.forest, data, model.num_samples)
+        got = sharded_score_2d(mesh, model.forest, data, model.num_samples)
+        np.testing.assert_allclose(local, got, rtol=1e-6, atol=1e-7)
+
+    def test_score_2d_neutral_tree_padding(self, mesh, data):
+        # 10 trees over a 4-wide trees axis: 2 neutral pad trees whose
+        # contribution to the psum must be exactly zero; odd row count too
+        model = IsolationForest(num_estimators=10, max_samples=64.0).fit(data)
+        odd = data[:4093]
+        got = sharded_score_2d(mesh, model.forest, odd, model.num_samples)
+        assert got.shape == (4093,)
+        local = score_matrix(model.forest, odd, model.num_samples)
+        np.testing.assert_allclose(local, got, rtol=1e-6, atol=1e-7)
+
+    def test_score_2d_extended_forest(self, mesh, data):
+        from isoforest_tpu import ExtendedIsolationForest
+
+        model = ExtendedIsolationForest(
+            num_estimators=10, max_samples=64.0, extension_level=2
+        ).fit(data)
+        got = sharded_score_2d(mesh, model.forest, data, model.num_samples)
+        local = score_matrix(model.forest, data, model.num_samples)
+        np.testing.assert_allclose(local, got, rtol=1e-6, atol=1e-7)
 
 
 class TestFitViaMesh:

@@ -41,6 +41,12 @@ def main() -> None:
         "probing the default backend would hang); default = whatever the "
         "environment registers (a real slice on TPU hosts)",
     )
+    ap.add_argument(
+        "--score-variants",
+        action="store_true",
+        help="measure replicated-forest vs 2-D (tree x row, psum) scoring "
+        "at the full mesh instead of the scaling curve",
+    )
     args = ap.parse_args()
 
     if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
@@ -98,8 +104,49 @@ def main() -> None:
             flush=True,
         )
 
+    def score_variants(n_dev: int, rows: int, trees: int) -> None:
+        """Replicated-forest row sharding vs 2-D tree x row sharding with a
+        trees-axis psum (VERDICT r2 item 8): same compute, different
+        collective — all-gather of the forest vs psum of [rows_local]
+        partials. Winner is measured, not argued."""
+        from isoforest_tpu import IsolationForest
+        from isoforest_tpu.parallel import sharded_score, sharded_score_2d
+
+        mesh = create_mesh(devices=jax.devices()[:n_dev])
+        X = X_full[:rows]
+        model = IsolationForest(
+            num_estimators=trees, max_samples=float(args.samples), random_seed=1
+        ).fit(X)
+        for name, fn in (("replicated", sharded_score), ("2d_psum", sharded_score_2d)):
+            fn(mesh, model.forest, X, model.num_samples)  # compile + warm
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                fn(mesh, model.forest, X, model.num_samples)
+                best = min(best, time.perf_counter() - t0)
+            print(
+                json.dumps(
+                    {
+                        "metric": f"score_variant_{name}",
+                        "devices": n_dev,
+                        "rows": rows,
+                        "trees": trees,
+                        "value": round(best, 4),
+                        "unit": "s",
+                        "rows_per_s": round(rows / best, 1),
+                        "backend": platform,
+                        "mesh": dict(mesh.shape),
+                    }
+                ),
+                flush=True,
+            )
+
     n_max = min(args.max_devices, len(jax.devices()))
     dev_counts = [d for d in (1, 2, 4, 8) if d <= n_max]
+
+    if args.score_variants:
+        score_variants(n_max, args.rows, args.trees)
+        return
 
     def fit_multiple(value: int, n_dev: int) -> int:
         # make_train_step requires rows/trees to divide the mesh axes;
