@@ -45,6 +45,10 @@ def _build() -> Optional[ctypes.CDLL]:
     cmd = [
         compiler,
         "-O3",
+        # scorer.cpp spawns std::thread workers; without -pthread some
+        # glibc/libstdc++ combinations make the constructor throw
+        # system_error at the first multi-threaded call
+        "-pthread",
         # no FMA contraction: the scorer's hyperplane dot must round exactly
         # like XLA's separate mul+add, or near-tie nodes route differently
         # and e2e score parity (ONNX gate, strategy equivalence) breaks

@@ -143,3 +143,60 @@ class TestNativeRecordDecoders:
         cols = native.decode_standard_block(body, count)
         assert list(cols["id"]) == [0, 1, 2]
         assert list(cols["numInstances"]) == [-1, 5, 7]
+
+
+class TestNativeScorerVariants:
+    """The scalar, AVX-512, and threaded row-range kernels must produce
+    bitwise-identical scores: branch decisions are the same f32 comparisons
+    and leaf values accumulate into f64 in ascending-tree order per L2 tile
+    (scorer.cpp header contract). On hosts without AVX-512 the SIMD toggle
+    is a no-op and the assertions hold trivially."""
+
+    @staticmethod
+    def _toggle(monkeypatch, **env):
+        for key, val in env.items():
+            # os.environ.__setitem__ calls putenv, so the C side's getenv
+            # sees the change without a subprocess
+            monkeypatch.setenv(key, val)
+
+    def _standard(self, n_trees):
+        rng = np.random.default_rng(7)
+        N, F, M, H = 3003, 9, 511, 8  # N not a multiple of 16: remainder rows
+        X = rng.normal(size=(N, F)).astype(np.float32)
+        feature = rng.integers(-1, F, size=(n_trees, M)).astype(np.int32)
+        threshold = rng.normal(size=(n_trees, M)).astype(np.float32)
+        ni = rng.integers(-1, 50, size=(n_trees, M)).astype(np.int64)
+        return lambda: native.score_standard(feature, threshold, ni, X, H)
+
+    def _extended(self):
+        rng = np.random.default_rng(8)
+        N, F, T, M, H, K = 2005, 6, 37, 255, 7, 3
+        X = rng.normal(size=(N, F)).astype(np.float32)
+        indices = rng.integers(0, F, size=(T, M, K)).astype(np.int32)
+        leaf = rng.random((T, M)) < 0.3
+        indices[leaf, 0] = -1
+        weights = rng.normal(size=(T, M, K)).astype(np.float32)
+        offset = rng.normal(size=(T, M)).astype(np.float32)
+        ni = np.where(leaf, rng.integers(0, 50, size=(T, M)), -1).astype(np.int64)
+        return lambda: native.score_extended(indices, weights, offset, ni, X, H)
+
+    @pytest.mark.parametrize("n_trees", [42, 301])  # 301 > one L2 tile (~128); both
+    # counts are non-multiples of the SIMD tree interleave, so the
+    # remainder-tree loops execute too
+    def test_standard_simd_threads_bitwise(self, monkeypatch, n_trees):
+        run = self._standard(n_trees)
+        self._toggle(monkeypatch, ISOFOREST_NATIVE_SIMD="0")
+        ref = run()
+        self._toggle(monkeypatch, ISOFOREST_NATIVE_SIMD="1")
+        assert np.array_equal(ref, run())
+        self._toggle(monkeypatch, ISOFOREST_NATIVE_THREADS="4")
+        assert np.array_equal(ref, run())
+
+    def test_extended_simd_threads_bitwise(self, monkeypatch):
+        run = self._extended()
+        self._toggle(monkeypatch, ISOFOREST_NATIVE_SIMD="0")
+        ref = run()
+        self._toggle(monkeypatch, ISOFOREST_NATIVE_SIMD="1")
+        assert np.array_equal(ref, run())
+        self._toggle(monkeypatch, ISOFOREST_NATIVE_THREADS="3")
+        assert np.array_equal(ref, run())
