@@ -25,6 +25,7 @@
 // scores (pinned by tests/test_native.py).
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstdlib>
 #include <thread>
@@ -208,25 +209,57 @@ __attribute__((target("avx512f,avx512dq"))) inline void acc_leaf_f64(
   acc_hi = _mm512_add_pd(acc_hi, _mm512_cvtps_pd(_mm512_extractf32x8_ps(lv, 1)));
 }
 
-// One heap level of the standard walk for 16 row lanes of one tree: gather
-// the split feature, the row's value of it, and the threshold; advance
-// internal lanes to 2n+1+b. The single source for both the interleaved and
-// the remainder-tree loops.
+// Advance 16 row lanes one heap level given this level's split feature and
+// threshold per lane: internal lanes (f >= 0) go to 2n+1+b, leaves stay.
 __attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
-step_standard(__m512i nd, const int32_t* featb, const float* thrb,
-              const float* Xb, __m512i vroff) {
+advance_standard(__m512i nd, __m512i f, __m512 thr, const float* Xb,
+                 __m512i vroff) {
   const __m512i zero = _mm512_setzero_si512();
   const __m512i one = _mm512_set1_epi32(1);
-  const __m512i f = _mm512_i32gather_epi32(nd, featb, 4);
   const __mmask16 internal =
       _mm512_cmp_epi32_mask(f, zero, _MM_CMPINT_NLT);  // f >= 0
   const __m512i fs = _mm512_max_epi32(f, zero);
   const __m512 xv = _mm512_i32gather_ps(_mm512_add_epi32(vroff, fs), Xb, 4);
-  const __m512 thr = _mm512_i32gather_ps(nd, thrb, 4);
   const __mmask16 b = _mm512_cmp_ps_mask(xv, thr, _CMP_GE_OQ);
   __m512i nxt = _mm512_add_epi32(_mm512_slli_epi32(nd, 1), one);
   nxt = _mm512_mask_add_epi32(nxt, b, nxt, one);
   return _mm512_mask_mov_epi32(nd, internal, nxt);
+}
+
+// One heap level of the standard walk for 16 row lanes of one tree: gather
+// the split feature, the row's value of it, and the threshold. The single
+// source for both the interleaved and the remainder-tree loops.
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
+step_standard(__m512i nd, const int32_t* featb, const float* thrb,
+              const float* Xb, __m512i vroff) {
+  const __m512i f = _mm512_i32gather_epi32(nd, featb, 4);
+  const __m512 thr = _mm512_i32gather_ps(nd, thrb, 4);
+  return advance_standard(nd, f, thr, Xb, vroff);
+}
+
+// Node tables for the first PERM_LEVELS heap levels (node ids 0..30) held in
+// two zmm registers: the feature/threshold lookups become vpermi2d/ps (~3
+// cycles) instead of vpgatherdd (~20), leaving only the row-value gather.
+// Requires m_nodes >= 32 (height >= 5); smaller trees take the gather path.
+constexpr int32_t PERM_LEVELS = 5;  // nd entering step s<=4 is <= 30 < 32
+
+struct NodeTable32 {
+  __m512i f_lo, f_hi;
+  __m512 t_lo, t_hi;
+};
+
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline NodeTable32
+load_table32(const int32_t* featb, const float* thrb) {
+  return {_mm512_loadu_si512(featb), _mm512_loadu_si512(featb + 16),
+          _mm512_loadu_ps(thrb), _mm512_loadu_ps(thrb + 16)};
+}
+
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
+step_standard_perm(__m512i nd, const NodeTable32& tab, const float* Xb,
+                   __m512i vroff) {
+  const __m512i f = _mm512_permutex2var_epi32(tab.f_lo, nd, tab.f_hi);
+  const __m512 thr = _mm512_permutex2var_ps(tab.t_lo, nd, tab.t_hi);
+  return advance_standard(nd, f, thr, Xb, vroff);
 }
 
 // One heap level of the extended walk: per-lane sequential hyperplane dot
@@ -282,11 +315,23 @@ __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
       // two paths stay bitwise-equal even for multi-tile forests
       __m512d tot_lo = _mm512_setzero_pd();
       __m512d tot_hi = _mm512_setzero_pd();
+      // levels 0..perm-1 resolve feature/threshold by register permute
+      // (node ids < 32), the rest by gather
+      const int32_t perm = m_nodes >= 32 ? std::min(height, PERM_LEVELS) : 0;
       int64_t t = g0;
       for (; t + TREE_IL <= g1; t += TREE_IL) {
         __m512i nd[TREE_IL];
-        for (int u = 0; u < TREE_IL; ++u) nd[u] = zero;
-        for (int32_t s = 0; s < height; ++s)
+        NodeTable32 tab[TREE_IL];
+        for (int u = 0; u < TREE_IL; ++u) {
+          nd[u] = zero;
+          if (perm)
+            tab[u] = load_table32(feature + (t + u) * m_nodes,
+                                  threshold + (t + u) * m_nodes);
+        }
+        for (int32_t s = 0; s < perm; ++s)
+          for (int u = 0; u < TREE_IL; ++u)
+            nd[u] = step_standard_perm(nd[u], tab[u], Xb, vroff);
+        for (int32_t s = perm; s < height; ++s)
           for (int u = 0; u < TREE_IL; ++u)
             nd[u] = step_standard(nd[u], feature + (t + u) * m_nodes,
                                   threshold + (t + u) * m_nodes, Xb, vroff);
@@ -297,7 +342,13 @@ __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
       }
       for (; t < g1; ++t) {  // remainder trees, one at a time
         __m512i nd = zero;
-        for (int32_t s = 0; s < height; ++s)
+        if (perm) {
+          const NodeTable32 tab =
+              load_table32(feature + t * m_nodes, threshold + t * m_nodes);
+          for (int32_t s = 0; s < perm; ++s)
+            nd = step_standard_perm(nd, tab, Xb, vroff);
+        }
+        for (int32_t s = perm; s < height; ++s)
           nd = step_standard(nd, feature + t * m_nodes,
                              threshold + t * m_nodes, Xb, vroff);
         acc_leaf_f64(_mm512_i32gather_ps(nd, leaf_value + t * m_nodes, 4),
@@ -418,11 +469,29 @@ void run_row_ranges(int64_t n_rows, RangeFn fn) {
   const int64_t chunk = ((n_rows / nt + 15) / 16) * 16 + 16;
   std::vector<std::thread> workers;
   workers.reserve(nt);
-  for (int64_t start = 0; start < n_rows; start += chunk) {
-    const int64_t stop = std::min(n_rows, start + chunk);
-    workers.emplace_back([=] { fn(start, stop); });
+  // An exception here (thread-ctor resource failure, worker bad_alloc)
+  // must not unwind past a joinable std::thread — that std::terminate()s
+  // the host Python process. Join whatever spawned, then recompute the
+  // whole range sequentially: every row is pure, so overwriting rows some
+  // worker already produced yields the identical result.
+  std::atomic<bool> worker_failed{false};
+  bool spawn_failed = false;
+  try {
+    for (int64_t start = 0; start < n_rows; start += chunk) {
+      const int64_t stop = std::min(n_rows, start + chunk);
+      workers.emplace_back([=, &worker_failed] {
+        try {
+          fn(start, stop);
+        } catch (...) {
+          worker_failed.store(true);
+        }
+      });
+    }
+  } catch (...) {
+    spawn_failed = true;
   }
   for (auto& w : workers) w.join();
+  if (spawn_failed || worker_failed.load()) fn(0, n_rows);
 }
 }  // namespace
 

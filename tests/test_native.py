@@ -159,14 +159,14 @@ class TestNativeScorerVariants:
             # sees the change without a subprocess
             monkeypatch.setenv(key, val)
 
-    def _standard(self, n_trees):
+    def _standard(self, n_trees, m=511, h=8):
         rng = np.random.default_rng(7)
-        N, F, M, H = 3003, 9, 511, 8  # N not a multiple of 16: remainder rows
+        N, F = 3003, 9  # N not a multiple of 16: remainder rows
         X = rng.normal(size=(N, F)).astype(np.float32)
-        feature = rng.integers(-1, F, size=(n_trees, M)).astype(np.int32)
-        threshold = rng.normal(size=(n_trees, M)).astype(np.float32)
-        ni = rng.integers(-1, 50, size=(n_trees, M)).astype(np.int64)
-        return lambda: native.score_standard(feature, threshold, ni, X, H)
+        feature = rng.integers(-1, F, size=(n_trees, m)).astype(np.int32)
+        threshold = rng.normal(size=(n_trees, m)).astype(np.float32)
+        ni = rng.integers(-1, 50, size=(n_trees, m)).astype(np.int64)
+        return lambda: native.score_standard(feature, threshold, ni, X, h)
 
     def _extended(self):
         rng = np.random.default_rng(8)
@@ -180,16 +180,24 @@ class TestNativeScorerVariants:
         ni = np.where(leaf, rng.integers(0, 50, size=(T, M)), -1).astype(np.int64)
         return lambda: native.score_extended(indices, weights, offset, ni, X, H)
 
-    @pytest.mark.parametrize("n_trees", [42, 301])  # 301 > one L2 tile (~128); both
-    # counts are non-multiples of the SIMD tree interleave, so the
-    # remainder-tree loops execute too
-    def test_standard_simd_threads_bitwise(self, monkeypatch, n_trees):
-        run = self._standard(n_trees)
+    # tree counts are non-multiples of the SIMD tree interleave so the
+    # remainder-tree loops execute; 301 > one L2 tile (~128 trees); m=31
+    # (height 4) is below the 32-node register-permute threshold, covering
+    # the gather-only branch
+    @pytest.mark.parametrize(
+        "n_trees,m,h", [(42, 511, 8), (301, 511, 8), (50, 31, 4)]
+    )
+    def test_standard_simd_threads_bitwise(self, monkeypatch, n_trees, m, h):
+        run = self._standard(n_trees, m, h)
         self._toggle(monkeypatch, ISOFOREST_NATIVE_SIMD="0")
         ref = run()
         self._toggle(monkeypatch, ISOFOREST_NATIVE_SIMD="1")
         assert np.array_equal(ref, run())
         self._toggle(monkeypatch, ISOFOREST_NATIVE_THREADS="4")
+        assert np.array_equal(ref, run())
+        # scalar kernel under threads (on AVX-512 hosts the previous toggle
+        # only ran scalar code for the <16-row slab remainders)
+        self._toggle(monkeypatch, ISOFOREST_NATIVE_SIMD="0")
         assert np.array_equal(ref, run())
 
     def test_extended_simd_threads_bitwise(self, monkeypatch):
@@ -199,4 +207,6 @@ class TestNativeScorerVariants:
         self._toggle(monkeypatch, ISOFOREST_NATIVE_SIMD="1")
         assert np.array_equal(ref, run())
         self._toggle(monkeypatch, ISOFOREST_NATIVE_THREADS="3")
+        assert np.array_equal(ref, run())
+        self._toggle(monkeypatch, ISOFOREST_NATIVE_SIMD="0")
         assert np.array_equal(ref, run())
