@@ -366,6 +366,53 @@ __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
                                leaf_value, n_trees, m_nodes, height, out);
 }
 
+// k=2 EIF fast path for the first 4 heap levels (extensionLevel=1, the most
+// common extended config): node ids entering steps 0..3 are <= 14, so the
+// flat hyperplane tables indices/weights[2*nd + q] (flat ids <= 29) live in
+// one zmm pair each and the offsets (node ids < 16) in a single zmm —
+// lookups become vpermi2d/ps + vpermd, leaving only the two row-value
+// gathers per node. Requires m_nodes >= 31.
+constexpr int32_t PERM_LEVELS_EXT_K2 = 4;
+
+struct ExtTable32K2 {
+  __m512i i_lo, i_hi;
+  __m512 w_lo, w_hi;
+  __m512 off;
+};
+
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline ExtTable32K2
+load_ext_table_k2(const int32_t* idxb, const float* wb, const float* offb) {
+  return {_mm512_loadu_si512(idxb), _mm512_loadu_si512(idxb + 16),
+          _mm512_loadu_ps(wb), _mm512_loadu_ps(wb + 16), _mm512_loadu_ps(offb)};
+}
+
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
+step_extended_k2_perm(__m512i nd, const ExtTable32K2& tab, const float* Xb,
+                      __m512i vroff) {
+  const __m512i zero = _mm512_setzero_si512();
+  const __m512i one = _mm512_set1_epi32(1);
+  const __m512i i0 = _mm512_slli_epi32(nd, 1);  // flat id 2*nd
+  const __m512i i1 = _mm512_add_epi32(i0, one);
+  const __m512i f0 = _mm512_permutex2var_epi32(tab.i_lo, i0, tab.i_hi);
+  const __m512i f1 = _mm512_permutex2var_epi32(tab.i_lo, i1, tab.i_hi);
+  const __mmask16 internal = _mm512_cmp_epi32_mask(f0, zero, _MM_CMPINT_NLT);
+  const __m512 w0 = _mm512_permutex2var_ps(tab.w_lo, i0, tab.w_hi);
+  const __m512 w1 = _mm512_permutex2var_ps(tab.w_lo, i1, tab.w_hi);
+  const __m512 xv0 = _mm512_i32gather_ps(
+      _mm512_add_epi32(vroff, _mm512_max_epi32(f0, zero)), Xb, 4);
+  const __m512 xv1 = _mm512_i32gather_ps(
+      _mm512_add_epi32(vroff, _mm512_max_epi32(f1, zero)), Xb, 4);
+  // (0 + x0*w0) + x1*w1 == x0*w0 + x1*w1 exactly — same rounding as the
+  // scalar/gather dot loop, no FMA contraction
+  const __m512 dot =
+      _mm512_add_ps(_mm512_mul_ps(xv0, w0), _mm512_mul_ps(xv1, w1));
+  const __m512 off = _mm512_permutexvar_ps(nd, tab.off);
+  const __mmask16 b = _mm512_cmp_ps_mask(dot, off, _CMP_GE_OQ);
+  __m512i nxt = _mm512_add_epi32(_mm512_slli_epi32(nd, 1), one);
+  nxt = _mm512_mask_add_epi32(nxt, b, nxt, one);
+  return _mm512_mask_mov_epi32(nd, internal, nxt);
+}
+
 __attribute__((target("avx512f,avx512dq"))) void score_extended_rows_avx512(
     const float* X, int64_t r0, int64_t r1, int32_t n_features,
     const int32_t* indices, const float* weights, const float* offset,
@@ -389,10 +436,22 @@ __attribute__((target("avx512f,avx512dq"))) void score_extended_rows_avx512(
       __m512d tot_hi = _mm512_setzero_pd();
       // EIF nodes issue 3 gathers per hyperplane term; interleave 2 trees
       // (measured: 4-wide regresses 1.97x -> 1.82x on the build host).
+      const int32_t perm =
+          (k == 2 && m_nodes >= 31) ? std::min(height, PERM_LEVELS_EXT_K2) : 0;
       int64_t t = g0;
       for (; t + 2 <= g1; t += 2) {
         __m512i nd[2] = {zero, zero};
-        for (int32_t s = 0; s < height; ++s)
+        if (perm) {
+          ExtTable32K2 tab[2];
+          for (int u = 0; u < 2; ++u)
+            tab[u] = load_ext_table_k2(indices + (t + u) * m_nodes * k,
+                                       weights + (t + u) * m_nodes * k,
+                                       offset + (t + u) * m_nodes);
+          for (int32_t s = 0; s < perm; ++s)
+            for (int u = 0; u < 2; ++u)
+              nd[u] = step_extended_k2_perm(nd[u], tab[u], Xb, vroff);
+        }
+        for (int32_t s = perm; s < height; ++s)
           for (int u = 0; u < 2; ++u)
             nd[u] = step_extended(nd[u], indices + (t + u) * m_nodes * k,
                                   weights + (t + u) * m_nodes * k,
@@ -404,7 +463,14 @@ __attribute__((target("avx512f,avx512dq"))) void score_extended_rows_avx512(
       }
       for (; t < g1; ++t) {
         __m512i nd = zero;
-        for (int32_t s = 0; s < height; ++s)
+        if (perm) {
+          const ExtTable32K2 tab =
+              load_ext_table_k2(indices + t * m_nodes * k,
+                                weights + t * m_nodes * k, offset + t * m_nodes);
+          for (int32_t s = 0; s < perm; ++s)
+            nd = step_extended_k2_perm(nd, tab, Xb, vroff);
+        }
+        for (int32_t s = perm; s < height; ++s)
           nd = step_extended(nd, indices + t * m_nodes * k,
                              weights + t * m_nodes * k, offset + t * m_nodes,
                              Xb, vroff, vk, k);

@@ -168,9 +168,9 @@ class TestNativeScorerVariants:
         ni = rng.integers(-1, 50, size=(n_trees, m)).astype(np.int64)
         return lambda: native.score_standard(feature, threshold, ni, X, h)
 
-    def _extended(self):
+    def _extended(self, k=3):
         rng = np.random.default_rng(8)
-        N, F, T, M, H, K = 2005, 6, 37, 255, 7, 3
+        N, F, T, M, H, K = 2005, 6, 37, 255, 7, k
         X = rng.normal(size=(N, F)).astype(np.float32)
         indices = rng.integers(0, F, size=(T, M, K)).astype(np.int32)
         leaf = rng.random((T, M)) < 0.3
@@ -200,8 +200,11 @@ class TestNativeScorerVariants:
         self._toggle(monkeypatch, ISOFOREST_NATIVE_SIMD="0")
         assert np.array_equal(ref, run())
 
-    def test_extended_simd_threads_bitwise(self, monkeypatch):
-        run = self._extended()
+    # k=2 exercises the register-permute fast path (extensionLevel=1),
+    # k=3 the general gather path
+    @pytest.mark.parametrize("k", [2, 3])
+    def test_extended_simd_threads_bitwise(self, monkeypatch, k):
+        run = self._extended(k)
         self._toggle(monkeypatch, ISOFOREST_NATIVE_SIMD="0")
         ref = run()
         self._toggle(monkeypatch, ISOFOREST_NATIVE_SIMD="1")
