@@ -218,6 +218,59 @@ def ionosphere_like(
     return X[perm], y[perm]
 
 
+def smtp_like(
+    n: int = 6000, contamination: float = 0.03, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Smtp-family shape: 3-d log-count-like traffic where anomalies deviate
+    on one axis with partial overlap and moderate nuisance variance.
+
+    Published smtp row (/root/reference/README.md:454-456, BASELINE.md):
+    StandardIF 0.910 > ExtendedIF_0 0.896 > ExtendedIF_max 0.858 — a mild
+    EIF_max degradation on low-dim axis-aligned traffic data (same dilution
+    mechanism as annthyroid, softened: only 2 nuisance dims at 1.5 sigma).
+    Measured here over seeds 1-3: std 0.926 / EIF_0 0.923 / EIF_max 0.883."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    n_in = n - n_out
+    f0_in = rng.normal(0.0, 0.6, n_in)
+    nuis_in = rng.normal(0.0, 1.5, (n_in, 2))
+    sign = rng.choice([-1.0, 1.0], n_out)
+    f0_out = sign * np.abs(rng.normal(2.1, 0.7, n_out))
+    nuis_out = rng.normal(0.0, 1.5, (n_out, 2))
+    X = np.vstack(
+        [np.column_stack([f0_in, nuis_in]), np.column_stack([f0_out, nuis_out])]
+    ).astype(np.float32)
+    y = np.concatenate([np.zeros(n_in), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
+def pima_like(
+    n: int = 4000, contamination: float = 0.34, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pima-family shape: 8-d clinical-like data at 34% contamination (pima
+    is 34% positive), outliers shifted on two axes under heavy overlap plus
+    high-variance nuisance axes — the published table's weakest, most
+    overlapped dataset (StandardIF 0.668, /root/reference/README.md:448-450).
+
+    Published ordering: StandardIF 0.668 ~ ExtendedIF_0 0.667 >
+    ExtendedIF_max 0.644. Measured here over seeds 1-3: std 0.637 /
+    EIF_0 0.610 / EIF_max 0.588 — same non-saturated regime and ordering."""
+    rng = np.random.default_rng(seed)
+    n_out = int(n * contamination)
+    n_in = n - n_out
+    X_in = rng.normal(0.0, 1.0, (n_in, 8))
+    X_in[:, 2:] *= 2.5  # high-variance nuisance axes (hyperplane dilution)
+    X_out = rng.normal(0.0, 1.0, (n_out, 8))
+    X_out[:, 2:] *= 2.5
+    X_out[:, 0] += np.abs(rng.normal(2.6, 0.6, n_out))
+    X_out[:, 1] += np.abs(rng.normal(2.2, 0.6, n_out))
+    X = np.vstack([X_in, X_out]).astype(np.float32)
+    y = np.concatenate([np.zeros(n_in), np.ones(n_out)])
+    perm = rng.permutation(n)
+    return X[perm], y[perm]
+
+
 def high_dim_blobs(
     n: int = 20000, f: int = 274, contamination: float = 0.02, seed: int = 0
 ) -> Tuple[np.ndarray, np.ndarray]:

@@ -21,7 +21,9 @@ from isoforest_tpu.data import (
     ionosphere_like,
     kddcup_http_hard,
     mulcross,
+    pima_like,
     sinusoid,
+    smtp_like,
     two_blobs,
 )
 
@@ -156,6 +158,39 @@ class TestPublishedOrderingGates:
         assert 0.80 <= std <= 0.92, f"std {std:.4f} outside band"
         assert 0.86 <= eif <= 0.97, f"EIF_max {eif:.4f} outside band"
         assert eif - std > 0.02, f"EIF advantage lost: gap {eif - std:.4f}"
+
+
+class TestRemainingFamilyGates:
+    """Round 4: the last two published dataset families with a distinctive
+    signature and no gate (smtp's mild EIF_max degradation on low-dim
+    traffic data, README.md:454-456; pima's non-saturated ~0.67 regime at
+    34% contamination with EIF_max worst, :448-450). With these, every
+    published ordering in the 13-dataset table that the generators can
+    mechanistically reproduce is gated; breastw/cardio/satellite carry no
+    distinctive ordering beyond families already covered (their EIF-vs-std
+    gaps are within published noise or duplicate the ionosphere mechanism)."""
+
+    def test_smtp_mild_eif_max_degradation(self):
+        # published: std 0.910 > EIF_0 0.896 > EIF_max 0.858; measured
+        # (seeds 1-3): 0.926 / 0.923 / 0.883
+        std = _seed_mean(smtp_like, IsolationForest)
+        eif0 = _seed_mean(smtp_like, ExtendedIsolationForest, extension_level=0)
+        eif = _seed_mean(smtp_like, ExtendedIsolationForest)
+        assert 0.88 <= std <= 0.96, f"std {std:.4f} outside band"
+        assert 0.83 <= eif <= 0.93, f"EIF_max {eif:.4f} outside band"
+        assert std - eif > 0.015, f"degradation lost: gap {std - eif:.4f}"
+        assert abs(std - eif0) < 0.03, f"EIF_0 {eif0:.4f} vs std {std:.4f}"
+
+    def test_pima_overlapped_regime_eif_max_worst(self):
+        # published: std 0.668 ~ EIF_0 0.667 > EIF_max 0.644; measured
+        # (seeds 1-3): 0.637 / 0.610 / 0.588 — the table's only
+        # non-saturated mid-0.6s dataset, so the band is the signal that
+        # heavy class overlap neither collapses to 0.5 nor inflates
+        std = _seed_mean(pima_like, IsolationForest)
+        eif = _seed_mean(pima_like, ExtendedIsolationForest)
+        assert 0.58 <= std <= 0.72, f"std {std:.4f} outside band"
+        assert 0.52 <= eif <= 0.66, f"EIF_max {eif:.4f} outside band"
+        assert std - eif > 0.02, f"ordering lost: gap {std - eif:.4f}"
 
 
 def _auprc(y, s):
