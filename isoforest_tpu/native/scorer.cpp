@@ -209,21 +209,24 @@ __attribute__((target("avx512f,avx512dq"))) inline void acc_leaf_f64(
   acc_hi = _mm512_add_pd(acc_hi, _mm512_cvtps_pd(_mm512_extractf32x8_ps(lv, 1)));
 }
 
-// Advance 16 row lanes one heap level given this level's split feature and
-// threshold per lane: internal lanes (f >= 0) go to 2n+1+b, leaves stay.
+// Advance 16 row lanes one heap level given this level's split feature,
+// threshold, and row value per lane: internal lanes (f >= 0) go to 2n+1+b,
+// leaves stay.
 __attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
-advance_standard(__m512i nd, __m512i f, __m512 thr, const float* Xb,
-                 __m512i vroff) {
+advance_standard(__m512i nd, __m512i f, __m512 thr, __m512 xv) {
   const __m512i zero = _mm512_setzero_si512();
   const __m512i one = _mm512_set1_epi32(1);
   const __mmask16 internal =
       _mm512_cmp_epi32_mask(f, zero, _MM_CMPINT_NLT);  // f >= 0
-  const __m512i fs = _mm512_max_epi32(f, zero);
-  const __m512 xv = _mm512_i32gather_ps(_mm512_add_epi32(vroff, fs), Xb, 4);
   const __mmask16 b = _mm512_cmp_ps_mask(xv, thr, _CMP_GE_OQ);
   __m512i nxt = _mm512_add_epi32(_mm512_slli_epi32(nd, 1), one);
   nxt = _mm512_mask_add_epi32(nxt, b, nxt, one);
   return _mm512_mask_mov_epi32(nd, internal, nxt);
+}
+
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
+xindex(__m512i f, __m512i vroff) {
+  return _mm512_add_epi32(vroff, _mm512_max_epi32(f, _mm512_setzero_si512()));
 }
 
 // One heap level of the standard walk for 16 row lanes of one tree: gather
@@ -234,7 +237,8 @@ step_standard(__m512i nd, const int32_t* featb, const float* thrb,
               const float* Xb, __m512i vroff) {
   const __m512i f = _mm512_i32gather_epi32(nd, featb, 4);
   const __m512 thr = _mm512_i32gather_ps(nd, thrb, 4);
-  return advance_standard(nd, f, thr, Xb, vroff);
+  return advance_standard(nd, f, thr,
+                          _mm512_i32gather_ps(xindex(f, vroff), Xb, 4));
 }
 
 // Node tables for the first PERM_LEVELS heap levels (node ids 0..30) held in
@@ -242,6 +246,39 @@ step_standard(__m512i nd, const int32_t* featb, const float* thrb,
 // cycles) instead of vpgatherdd (~20), leaving only the row-value gather.
 // Requires m_nodes >= 32 (height >= 5); smaller trees take the gather path.
 constexpr int32_t PERM_LEVELS = 5;  // nd entering step s<=4 is <= 30 < 32
+
+// For F <= 4 the whole 16-row X slab (16*F contiguous floats) fits in F zmm
+// registers, so the row-value lookup x[j*F + f] (flat index < 64) becomes
+// register permutes as well — permute-level steps then issue NO gathers at
+// all, and gather-level steps only the feature/threshold pair. This is the
+// headline regime (kddcup http F=3).
+constexpr int32_t XTAB_MAX_FEATURES = 4;
+
+struct XTable64 {
+  __m512 r0, r1, r2, r3;
+  bool narrow;  // F <= 2: flat ids < 32, single vpermi2ps
+};
+
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline XTable64
+load_xtable(const float* Xb, int32_t f) {
+  // load only registers the slab covers (16*f floats); alias the rest to
+  // r2 so flat ids < 16*f never read past the slab
+  const __m512 r0 = _mm512_loadu_ps(Xb);
+  const __m512 r1 = f >= 2 ? _mm512_loadu_ps(Xb + 16) : r0;
+  const __m512 r2 = f >= 3 ? _mm512_loadu_ps(Xb + 32) : r1;
+  const __m512 r3 = f >= 4 ? _mm512_loadu_ps(Xb + 48) : r2;
+  return {r0, r1, r2, r3, f <= 2};
+}
+
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512
+xlookup(const XTable64& xt, __m512i i) {
+  const __m512 lo = _mm512_permutex2var_ps(xt.r0, i, xt.r1);
+  if (xt.narrow) return lo;
+  const __m512 hi = _mm512_permutex2var_ps(xt.r2, i, xt.r3);
+  const __mmask16 top =
+      _mm512_cmp_epi32_mask(i, _mm512_set1_epi32(31), _MM_CMPINT_NLE);
+  return _mm512_mask_blend_ps(top, lo, hi);
+}
 
 struct NodeTable32 {
   __m512i f_lo, f_hi;
@@ -259,7 +296,27 @@ step_standard_perm(__m512i nd, const NodeTable32& tab, const float* Xb,
                    __m512i vroff) {
   const __m512i f = _mm512_permutex2var_epi32(tab.f_lo, nd, tab.f_hi);
   const __m512 thr = _mm512_permutex2var_ps(tab.t_lo, nd, tab.t_hi);
-  return advance_standard(nd, f, thr, Xb, vroff);
+  return advance_standard(nd, f, thr,
+                          _mm512_i32gather_ps(xindex(f, vroff), Xb, 4));
+}
+
+// Gather-free variant: node table AND X slab in registers (F <= 4).
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
+step_standard_perm_xt(__m512i nd, const NodeTable32& tab, const XTable64& xt,
+                      __m512i vroff) {
+  const __m512i f = _mm512_permutex2var_epi32(tab.f_lo, nd, tab.f_hi);
+  const __m512 thr = _mm512_permutex2var_ps(tab.t_lo, nd, tab.t_hi);
+  return advance_standard(nd, f, thr, xlookup(xt, xindex(f, vroff)));
+}
+
+// Deep levels with a register-resident X slab: gather feature/threshold,
+// permute the row value.
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
+step_standard_xt(__m512i nd, const int32_t* featb, const float* thrb,
+                 const XTable64& xt, __m512i vroff) {
+  const __m512i f = _mm512_i32gather_epi32(nd, featb, 4);
+  const __m512 thr = _mm512_i32gather_ps(nd, thrb, 4);
+  return advance_standard(nd, f, thr, xlookup(xt, xindex(f, vroff)));
 }
 
 // One heap level of the extended walk: per-lane sequential hyperplane dot
@@ -316,8 +373,13 @@ __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
       __m512d tot_lo = _mm512_setzero_pd();
       __m512d tot_hi = _mm512_setzero_pd();
       // levels 0..perm-1 resolve feature/threshold by register permute
-      // (node ids < 32), the rest by gather
+      // (node ids < 32), the rest by gather; F <= 4 additionally resolves
+      // the row value from the register-resident X slab (use_xt), making
+      // permute levels gather-free
       const int32_t perm = m_nodes >= 32 ? std::min(height, PERM_LEVELS) : 0;
+      const bool use_xt = n_features <= XTAB_MAX_FEATURES;
+      const XTable64 xt =
+          use_xt ? load_xtable(Xb, n_features) : XTable64{};
       int64_t t = g0;
       for (; t + TREE_IL <= g1; t += TREE_IL) {
         __m512i nd[TREE_IL];
@@ -330,11 +392,17 @@ __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
         }
         for (int32_t s = 0; s < perm; ++s)
           for (int u = 0; u < TREE_IL; ++u)
-            nd[u] = step_standard_perm(nd[u], tab[u], Xb, vroff);
+            nd[u] = use_xt ? step_standard_perm_xt(nd[u], tab[u], xt, vroff)
+                           : step_standard_perm(nd[u], tab[u], Xb, vroff);
         for (int32_t s = perm; s < height; ++s)
           for (int u = 0; u < TREE_IL; ++u)
-            nd[u] = step_standard(nd[u], feature + (t + u) * m_nodes,
-                                  threshold + (t + u) * m_nodes, Xb, vroff);
+            nd[u] = use_xt
+                        ? step_standard_xt(nd[u], feature + (t + u) * m_nodes,
+                                           threshold + (t + u) * m_nodes, xt,
+                                           vroff)
+                        : step_standard(nd[u], feature + (t + u) * m_nodes,
+                                        threshold + (t + u) * m_nodes, Xb,
+                                        vroff);
         for (int u = 0; u < TREE_IL; ++u)
           acc_leaf_f64(
               _mm512_i32gather_ps(nd[u], leaf_value + (t + u) * m_nodes, 4),
@@ -346,11 +414,14 @@ __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
           const NodeTable32 tab =
               load_table32(feature + t * m_nodes, threshold + t * m_nodes);
           for (int32_t s = 0; s < perm; ++s)
-            nd = step_standard_perm(nd, tab, Xb, vroff);
+            nd = use_xt ? step_standard_perm_xt(nd, tab, xt, vroff)
+                        : step_standard_perm(nd, tab, Xb, vroff);
         }
         for (int32_t s = perm; s < height; ++s)
-          nd = step_standard(nd, feature + t * m_nodes,
-                             threshold + t * m_nodes, Xb, vroff);
+          nd = use_xt ? step_standard_xt(nd, feature + t * m_nodes,
+                                         threshold + t * m_nodes, xt, vroff)
+                      : step_standard(nd, feature + t * m_nodes,
+                                      threshold + t * m_nodes, Xb, vroff);
         acc_leaf_f64(_mm512_i32gather_ps(nd, leaf_value + t * m_nodes, 4),
                      tot_lo, tot_hi);
       }

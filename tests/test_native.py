@@ -159,9 +159,9 @@ class TestNativeScorerVariants:
             # sees the change without a subprocess
             monkeypatch.setenv(key, val)
 
-    def _standard(self, n_trees, m=511, h=8):
+    def _standard(self, n_trees, m=511, h=8, f=9):
         rng = np.random.default_rng(7)
-        N, F = 3003, 9  # N not a multiple of 16: remainder rows
+        N, F = 3003, f  # N not a multiple of 16: remainder rows
         X = rng.normal(size=(N, F)).astype(np.float32)
         feature = rng.integers(-1, F, size=(n_trees, m)).astype(np.int32)
         threshold = rng.normal(size=(n_trees, m)).astype(np.float32)
@@ -183,12 +183,15 @@ class TestNativeScorerVariants:
     # tree counts are non-multiples of the SIMD tree interleave so the
     # remainder-tree loops execute; 301 > one L2 tile (~128 trees); m=31
     # (height 4) is below the 32-node register-permute threshold, covering
-    # the gather-only branch
+    # the gather-only branch; f=3 covers the register-resident X-slab path
+    # (F <= 4) and f=2 its narrow (single-permute) variant
     @pytest.mark.parametrize(
-        "n_trees,m,h", [(42, 511, 8), (301, 511, 8), (50, 31, 4)]
+        "n_trees,m,h,f",
+        [(42, 511, 8, 9), (301, 511, 8, 9), (50, 31, 4, 9),
+         (42, 511, 8, 3), (42, 511, 8, 2)],
     )
-    def test_standard_simd_threads_bitwise(self, monkeypatch, n_trees, m, h):
-        run = self._standard(n_trees, m, h)
+    def test_standard_simd_threads_bitwise(self, monkeypatch, n_trees, m, h, f):
+        run = self._standard(n_trees, m, h, f)
         self._toggle(monkeypatch, ISOFOREST_NATIVE_SIMD="0")
         ref = run()
         self._toggle(monkeypatch, ISOFOREST_NATIVE_SIMD="1")
