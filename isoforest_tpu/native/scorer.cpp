@@ -325,7 +325,7 @@ step_standard_xt(__m512i nd, const int32_t* featb, const float* thrb,
 __attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
 step_extended(__m512i nd, const int32_t* idxb, const float* wb,
               const float* offb, const float* Xb, __m512i vroff, __m512i vk,
-              int32_t k) {
+              int32_t k, bool use_xt, const XTable64& xt) {
   const __m512i zero = _mm512_setzero_si512();
   const __m512i one = _mm512_set1_epi32(1);
   const __m512i sub = _mm512_mullo_epi32(nd, vk);
@@ -336,8 +336,9 @@ step_extended(__m512i nd, const int32_t* idxb, const float* wb,
   __m512i qi = sub;
   for (int32_t q = 0; q < k; ++q) {
     const __m512i f = q == 0 ? f0 : _mm512_i32gather_epi32(qi, idxb, 4);
-    const __m512i fs = _mm512_max_epi32(f, zero);
-    const __m512 xv = _mm512_i32gather_ps(_mm512_add_epi32(vroff, fs), Xb, 4);
+    const __m512i xi = xindex(f, vroff);
+    const __m512 xv =
+        use_xt ? xlookup(xt, xi) : _mm512_i32gather_ps(xi, Xb, 4);
     const __m512 w = _mm512_i32gather_ps(qi, wb, 4);
     dot = _mm512_add_ps(dot, _mm512_mul_ps(xv, w));
     qi = _mm512_add_epi32(qi, one);
@@ -437,46 +438,71 @@ __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
                                leaf_value, n_trees, m_nodes, height, out);
 }
 
-// k=2 EIF fast path for the first 4 heap levels (extensionLevel=1, the most
-// common extended config): node ids entering steps 0..3 are <= 14, so the
-// flat hyperplane tables indices/weights[2*nd + q] (flat ids <= 29) live in
-// one zmm pair each and the offsets (node ids < 16) in a single zmm —
-// lookups become vpermi2d/ps + vpermd, leaving only the two row-value
-// gathers per node. Requires m_nodes >= 31.
-constexpr int32_t PERM_LEVELS_EXT_K2 = 4;
+// k <= 4 EIF fast path for the first 4 heap levels (extensionLevel 1-3,
+// covering the common extended configs): node ids entering steps 0..3 are
+// <= 14, so flat hyperplane ids k*nd + q are <= 15k-1 <= 59 — the
+// indices/weights tables live in two zmm pairs each (64-entry lookups, same
+// shape as xlookup) and the offsets (node ids < 16) in a single zmm. With
+// F <= XTAB_MAX_FEATURES the row values come from the register X slab too,
+// making these steps fully gather-free. Requires m_nodes >= 31 and
+// m_nodes*k >= 64 (the 64-entry flat loads must be in-bounds).
+constexpr int32_t PERM_LEVELS_EXT = 4;
+constexpr int32_t EXT_PERM_MAX_K = 4;
 
-struct ExtTable32K2 {
-  __m512i i_lo, i_hi;
-  __m512 w_lo, w_hi;
+struct ExtTableK4 {
+  __m512i i0, i1, i2, i3;
+  __m512 w0, w1, w2, w3;
   __m512 off;
+  __m512i vhi;  // broadcast 31, for the 64-entry blend
 };
 
-__attribute__((target("avx512f,avx512dq"), always_inline)) inline ExtTable32K2
-load_ext_table_k2(const int32_t* idxb, const float* wb, const float* offb) {
-  return {_mm512_loadu_si512(idxb), _mm512_loadu_si512(idxb + 16),
-          _mm512_loadu_ps(wb), _mm512_loadu_ps(wb + 16), _mm512_loadu_ps(offb)};
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline ExtTableK4
+load_ext_table(const int32_t* idxb, const float* wb, const float* offb) {
+  return {_mm512_loadu_si512(idxb),      _mm512_loadu_si512(idxb + 16),
+          _mm512_loadu_si512(idxb + 32), _mm512_loadu_si512(idxb + 48),
+          _mm512_loadu_ps(wb),           _mm512_loadu_ps(wb + 16),
+          _mm512_loadu_ps(wb + 32),      _mm512_loadu_ps(wb + 48),
+          _mm512_loadu_ps(offb),         _mm512_set1_epi32(31)};
 }
 
 __attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
-step_extended_k2_perm(__m512i nd, const ExtTable32K2& tab, const float* Xb,
-                      __m512i vroff) {
+ext_lookup_i32(const ExtTableK4& t, __m512i i) {
+  const __m512i lo = _mm512_permutex2var_epi32(t.i0, i, t.i1);
+  const __m512i hi = _mm512_permutex2var_epi32(t.i2, i, t.i3);
+  return _mm512_mask_blend_epi32(
+      _mm512_cmp_epi32_mask(i, t.vhi, _MM_CMPINT_NLE), lo, hi);
+}
+
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512
+ext_lookup_ps(const ExtTableK4& t, __m512i i) {
+  const __m512 lo = _mm512_permutex2var_ps(t.w0, i, t.w1);
+  const __m512 hi = _mm512_permutex2var_ps(t.w2, i, t.w3);
+  return _mm512_mask_blend_ps(
+      _mm512_cmp_epi32_mask(i, t.vhi, _MM_CMPINT_NLE), lo, hi);
+}
+
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
+step_extended_perm(__m512i nd, const ExtTableK4& tab, const float* Xb,
+                   __m512i vroff, __m512i vk, int32_t k, bool use_xt,
+                   const XTable64& xt) {
   const __m512i zero = _mm512_setzero_si512();
   const __m512i one = _mm512_set1_epi32(1);
-  const __m512i i0 = _mm512_slli_epi32(nd, 1);  // flat id 2*nd
-  const __m512i i1 = _mm512_add_epi32(i0, one);
-  const __m512i f0 = _mm512_permutex2var_epi32(tab.i_lo, i0, tab.i_hi);
-  const __m512i f1 = _mm512_permutex2var_epi32(tab.i_lo, i1, tab.i_hi);
+  const __m512i sub = _mm512_mullo_epi32(nd, vk);
+  const __m512i f0 = ext_lookup_i32(tab, sub);
   const __mmask16 internal = _mm512_cmp_epi32_mask(f0, zero, _MM_CMPINT_NLT);
-  const __m512 w0 = _mm512_permutex2var_ps(tab.w_lo, i0, tab.w_hi);
-  const __m512 w1 = _mm512_permutex2var_ps(tab.w_lo, i1, tab.w_hi);
-  const __m512 xv0 = _mm512_i32gather_ps(
-      _mm512_add_epi32(vroff, _mm512_max_epi32(f0, zero)), Xb, 4);
-  const __m512 xv1 = _mm512_i32gather_ps(
-      _mm512_add_epi32(vroff, _mm512_max_epi32(f1, zero)), Xb, 4);
-  // (0 + x0*w0) + x1*w1 == x0*w0 + x1*w1 exactly — same rounding as the
-  // scalar/gather dot loop, no FMA contraction
-  const __m512 dot =
-      _mm512_add_ps(_mm512_mul_ps(xv0, w0), _mm512_mul_ps(xv1, w1));
+  // per-lane sequential dot over q — same f32 mul+add order as the scalar
+  // walk (no FMA contraction; (0 + m0) + m1 + ... is the scalar grouping)
+  __m512 dot = _mm512_setzero_ps();
+  __m512i qi = sub;
+  for (int32_t q = 0; q < k; ++q) {
+    const __m512i f = q == 0 ? f0 : ext_lookup_i32(tab, qi);
+    const __m512i xi = xindex(f, vroff);
+    const __m512 xv =
+        use_xt ? xlookup(xt, xi) : _mm512_i32gather_ps(xi, Xb, 4);
+    const __m512 w = ext_lookup_ps(tab, qi);
+    dot = _mm512_add_ps(dot, _mm512_mul_ps(xv, w));
+    qi = _mm512_add_epi32(qi, one);
+  }
   const __m512 off = _mm512_permutexvar_ps(nd, tab.off);
   const __mmask16 b = _mm512_cmp_ps_mask(dot, off, _CMP_GE_OQ);
   __m512i nxt = _mm512_add_epi32(_mm512_slli_epi32(nd, 1), one);
@@ -507,26 +533,34 @@ __attribute__((target("avx512f,avx512dq"))) void score_extended_rows_avx512(
       __m512d tot_hi = _mm512_setzero_pd();
       // EIF nodes issue 3 gathers per hyperplane term; interleave 2 trees
       // (measured: 4-wide regresses 1.97x -> 1.82x on the build host).
+      // m_nodes*k >= 64 keeps load_ext_table's 64-entry flat loads
+      // in-bounds (k=2 with 31-node trees would only have 62)
       const int32_t perm =
-          (k == 2 && m_nodes >= 31) ? std::min(height, PERM_LEVELS_EXT_K2) : 0;
+          (k <= EXT_PERM_MAX_K && m_nodes >= 31 && m_nodes * k >= 64)
+              ? std::min(height, PERM_LEVELS_EXT)
+              : 0;
+      const bool use_xt = n_features <= XTAB_MAX_FEATURES;
+      const XTable64 xt = use_xt ? load_xtable(Xb, n_features) : XTable64{};
       int64_t t = g0;
       for (; t + 2 <= g1; t += 2) {
         __m512i nd[2] = {zero, zero};
         if (perm) {
-          ExtTable32K2 tab[2];
+          ExtTableK4 tab[2];
           for (int u = 0; u < 2; ++u)
-            tab[u] = load_ext_table_k2(indices + (t + u) * m_nodes * k,
-                                       weights + (t + u) * m_nodes * k,
-                                       offset + (t + u) * m_nodes);
+            tab[u] = load_ext_table(indices + (t + u) * m_nodes * k,
+                                    weights + (t + u) * m_nodes * k,
+                                    offset + (t + u) * m_nodes);
           for (int32_t s = 0; s < perm; ++s)
             for (int u = 0; u < 2; ++u)
-              nd[u] = step_extended_k2_perm(nd[u], tab[u], Xb, vroff);
+              nd[u] = step_extended_perm(nd[u], tab[u], Xb, vroff, vk, k,
+                                         use_xt, xt);
         }
         for (int32_t s = perm; s < height; ++s)
           for (int u = 0; u < 2; ++u)
             nd[u] = step_extended(nd[u], indices + (t + u) * m_nodes * k,
                                   weights + (t + u) * m_nodes * k,
-                                  offset + (t + u) * m_nodes, Xb, vroff, vk, k);
+                                  offset + (t + u) * m_nodes, Xb, vroff, vk, k,
+                                  use_xt, xt);
         for (int u = 0; u < 2; ++u)
           acc_leaf_f64(
               _mm512_i32gather_ps(nd[u], leaf_value + (t + u) * m_nodes, 4),
@@ -535,16 +569,16 @@ __attribute__((target("avx512f,avx512dq"))) void score_extended_rows_avx512(
       for (; t < g1; ++t) {
         __m512i nd = zero;
         if (perm) {
-          const ExtTable32K2 tab =
-              load_ext_table_k2(indices + t * m_nodes * k,
-                                weights + t * m_nodes * k, offset + t * m_nodes);
+          const ExtTableK4 tab =
+              load_ext_table(indices + t * m_nodes * k,
+                             weights + t * m_nodes * k, offset + t * m_nodes);
           for (int32_t s = 0; s < perm; ++s)
-            nd = step_extended_k2_perm(nd, tab, Xb, vroff);
+            nd = step_extended_perm(nd, tab, Xb, vroff, vk, k, use_xt, xt);
         }
         for (int32_t s = perm; s < height; ++s)
           nd = step_extended(nd, indices + t * m_nodes * k,
                              weights + t * m_nodes * k, offset + t * m_nodes,
-                             Xb, vroff, vk, k);
+                             Xb, vroff, vk, k, use_xt, xt);
         acc_leaf_f64(_mm512_i32gather_ps(nd, leaf_value + t * m_nodes, 4),
                      tot_lo, tot_hi);
       }

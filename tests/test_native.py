@@ -168,9 +168,9 @@ class TestNativeScorerVariants:
         ni = rng.integers(-1, 50, size=(n_trees, m)).astype(np.int64)
         return lambda: native.score_standard(feature, threshold, ni, X, h)
 
-    def _extended(self, k=3):
+    def _extended(self, k=3, f=6):
         rng = np.random.default_rng(8)
-        N, F, T, M, H, K = 2005, 6, 37, 255, 7, k
+        N, F, T, M, H, K = 2005, f, 37, 255, 7, k
         X = rng.normal(size=(N, F)).astype(np.float32)
         indices = rng.integers(0, F, size=(T, M, K)).astype(np.int32)
         leaf = rng.random((T, M)) < 0.3
@@ -203,11 +203,12 @@ class TestNativeScorerVariants:
         self._toggle(monkeypatch, ISOFOREST_NATIVE_SIMD="0")
         assert np.array_equal(ref, run())
 
-    # k=2 exercises the register-permute fast path (extensionLevel=1),
-    # k=3 the general gather path
-    @pytest.mark.parametrize("k", [2, 3])
-    def test_extended_simd_threads_bitwise(self, monkeypatch, k):
-        run = self._extended(k)
+    # k <= 4 exercises the register-permute fast path (with f=3 also the
+    # register X slab), k=6 the general gather path; k=4 covers the
+    # 64-entry blend lookups
+    @pytest.mark.parametrize("k,f", [(2, 6), (3, 3), (4, 6), (6, 6)])
+    def test_extended_simd_threads_bitwise(self, monkeypatch, k, f):
+        run = self._extended(k, f)
         self._toggle(monkeypatch, ISOFOREST_NATIVE_SIMD="0")
         ref = run()
         self._toggle(monkeypatch, ISOFOREST_NATIVE_SIMD="1")
