@@ -309,6 +309,26 @@ step_standard_perm_xt(__m512i nd, const NodeTable32& tab, const XTable64& xt,
   return advance_standard(nd, f, thr, xlookup(xt, xindex(f, vroff)));
 }
 
+// Heap level 5 (node ids 31..62, 32 of them) also fits one zmm pair per
+// array, indexed by nd-31. Lanes that went leaf at an earlier level have
+// nd < 31 and would alias into the table, so their fetched feature is
+// forced to -1 (leaf) before the advance. Requires m_nodes >= 63.
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
+step_standard_perm_l5(__m512i nd, const NodeTable32& tab, const XTable64& xt,
+                      bool use_xt, const float* Xb, __m512i vroff) {
+  const __m512i vbase = _mm512_set1_epi32(31);
+  const __m512i idx = _mm512_sub_epi32(nd, vbase);
+  const __mmask16 in_level =
+      _mm512_cmp_epi32_mask(nd, vbase, _MM_CMPINT_NLT);  // nd >= 31
+  const __m512i f_raw = _mm512_permutex2var_epi32(tab.f_lo, idx, tab.f_hi);
+  const __m512i f =
+      _mm512_mask_mov_epi32(_mm512_set1_epi32(-1), in_level, f_raw);
+  const __m512 thr = _mm512_permutex2var_ps(tab.t_lo, idx, tab.t_hi);
+  const __m512i xi = xindex(f, vroff);
+  return advance_standard(
+      nd, f, thr, use_xt ? xlookup(xt, xi) : _mm512_i32gather_ps(xi, Xb, 4));
+}
+
 // Deep levels with a register-resident X slab: gather feature/threshold,
 // permute the row value.
 __attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
@@ -395,7 +415,16 @@ __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
           for (int u = 0; u < TREE_IL; ++u)
             nd[u] = use_xt ? step_standard_perm_xt(nd[u], tab[u], xt, vroff)
                            : step_standard_perm(nd[u], tab[u], Xb, vroff);
-        for (int32_t s = perm; s < height; ++s)
+        int32_t deep = perm;
+        if (perm == PERM_LEVELS && height > PERM_LEVELS && m_nodes >= 63) {
+          for (int u = 0; u < TREE_IL; ++u)
+            tab[u] = load_table32(feature + (t + u) * m_nodes + 31,
+                                  threshold + (t + u) * m_nodes + 31);
+          for (int u = 0; u < TREE_IL; ++u)
+            nd[u] = step_standard_perm_l5(nd[u], tab[u], xt, use_xt, Xb, vroff);
+          deep = perm + 1;
+        }
+        for (int32_t s = deep; s < height; ++s)
           for (int u = 0; u < TREE_IL; ++u)
             nd[u] = use_xt
                         ? step_standard_xt(nd[u], feature + (t + u) * m_nodes,
@@ -418,7 +447,14 @@ __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
             nd = use_xt ? step_standard_perm_xt(nd, tab, xt, vroff)
                         : step_standard_perm(nd, tab, Xb, vroff);
         }
-        for (int32_t s = perm; s < height; ++s)
+        int32_t deep = perm;
+        if (perm == PERM_LEVELS && height > PERM_LEVELS && m_nodes >= 63) {
+          const NodeTable32 l5 = load_table32(feature + t * m_nodes + 31,
+                                              threshold + t * m_nodes + 31);
+          nd = step_standard_perm_l5(nd, l5, xt, use_xt, Xb, vroff);
+          deep = perm + 1;
+        }
+        for (int32_t s = deep; s < height; ++s)
           nd = use_xt ? step_standard_xt(nd, feature + t * m_nodes,
                                          threshold + t * m_nodes, xt, vroff)
                       : step_standard(nd, feature + t * m_nodes,
