@@ -547,3 +547,63 @@ class TestDenseDispatchBoundary:
         base = score_matrix(m.forest, X, m.num_samples, strategy="gather")
         got = score_matrix(m.forest, X, m.num_samples, strategy="dense")
         np.testing.assert_allclose(got, base, atol=3e-6)
+
+
+class TestNativeScorerVariantProperties:
+    """Fuzz the native scorer's bitwise contract (scorer.cpp header): for
+    arbitrary forest shapes, the AVX-512 row-lane kernels — including the
+    register-permute node/X-table fast paths their thresholds select by
+    shape — must score bitwise-identically to the scalar kernel. The fixed
+    matrix in test_native.py covers each branch deliberately; this sweeps
+    the threshold boundaries (m_nodes 31/32/63, F 4/5, k 4/5, lane and
+    interleave remainders) at random."""
+
+    @given(
+        n_rows=st.integers(min_value=1, max_value=200),
+        n_trees=st.integers(min_value=1, max_value=40),
+        h=st.integers(min_value=1, max_value=7),
+        f=st.integers(min_value=1, max_value=8),
+        k=st.integers(min_value=2, max_value=6),
+        seed=st.integers(min_value=0, max_value=2**31),
+        extended=st.booleans(),
+    )
+    @_settings
+    def test_simd_matches_scalar_bitwise(
+        self, n_rows, n_trees, h, f, k, seed, extended
+    ):
+        import os
+
+        from isoforest_tpu import native
+
+        if not native.available():
+            pytest.skip("C++ toolchain unavailable")
+        rng = np.random.default_rng(seed)
+        m = (1 << (h + 1)) - 1
+        X = rng.normal(size=(n_rows, f)).astype(np.float32)
+        leaf = rng.random((n_trees, m)) < 0.4
+        ni = np.where(leaf, rng.integers(0, 50, size=(n_trees, m)), -1).astype(
+            np.int64
+        )
+        if extended:
+            idx = rng.integers(0, f, size=(n_trees, m, k)).astype(np.int32)
+            idx[leaf, 0] = -1
+            w = rng.normal(size=(n_trees, m, k)).astype(np.float32)
+            off = rng.normal(size=(n_trees, m)).astype(np.float32)
+            run = lambda: native.score_extended(idx, w, off, ni, X, h)
+        else:
+            feat = np.where(
+                leaf, -1, rng.integers(0, f, size=(n_trees, m))
+            ).astype(np.int32)
+            thr = rng.normal(size=(n_trees, m)).astype(np.float32)
+            run = lambda: native.score_standard(feat, thr, ni, X, h)
+        prev = os.environ.get("ISOFOREST_NATIVE_SIMD")
+        try:
+            os.environ["ISOFOREST_NATIVE_SIMD"] = "0"
+            ref = run()
+            os.environ["ISOFOREST_NATIVE_SIMD"] = "1"
+            assert np.array_equal(ref, run())
+        finally:
+            if prev is None:
+                os.environ.pop("ISOFOREST_NATIVE_SIMD", None)
+            else:
+                os.environ["ISOFOREST_NATIVE_SIMD"] = prev

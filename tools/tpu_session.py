@@ -116,6 +116,12 @@ def main() -> None:
                     help="also run the 10M-row BASELINE.json scale config")
     ap.add_argument("--trace", metavar="DIR", default=None,
                     help="write a jax.profiler trace of scoring + fit")
+    ap.add_argument("--skip-rankings", action="store_true",
+                    help="skip sections 1-3b (strategy rankings, fit timing, "
+                         "chunk sweep) and jump to --headline/--northstar — "
+                         "on CPU the dense rankings cost ~2 min each and can "
+                         "starve a wall-clock-budgeted session of the "
+                         "sections it was launched for (round-4 lesson)")
     args = ap.parse_args()
 
     platform = _bring_up()
@@ -128,64 +134,68 @@ def main() -> None:
 
     X, _ = kddcup_http_hard(n=args.rows)
 
-    # 1. standard-forest strategy ranking (pallas off-TPU would run in
-    # interpret mode — minutes per rep — so it only joins on the chip)
-    std = IsolationForest(num_estimators=100, random_seed=1).fit(X)
-    cands = ["gather", "dense"]
-    if jax.devices()[0].platform == "tpu":
-        cands.append("pallas")
-    std_rank = strategy_ranking(std, X, "standard", cands)
-
-    # 2. extended family, both kernel dispatches
-    ext_sparse = ExtendedIsolationForest(
-        num_estimators=100, extension_level=1, random_seed=1
-    ).fit(X)
-    strategy_ranking(ext_sparse, X, "extended_sparse_k2", cands)
-    ext_full = ExtendedIsolationForest(num_estimators=100, random_seed=1).fit(X)
-    strategy_ranking(ext_full, X, "extended_full", cands)
-
-    # 3. growth-phase timing (fit only, separate from scoring)
-    fit_s = _time(lambda: IsolationForest(num_estimators=100, random_seed=1).fit(X))
-    print(
-        json.dumps(
-            {"metric": "fit_only", "rows": args.rows, "value": round(fit_s, 4), "unit": "s"}
-        ),
-        flush=True,
-    )
-
-    # 3b. scoring chunk-size sweep on the winning strategy: the dense path
-    # streams [chunk, M] intermediates through HBM, so the chunk size trades
-    # working-set size against dispatch overhead — measured, not guessed
     from isoforest_tpu.ops.traversal import score_matrix
 
-    winner_strat = std_rank["winner"] or "dense"
-    chunk_timings = {}
-    for log2c in (14, 16, 18):
-        if (1 << log2c) > args.rows:
-            continue
-        try:
-            chunk_timings[f"2^{log2c}"] = round(
-                _time(
-                    lambda c=1 << log2c: score_matrix(
-                        std.forest, X, std.num_samples, chunk_size=c, strategy=winner_strat
-                    )
-                ),
-                4,
-            )
-        except Exception as exc:  # noqa: BLE001 — a failed point is data
-            chunk_timings[f"2^{log2c}"] = f"error: {str(exc)[:120]}"
-    print(
-        json.dumps(
-            {
-                "metric": "chunk_size_sweep",
-                "strategy": winner_strat,
-                "rows": args.rows,
-                "timings": chunk_timings,
-                "unit": "s",
-            }
-        ),
-        flush=True,
-    )
+    # sections 1-3b (rankings, fit timing, chunk sweep); the fitted forest
+    # is also section 6's trace subject, so it is built regardless
+    std = IsolationForest(num_estimators=100, random_seed=1).fit(X)
+    winner_strat = "dense"
+    if not args.skip_rankings:
+        # 1. standard-forest strategy ranking (pallas off-TPU would run in
+        # interpret mode — minutes per rep — so it only joins on the chip)
+        cands = ["gather", "dense"]
+        if jax.devices()[0].platform == "tpu":
+            cands.append("pallas")
+        std_rank = strategy_ranking(std, X, "standard", cands)
+
+        # 2. extended family, both kernel dispatches
+        ext_sparse = ExtendedIsolationForest(
+            num_estimators=100, extension_level=1, random_seed=1
+        ).fit(X)
+        strategy_ranking(ext_sparse, X, "extended_sparse_k2", cands)
+        ext_full = ExtendedIsolationForest(num_estimators=100, random_seed=1).fit(X)
+        strategy_ranking(ext_full, X, "extended_full", cands)
+
+        # 3. growth-phase timing (fit only, separate from scoring)
+        fit_s = _time(lambda: IsolationForest(num_estimators=100, random_seed=1).fit(X))
+        print(
+            json.dumps(
+                {"metric": "fit_only", "rows": args.rows, "value": round(fit_s, 4), "unit": "s"}
+            ),
+            flush=True,
+        )
+
+        # 3b. scoring chunk-size sweep on the winning strategy: the dense path
+        # streams [chunk, M] intermediates through HBM, so the chunk size trades
+        # working-set size against dispatch overhead — measured, not guessed
+        winner_strat = std_rank["winner"] or "dense"
+        chunk_timings = {}
+        for log2c in (14, 16, 18):
+            if (1 << log2c) > args.rows:
+                continue
+            try:
+                chunk_timings[f"2^{log2c}"] = round(
+                    _time(
+                        lambda c=1 << log2c: score_matrix(
+                            std.forest, X, std.num_samples, chunk_size=c, strategy=winner_strat
+                        )
+                    ),
+                    4,
+                )
+            except Exception as exc:  # noqa: BLE001 — a failed point is data
+                chunk_timings[f"2^{log2c}"] = f"error: {str(exc)[:120]}"
+        print(
+            json.dumps(
+                {
+                    "metric": "chunk_size_sweep",
+                    "strategy": winner_strat,
+                    "rows": args.rows,
+                    "timings": chunk_timings,
+                    "unit": "s",
+                }
+            ),
+            flush=True,
+        )
 
     # 4. the bench.py headline (1M rows, sklearn comparison) in-process —
     # bench's own backend probe is skipped; we already brought the chip up
