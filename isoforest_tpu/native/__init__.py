@@ -21,11 +21,31 @@ import numpy as np
 _HERE = pathlib.Path(__file__).parent
 _SRCS = (_HERE / "isoforest_io.cpp", _HERE / "scorer.cpp", _HERE / "encoder.cpp")
 
+# Single source for the compile flags AND the cache key: a flags-only
+# change (e.g. -pthread, -ffp-contract) must invalidate the cached .so
+# exactly like a source change, or hosts keep dlopen-ing a binary built
+# with the old, possibly parity-breaking flags.
+_CXXFLAGS = (
+    "-O3",
+    # no FMA contraction: the scorer's hyperplane dot must round exactly
+    # like XLA's separate mul+add, or near-tie nodes route differently
+    # and e2e score parity (ONNX gate, strategy equivalence) breaks
+    "-ffp-contract=off",
+    # scorer.cpp spawns std::thread workers; without -pthread some
+    # glibc/libstdc++ combinations make the constructor throw
+    # system_error at the first multi-threaded call
+    "-pthread",
+    "-shared",
+    "-fPIC",
+    "-std=c++17",
+)
+
 
 def _source_digest() -> str:
     h = hashlib.sha256()
     for src in _SRCS:
         h.update(src.read_bytes())
+    h.update(" ".join(_CXXFLAGS).encode())
     return h.hexdigest()[:12]
 
 
@@ -42,24 +62,7 @@ _build_failed = False
 
 def _build() -> Optional[ctypes.CDLL]:
     compiler = os.environ.get("CXX", "g++")
-    cmd = [
-        compiler,
-        "-O3",
-        # scorer.cpp spawns std::thread workers; without -pthread some
-        # glibc/libstdc++ combinations make the constructor throw
-        # system_error at the first multi-threaded call
-        "-pthread",
-        # no FMA contraction: the scorer's hyperplane dot must round exactly
-        # like XLA's separate mul+add, or near-tie nodes route differently
-        # and e2e score parity (ONNX gate, strategy equivalence) breaks
-        "-ffp-contract=off",
-        "-shared",
-        "-fPIC",
-        "-std=c++17",
-        *map(str, _SRCS),
-        "-o",
-        str(_SO),
-    ]
+    cmd = [compiler, *_CXXFLAGS, *map(str, _SRCS), "-o", str(_SO)]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except Exception:

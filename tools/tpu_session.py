@@ -137,9 +137,12 @@ def main() -> None:
     from isoforest_tpu.ops.traversal import score_matrix
 
     # sections 1-3b (rankings, fit timing, chunk sweep); the fitted forest
-    # is also section 6's trace subject, so it is built regardless
+    # is also section 6's trace subject, so it is built regardless.
+    # Without rankings there is no measured winner to pin — "auto" (and
+    # strategy=None for bench_ours) lets the per-backend dispatch decide
+    # rather than silently measuring dense on a chip where pallas wins.
     std = IsolationForest(num_estimators=100, random_seed=1).fit(X)
-    winner_strat = "dense"
+    winner_strat = "auto"
     if not args.skip_rankings:
         # 1. standard-forest strategy ranking (pallas off-TPU would run in
         # interpret mode — minutes per rep — so it only joins on the chip)
@@ -211,7 +214,7 @@ def main() -> None:
         prev_env = os.environ.get("ISOFOREST_TPU_STRATEGY")
         try:
             total_s, bfit_s, score_s, scores, strategy = bench.bench_ours(
-                Xh, strategy=winner_strat
+                Xh, strategy=None if args.skip_rankings else winner_strat
             )
         finally:
             if prev_env is None:
