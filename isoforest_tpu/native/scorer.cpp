@@ -26,8 +26,10 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cctype>
 #include <cstdint>
 #include <cstdlib>
+#include <string>
 #include <thread>
 #include <vector>
 
@@ -636,8 +638,14 @@ __attribute__((target("avx512f,avx512dq"))) void score_extended_rows_avx512(
 // ---------------------------------------------------------------------------
 
 bool use_simd() {
+  // opt-out accepts the obvious spellings, not just "0" — an operator
+  // debugging with ISOFOREST_NATIVE_SIMD=false must actually get scalar
   const char* s = std::getenv("ISOFOREST_NATIVE_SIMD");
-  if (s && s[0] == '0' && s[1] == '\0') return false;
+  if (s) {
+    std::string v(s);
+    for (auto& c : v) c = static_cast<char>(std::tolower(c));
+    if (v == "0" || v == "false" || v == "off" || v == "no") return false;
+  }
 #if IF_X86
   static const bool ok = __builtin_cpu_supports("avx512f") &&
                          __builtin_cpu_supports("avx512dq");
@@ -655,7 +663,10 @@ int env_threads(int64_t n_rows) {
   const char* s = std::getenv("ISOFOREST_NATIVE_THREADS");
   if (s && *s) {
     const int v = std::atoi(s);
-    if (v > 0) return v;
+    // any explicit setting wins: 0 (or junk that parses to <= 0) forces
+    // single-threaded rather than silently falling back to the automatic
+    // multi-thread default
+    return std::max(v, 1);
   }
   constexpr int64_t MIN_ROWS_PER_THREAD = 16 * 1024;
   const unsigned hc = std::thread::hardware_concurrency();

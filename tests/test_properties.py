@@ -555,8 +555,11 @@ class TestNativeScorerVariantProperties:
     register-permute node/X-table fast paths their thresholds select by
     shape — must score bitwise-identically to the scalar kernel. The fixed
     matrix in test_native.py covers each branch deliberately; this sweeps
-    the threshold boundaries (m_nodes 31/32/63, F 4/5, k 4/5, lane and
-    interleave remainders) at random."""
+    the reachable threshold boundaries at random: production m_nodes is
+    always exactly 2^(h+1)-1 (the heap invariant leaf_value_table
+    enforces), so h sweeps m_nodes across the kernels' permute gates at
+    their reachable values (31 -> no fast path, 63 -> nodes + level-5,
+    127+), alongside F 4/5, k 4/5, and lane/interleave remainders."""
 
     @given(
         n_rows=st.integers(min_value=1, max_value=200),

@@ -203,17 +203,22 @@ class TestAutoStrategy:
 
         tree = ast.parse(inspect.getsource(pallas_traversal))
         assigned = {
-            t.id
+            name.id
             for node in ast.walk(tree)
             if isinstance(node, (ast.Assign, ast.AnnAssign))
             for t in (node.targets if isinstance(node, ast.Assign) else [node.target])
-            if isinstance(t, ast.Name)
+            # walk the whole target so tuple/starred unpacking can't hide
+            # a local re-definition
+            for name in ast.walk(t)
+            if isinstance(name, ast.Name)
         }
         assert "_SELECT_MAX_FEATURES" not in assigned
         imported = {
             alias.name
             for node in ast.walk(tree)
-            if isinstance(node, ast.ImportFrom) and node.module == "dense_traversal"
+            if isinstance(node, ast.ImportFrom)
+            and node.module is not None
+            and node.module.split(".")[-1] == "dense_traversal"
             for alias in node.names
         }
         assert "_SELECT_MAX_FEATURES" in imported

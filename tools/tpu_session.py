@@ -136,13 +136,17 @@ def main() -> None:
 
     from isoforest_tpu.ops.traversal import score_matrix
 
+    from isoforest_tpu.ops.traversal import default_strategy
+
     # sections 1-3b (rankings, fit timing, chunk sweep); the fitted forest
     # is also section 6's trace subject, so it is built regardless.
-    # Without rankings there is no measured winner to pin — "auto" (and
-    # strategy=None for bench_ours) lets the per-backend dispatch decide
-    # rather than silently measuring dense on a chip where pallas wins.
+    # Without rankings there is no measured winner to pin — resolve the
+    # per-backend dispatch default (no probing; bench_ours(strategy=None)
+    # would time every candidate, exactly the chip-minute spend
+    # --skip-rankings exists to avoid) rather than silently pinning dense
+    # on a backend where it loses.
     std = IsolationForest(num_estimators=100, random_seed=1).fit(X)
-    winner_strat = "auto"
+    winner_strat = default_strategy()
     if not args.skip_rankings:
         # 1. standard-forest strategy ranking (pallas off-TPU would run in
         # interpret mode — minutes per rep — so it only joins on the chip)
@@ -214,7 +218,7 @@ def main() -> None:
         prev_env = os.environ.get("ISOFOREST_TPU_STRATEGY")
         try:
             total_s, bfit_s, score_s, scores, strategy = bench.bench_ours(
-                Xh, strategy=None if args.skip_rankings else winner_strat
+                Xh, strategy=winner_strat
             )
         finally:
             if prev_env is None:
