@@ -331,6 +331,44 @@ step_standard_perm_l5(__m512i nd, const NodeTable32& tab, const XTable64& xt,
       nd, f, thr, use_xt ? xlookup(xt, xi) : _mm512_i32gather_ps(xi, Xb, 4));
 }
 
+// Heap level 6 (node ids 63..126, 64 of them): two zmm pairs per array
+// with a 64-entry blended lookup (same shape as xlookup). Same stale-lane
+// masking as level 5. Requires m_nodes >= 127.
+struct NodeTable64 {
+  __m512i f0, f1, f2, f3;
+  __m512 t0, t1, t2, t3;
+};
+
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline NodeTable64
+load_table64(const int32_t* featb, const float* thrb) {
+  return {_mm512_loadu_si512(featb),      _mm512_loadu_si512(featb + 16),
+          _mm512_loadu_si512(featb + 32), _mm512_loadu_si512(featb + 48),
+          _mm512_loadu_ps(thrb),          _mm512_loadu_ps(thrb + 16),
+          _mm512_loadu_ps(thrb + 32),     _mm512_loadu_ps(thrb + 48)};
+}
+
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
+step_standard_perm_l6(__m512i nd, const NodeTable64& tab, const XTable64& xt,
+                      bool use_xt, const float* Xb, __m512i vroff) {
+  const __m512i vbase = _mm512_set1_epi32(63);
+  const __m512i idx = _mm512_sub_epi32(nd, vbase);
+  const __mmask16 in_level =
+      _mm512_cmp_epi32_mask(nd, vbase, _MM_CMPINT_NLT);  // nd >= 63
+  const __mmask16 top = _mm512_cmp_epi32_mask(
+      idx, _mm512_set1_epi32(31), _MM_CMPINT_NLE);
+  const __m512i f_lo = _mm512_permutex2var_epi32(tab.f0, idx, tab.f1);
+  const __m512i f_hi = _mm512_permutex2var_epi32(tab.f2, idx, tab.f3);
+  const __m512i f = _mm512_mask_mov_epi32(
+      _mm512_set1_epi32(-1), in_level,
+      _mm512_mask_blend_epi32(top, f_lo, f_hi));
+  const __m512 t_lo = _mm512_permutex2var_ps(tab.t0, idx, tab.t1);
+  const __m512 t_hi = _mm512_permutex2var_ps(tab.t2, idx, tab.t3);
+  const __m512 thr = _mm512_mask_blend_ps(top, t_lo, t_hi);
+  const __m512i xi = xindex(f, vroff);
+  return advance_standard(
+      nd, f, thr, use_xt ? xlookup(xt, xi) : _mm512_i32gather_ps(xi, Xb, 4));
+}
+
 // Deep levels with a register-resident X slab: gather feature/threshold,
 // permute the row value.
 __attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
@@ -425,6 +463,17 @@ __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
           for (int u = 0; u < TREE_IL; ++u)
             nd[u] = step_standard_perm_l5(nd[u], tab[u], xt, use_xt, Xb, vroff);
           deep = perm + 1;
+          if (height > deep && m_nodes >= 127) {
+            // level 6: tables loaded per tree (8 zmm each — sequential use
+            // keeps register pressure flat across the interleave)
+            for (int u = 0; u < TREE_IL; ++u) {
+              const NodeTable64 l6 =
+                  load_table64(feature + (t + u) * m_nodes + 63,
+                               threshold + (t + u) * m_nodes + 63);
+              nd[u] = step_standard_perm_l6(nd[u], l6, xt, use_xt, Xb, vroff);
+            }
+            deep += 1;
+          }
         }
         for (int32_t s = deep; s < height; ++s)
           for (int u = 0; u < TREE_IL; ++u)
@@ -455,6 +504,12 @@ __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
                                               threshold + t * m_nodes + 31);
           nd = step_standard_perm_l5(nd, l5, xt, use_xt, Xb, vroff);
           deep = perm + 1;
+          if (height > deep && m_nodes >= 127) {
+            const NodeTable64 l6 = load_table64(feature + t * m_nodes + 63,
+                                                threshold + t * m_nodes + 63);
+            nd = step_standard_perm_l6(nd, l6, xt, use_xt, Xb, vroff);
+            deep += 1;
+          }
         }
         for (int32_t s = deep; s < height; ++s)
           nd = use_xt ? step_standard_xt(nd, feature + t * m_nodes,
