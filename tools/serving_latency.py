@@ -1,0 +1,49 @@
+"""Serving-batch latency microbench for the native CPU walker.
+
+Measures p50/p99 `model.score(batch)` latency at serving batch sizes with
+the per-forest prep cache warm — the number a low-latency deployment cares
+about, complementary to bench.py's bulk-throughput headline. Run with
+``PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python tools/serving_latency.py``
+in this image (see benchmarks/README.md for the tunnel-wedge context).
+
+Round-4 build host (1 core, avx512f/dq, final kernels): batch 1 p50
+0.57 ms / p99 1.15 ms; batch 64 p50 0.63 ms; batch 1024 p50 0.93 ms;
+batch 8192 p50 2.98 ms — the 16k-row thread gate keeps serving batches
+single-threaded by design.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    from isoforest_tpu import IsolationForest
+    from isoforest_tpu.data import kddcup_http_hard
+
+    X, _ = kddcup_http_hard(n=200_000)
+    model = IsolationForest(num_estimators=100, random_seed=1).fit(X)
+    for bs in (1, 64, 1024, 8192):
+        xb = X[:bs]
+        model.score(xb)  # warm: compile/prep caches
+        times = []
+        for _ in range(50 if bs <= 1024 else 10):
+            t0 = time.perf_counter()
+            model.score(xb)
+            times.append(time.perf_counter() - t0)
+        print(
+            json.dumps(
+                {
+                    "metric": "serving_latency_ms",
+                    "batch": bs,
+                    "p50": round(float(np.percentile(times, 50)) * 1e3, 3),
+                    "p99": round(float(np.percentile(times, 99)) * 1e3, 3),
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
