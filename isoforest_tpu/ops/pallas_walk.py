@@ -1,0 +1,389 @@
+"""O(h) Pallas TPU scoring kernel: a true node-id walk via ``tpu.dynamic_gather``.
+
+The dense kernels (:mod:`.dense_traversal`, :mod:`.pallas_traversal`) tolerate
+a ~64x algorithmic overhead — every row visits all ``M = 2^(h+1)-1`` heap
+slots per tree (511 at the default ``maxSamples=256``) versus the reference
+pointer walk's ``h+1`` visits (``IsolationTree.scala:213-229``) — because
+XLA's per-lane gathers serialise on TPU (measured: gather 15.1 s vs dense
+0.63 s at 1M rows on a live v5e). This kernel gets the walk's O(h) work
+profile *without* XLA gathers by mapping the walk onto Mosaic's
+``tpu.dynamic_gather`` primitive, which is a full-width per-lane VMEM table
+lookup but only spans ONE vreg (128 lanes / 8 sublanes) along the gathered
+dimension.
+
+Layout that makes every lookup single-vreg:
+
+* **rows ride lanes** in groups of 128, **trees ride sublanes** in blocks
+  of 8 — so one ``[8, 128]`` vreg holds (8 trees x 128 rows) of walk state;
+* node tables are **level-major** ("walk layout"): level ``l`` occupies
+  ``max(1, 2^l/128)`` 128-lane chunks, nodes within a level in the
+  level-concat order of :func:`.pallas_traversal._concat_order` (left
+  children first, then right children), so the in-level position update is
+  ``p' = p + go_right * 2^l`` — pure int vector math, no pointer chase;
+* per level, the current node's threshold / feature / leaf value are ONE
+  lane-gather each (plus a select chain over chunks once levels exceed 128
+  nodes), and the row's feature value is ONE sublane-gather from the
+  transposed ``[8, 128]`` X tile (features on sublanes).
+
+Work per (row, tree): ~8 vector-element ops per level, ~70 for the default
+h=8 forest — against the dense walk's ~6,600 — with all tables VMEM-resident
+across the whole row sweep (tree-block grid axis is major, row axis minor).
+
+The extended variant replaces the feature lookup with ``k`` sublane-gathers
+and an f32 multiply-add reduction — **no matmul anywhere**, so it runs at
+full f32 precision and is not subject to the bf16-mantissa precision fence
+that gates :mod:`.pallas_traversal`'s EIF kernels on the remote Mosaic
+toolchain (the fence exists because their hyperplane *matmuls* reject
+``Precision.HIGHEST`` there; reference semantics: f32-cast dot,
+``ExtendedUtils.scala:46-55``). One bounded caveat: on tie-heavy quantized
+data, exact ``dot == offset`` ties can round 1 ulp differently here than
+under growth's own XLA reduce and route to the other child — the same
+deviation class the native C++ walker already carries; see PARITY.md and
+``TestQuantizedTieRouting``.
+
+Correctness is pinned against the gather/dense paths in interpret mode (CI,
+CPU) and by the chipless Mosaic machine-compile gate
+(``tests/mosaic_aot_worker.py``). Select on TPU via
+``score_matrix(strategy="walk")`` or ``ISOFOREST_TPU_STRATEGY=walk``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces are unavailable when lowering for CPU interpret mode
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+from ..utils.math import height_of as _height_of, leaf_value_table
+from .pallas_traversal import _cached_prep, _concat_order
+from .tree_growth import StandardForest
+
+_LANES = 128
+_SUBLANES = 8
+# Row groups of 128 lanes processed per grid step: 8 keeps the X tile at the
+# proven 1024-lane block size and divides the grid-step count (and its
+# per-step overhead) by 8.
+_ROW_GROUPS = 8
+_ROW_TILE = _ROW_GROUPS * _LANES
+# Beyond this many hyperplane coordinates the per-level gather+fma chain
+# approaches the dense kernels' matmul cost; larger k dispatches elsewhere.
+_WALK_K_MAX = 16
+
+
+@functools.lru_cache(maxsize=None)
+def _level_layout(h: int) -> tuple[tuple[int, ...], tuple[int, ...], int]:
+    """Walk-layout geometry: per-level lane offsets, per-level 128-lane chunk
+    counts, and the total padded lane count ``L``."""
+    offs, chunks, off = [], [], 0
+    for level in range(h + 1):
+        c = max(1, (1 << level) >> 7)
+        offs.append(off)
+        chunks.append(c)
+        off += _LANES * c
+    return tuple(offs), tuple(chunks), off
+
+
+def _pad_trees(arr: np.ndarray, fill) -> np.ndarray:
+    """Pad the tree axis up to a sublane multiple; padded trees contribute 0
+    to every walk (leaf table 0 everywhere)."""
+    t = arr.shape[0]
+    t_pad = -t % _SUBLANES
+    if not t_pad:
+        return arr
+    pad = np.full((t_pad,) + arr.shape[1:], fill, arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+def _to_walk_layout(arr_heap: np.ndarray, h: int, fill) -> np.ndarray:
+    """[T, M] heap-order table -> [T, L] level-major walk layout.
+
+    Level ``l``'s nodes sit at lanes ``offs[l] + p`` with ``p`` the in-level
+    position in concat order (:func:`.pallas_traversal._concat_order`); lanes
+    past the level width are ``fill``."""
+    t, m = arr_heap.shape
+    offs, _, L = _level_layout(h)
+    order = list(_concat_order(m))
+    out = np.full((t, L), fill, arr_heap.dtype)
+    pos = 0
+    for level in range(h + 1):
+        w = 1 << level
+        ids = order[pos : pos + w]
+        pos += w
+        out[:, offs[level] : offs[level] + w] = arr_heap[:, ids]
+    return out
+
+
+def walk_tables_standard(forest: StandardForest, h: int):
+    """Walk-layout node tables ``(threshold, feature, leaf_value)``, each
+    ``[T_pad8, L]``. Non-internal slots (leaves, holes below leaves, padding)
+    carry ``threshold=+inf`` (compare is always "go left", keeping the walk
+    on the hole chain under a leaf), ``feature=0`` (a safe gather index) and
+    the leaf-value table's 0 — so exactly one visited slot per (row, tree)
+    contributes, the exit leaf's ``depth + c(numInstances)``."""
+    feat_heap = np.asarray(forest.feature, np.int32)
+    internal = feat_heap >= 0
+    thr = np.where(
+        internal, np.asarray(forest.threshold, np.float32), np.inf
+    ).astype(np.float32)
+    feat = np.maximum(feat_heap, 0).astype(np.int32)
+    leaf = leaf_value_table(np.asarray(forest.num_instances), h)
+    return (
+        jnp.asarray(_pad_trees(_to_walk_layout(thr, h, np.inf), np.inf)),
+        jnp.asarray(_pad_trees(_to_walk_layout(feat, h, 0), 0)),
+        jnp.asarray(_pad_trees(_to_walk_layout(leaf, h, 0.0), 0.0)),
+    )
+
+
+def walk_tables_extended(forest, h: int):
+    """Walk-layout EIF tables ``(offset, idx_packed, w_packed, leaf_value)``.
+
+    The ``k`` hyperplane coordinate/weight planes are packed lane-wise into
+    single 2-D arrays ``[T_pad8, k*L]`` (plane ``q`` at lane offset ``q*L``)
+    so the kernel takes static 128-lane slices of plain 2-D refs — no 3-D
+    block shapes for Mosaic to relayout. Missing coordinates (leaves, holes,
+    sparse padding) carry index 0 / weight 0 and contribute nothing to the
+    dot; offset ``+inf`` keeps sub-leaf walks on the hole chain."""
+    indices = np.asarray(forest.indices, np.int32)  # [T, M, k]
+    weights = np.asarray(forest.weights, np.float32)
+    internal = indices[:, :, 0] >= 0
+    off = np.where(
+        internal, np.asarray(forest.offset, np.float32), np.inf
+    ).astype(np.float32)
+    leaf = leaf_value_table(np.asarray(forest.num_instances), h)
+    k = indices.shape[2]
+    idx_planes = [
+        _to_walk_layout(np.maximum(indices[:, :, q], 0).astype(np.int32), h, 0)
+        for q in range(k)
+    ]
+    w_planes = [
+        _to_walk_layout(
+            np.where(indices[:, :, q] >= 0, weights[:, :, q], 0.0).astype(
+                np.float32
+            ),
+            h,
+            0.0,
+        )
+        for q in range(k)
+    ]
+    return (
+        jnp.asarray(_pad_trees(_to_walk_layout(off, h, np.inf), np.inf)),
+        jnp.asarray(_pad_trees(np.concatenate(idx_planes, axis=1), 0)),
+        jnp.asarray(_pad_trees(np.concatenate(w_planes, axis=1), 0.0)),
+        jnp.asarray(_pad_trees(_to_walk_layout(leaf, h, 0.0), 0.0)),
+    )
+
+
+def _lookup(ref, p, base: int, chunks: int, dtype):
+    """Value of table ``ref`` at in-level position ``p`` — one
+    ``tpu.dynamic_gather`` per 128-lane chunk, selected by ``p``'s high bits
+    when the level spans several chunks."""
+    if chunks == 1:
+        tbl = ref[:, base : base + _LANES]
+        return jnp.take_along_axis(tbl, p, axis=1, mode="promise_in_bounds")
+    p_lo = jnp.bitwise_and(p, _LANES - 1)
+    p_hi = jnp.right_shift(p, 7)
+    acc = jnp.zeros((_SUBLANES, _LANES), dtype)
+    for c in range(chunks):
+        tbl = ref[:, base + c * _LANES : base + (c + 1) * _LANES]
+        g = jnp.take_along_axis(tbl, p_lo, axis=1, mode="promise_in_bounds")
+        acc = jnp.where(p_hi == c, g, acc)
+    return acc
+
+
+def _gather_feature(x_tile, feat_at, fchunks: int):
+    """Row feature values ``x[row, feat_at]`` — a sublane dynamic_gather per
+    8-feature chunk of the transposed X tile."""
+    if fchunks == 1:
+        return jnp.take_along_axis(
+            x_tile, feat_at, axis=0, mode="promise_in_bounds"
+        )
+    f_lo = jnp.bitwise_and(feat_at, _SUBLANES - 1)
+    f_hi = jnp.right_shift(feat_at, 3)
+    acc = jnp.zeros((_SUBLANES, _LANES), jnp.float32)
+    for fc in range(fchunks):
+        xc = x_tile[fc * _SUBLANES : (fc + 1) * _SUBLANES, :]
+        g = jnp.take_along_axis(xc, f_lo, axis=0, mode="promise_in_bounds")
+        acc = jnp.where(f_hi == fc, g, acc)
+    return acc
+
+
+def _accumulate(tb, out_ref, res):
+    @pl.when(tb == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += res
+
+
+def _standard_walk_kernel(h, fchunks, xt_ref, thr_ref, feat_ref, leaf_ref, out_ref):
+    tb = pl.program_id(0)
+    offs, chunks, _ = _level_layout(h)
+    x_all = xt_ref[...]  # [fchunks*8, ROW_TILE]
+    parts = []
+    for r in range(_ROW_GROUPS):
+        x_tile = x_all[:, r * _LANES : (r + 1) * _LANES]
+        p = jnp.zeros((_SUBLANES, _LANES), jnp.int32)
+        total = jnp.zeros((_SUBLANES, _LANES), jnp.float32)
+        for level in range(h + 1):
+            total = total + _lookup(
+                leaf_ref, p, offs[level], chunks[level], jnp.float32
+            )
+            if level < h:
+                thr_at = _lookup(thr_ref, p, offs[level], chunks[level], jnp.float32)
+                feat_at = _lookup(feat_ref, p, offs[level], chunks[level], jnp.int32)
+                x_at = _gather_feature(x_tile, feat_at, fchunks)
+                go_right = (x_at >= thr_at).astype(jnp.int32)
+                p = p + go_right * (1 << level)
+        parts.append(jnp.sum(total, axis=0, keepdims=True))  # [1, 128]
+    _accumulate(tb, out_ref, jnp.concatenate(parts, axis=1))
+
+
+def _extended_walk_kernel(
+    h, fchunks, k, L, xt_ref, off_ref, idx_ref, w_ref, leaf_ref, out_ref
+):
+    tb = pl.program_id(0)
+    offs, chunks, _ = _level_layout(h)
+    x_all = xt_ref[...]
+    parts = []
+    for r in range(_ROW_GROUPS):
+        x_tile = x_all[:, r * _LANES : (r + 1) * _LANES]
+        p = jnp.zeros((_SUBLANES, _LANES), jnp.int32)
+        total = jnp.zeros((_SUBLANES, _LANES), jnp.float32)
+        for level in range(h + 1):
+            total = total + _lookup(
+                leaf_ref, p, offs[level], chunks[level], jnp.float32
+            )
+            if level < h:
+                off_at = _lookup(off_ref, p, offs[level], chunks[level], jnp.float32)
+                # Accumulate the hyperplane dot as jnp.sum over stacked
+                # products — the same formulation growth (`ext_growth`) and
+                # the gather path use. This is load-bearing on tie-heavy
+                # quantized data: a constant coordinate makes the intercept
+                # term bit-equal to every in-node row's term, so
+                # dot == offset EXACTLY iff scoring rounds like growth did;
+                # a sequential fold here landed 1 ulp low and flipped ~30%
+                # of mammography rows into empty-leaf short-circuits
+                # (measured round 5; ExtendedIsolationTree.scala:201-217 is
+                # where the reference inherits the same tie structure).
+                terms = []
+                for q in range(k):
+                    base = q * L + offs[level]
+                    iq = _lookup(idx_ref, p, base, chunks[level], jnp.int32)
+                    wq = _lookup(w_ref, p, base, chunks[level], jnp.float32)
+                    terms.append(_gather_feature(x_tile, iq, fchunks) * wq)
+                dot = jnp.sum(jnp.stack(terms, axis=0), axis=0)
+                # dot >= offset -> right (ExtendedIsolationTree.scala:230-232
+                # partitions dot < offset left), f32 exactly like the gather
+                # path — no matmul, no bf16 mantissa loss
+                go_right = (dot >= off_at).astype(jnp.int32)
+                p = p + go_right * (1 << level)
+        parts.append(jnp.sum(total, axis=0, keepdims=True))
+    _accumulate(tb, out_ref, jnp.concatenate(parts, axis=1))
+
+
+def _vmem_spec(block_shape, index_map):
+    kw = {"memory_space": _VMEM} if _VMEM is not None else {}
+    return pl.BlockSpec(block_shape, index_map, **kw)
+
+
+@functools.partial(jax.jit, static_argnames=("h", "f_raw", "interpret"))
+def _standard_walk(X, thr, feat, leaf, h, f_raw, interpret=False):
+    """Path-length SUM over all trees for padded ``X [Np, F]``; caller
+    divides by the real tree count. Transpose to feature-major happens here,
+    on device, so callers keep the natural row-major layout."""
+    n_pad, _ = X.shape
+    f8 = -(-f_raw // _SUBLANES) * _SUBLANES
+    XT = jnp.pad(X, ((0, 0), (0, f8 - f_raw))).T  # [f8, Np]
+    t_pad, L = thr.shape
+    grid = (t_pad // _SUBLANES, n_pad // _ROW_TILE)  # rows minor: tables stay resident
+    table = _vmem_spec((_SUBLANES, L), lambda tb, rc: (tb, 0))
+    out = pl.pallas_call(
+        functools.partial(_standard_walk_kernel, h, f8 // _SUBLANES),
+        grid=grid,
+        in_specs=[
+            _vmem_spec((f8, _ROW_TILE), lambda tb, rc: (0, rc)),
+            table,
+            table,
+            table,
+        ],
+        out_specs=_vmem_spec((1, _ROW_TILE), lambda tb, rc: (0, rc)),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+        interpret=interpret,
+    )(XT, thr, feat, leaf)
+    return out[0]
+
+
+@functools.partial(jax.jit, static_argnames=("h", "f_raw", "k", "interpret"))
+def _extended_walk(X, off, idx_packed, w_packed, leaf, h, f_raw, k, interpret=False):
+    n_pad, _ = X.shape
+    f8 = -(-f_raw // _SUBLANES) * _SUBLANES
+    XT = jnp.pad(X, ((0, 0), (0, f8 - f_raw))).T
+    t_pad, L = off.shape
+    grid = (t_pad // _SUBLANES, n_pad // _ROW_TILE)
+    table = _vmem_spec((_SUBLANES, L), lambda tb, rc: (tb, 0))
+    packed = _vmem_spec((_SUBLANES, k * L), lambda tb, rc: (tb, 0))
+    out = pl.pallas_call(
+        functools.partial(_extended_walk_kernel, h, f8 // _SUBLANES, k, L),
+        grid=grid,
+        in_specs=[
+            _vmem_spec((f8, _ROW_TILE), lambda tb, rc: (0, rc)),
+            table,
+            packed,
+            packed,
+            table,
+        ],
+        out_specs=_vmem_spec((1, _ROW_TILE), lambda tb, rc: (0, rc)),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+        interpret=interpret,
+    )(XT, off, idx_packed, w_packed, leaf)
+    return out[0]
+
+
+def supports(forest) -> bool:
+    """Whether the walk kernel covers this forest: EIF hyperplanes beyond
+    ``_WALK_K_MAX`` coordinates dispatch to the dense kernels instead."""
+    return (
+        isinstance(forest, StandardForest)
+        or forest.indices.shape[2] <= _WALK_K_MAX
+    )
+
+
+def path_lengths_walk(forest, X, interpret: bool = False) -> jax.Array:
+    """Mean path lengths via the O(h) dynamic-gather walk kernel. Rows are
+    padded to the 1024-lane tile internally; pass ``interpret=True`` off-TPU."""
+    X = jnp.asarray(X, jnp.float32)
+    n, f_raw = X.shape
+    pad = (-n) % _ROW_TILE
+    if pad:
+        X = jnp.pad(X, ((0, pad), (0, 0)))
+    h = _height_of(forest.max_nodes)
+    t_real = forest.num_instances.shape[0]
+    if isinstance(forest, StandardForest):
+        thr, feat, leaf = _cached_prep(
+            forest, lambda: walk_tables_standard(forest, h), extra_key=("walk",)
+        )
+        out = _standard_walk(X, thr, feat, leaf, h, f_raw, interpret=interpret)
+    else:
+        k = forest.indices.shape[2]
+        if k > _WALK_K_MAX:
+            raise ValueError(
+                f"walk kernel supports k <= {_WALK_K_MAX} hyperplane "
+                f"coordinates, got {k}; use the dense/pallas strategies"
+            )
+        off, idx_packed, w_packed, leaf = _cached_prep(
+            forest, lambda: walk_tables_extended(forest, h), extra_key=("walk",)
+        )
+        out = _extended_walk(
+            X, off, idx_packed, w_packed, leaf, h, f_raw, k, interpret=interpret
+        )
+    return out[:n] / jnp.float32(t_real)

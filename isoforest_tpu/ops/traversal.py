@@ -121,10 +121,11 @@ PLATFORM_DEFAULT_STRATEGY = {
 # real TPU (see the fence in :func:`score_matrix`).
 PALLAS_MAX_ROWS = 1 << 18
 
-STRATEGIES = ("gather", "dense", "pallas", "native")
+STRATEGIES = ("gather", "dense", "pallas", "walk", "native")
 
 _warned_native_fallback = False
 _warned_eif_pallas_fence = False
+_warned_walk_wide_k = False
 
 
 def _live_platform() -> str:
@@ -239,6 +240,11 @@ def score_matrix(
         serialise.
       * ``"pallas"`` — hand-blocked TPU kernel of the dense algorithm
         (:mod:`.pallas_traversal`).
+      * ``"walk"`` — O(h) dynamic-gather node-id walk (:mod:`.pallas_walk`):
+        the reference pointer walk's work profile (~70 element-ops per
+        row-tree vs dense's ~6,600) mapped onto Mosaic's single-vreg
+        ``tpu.dynamic_gather``. Falls back to dense for EIF hyperplanes
+        wider than 16 coordinates.
       * ``"native"`` — hand-scheduled C++ walker (:mod:`..native` scorer),
         the CPU fast path; no jax involvement at all.
       * ``"auto"`` — ``ISOFOREST_TPU_STRATEGY`` env var if set, else the
@@ -273,6 +279,27 @@ def score_matrix(
             f"unknown scoring strategy {strategy!r}; expected one of "
             f"'auto', {', '.join(repr(s) for s in STRATEGIES)}"
         )
+    if strategy == "walk":
+        from . import pallas_walk
+
+        if not pallas_walk.supports(forest):
+            # wide-k EIF hyperplanes: the gather+fma chain stops paying;
+            # dense keeps HIGHEST-precision semantics. Warn once so pinned
+            # measurements are never silently mislabeled (same contract as
+            # the pallas fence / native fallback below).
+            global _warned_walk_wide_k
+            if not _warned_walk_wide_k:
+                _warned_walk_wide_k = True
+                from ..utils import logger
+
+                logger.warning(
+                    "strategy='walk' supports EIF hyperplanes up to k=%d "
+                    "coordinates; this forest has k=%d — scoring with the "
+                    "dense strategy instead",
+                    pallas_walk._WALK_K_MAX,
+                    forest.indices.shape[2],
+                )
+            strategy = "dense"
     if strategy == "pallas" and extended and _live_platform() == "tpu":
         # Precision fence (VERDICT r2 item 4 / ADVICE r2 medium): the EIF
         # Pallas kernels' hyperplane contractions run at the TPU's default
@@ -317,6 +344,15 @@ def score_matrix(
 
         def run_chunk(chunk):
             pl_len = path_lengths_pallas(forest, chunk, interpret=interpret)
+            return score_from_path_length(pl_len, num_samples)
+
+    elif strategy == "walk":
+        from .pallas_walk import path_lengths_walk
+
+        interpret = _live_platform() != "tpu"
+
+        def run_chunk(chunk):
+            pl_len = path_lengths_walk(forest, chunk, interpret=interpret)
             return score_from_path_length(pl_len, num_samples)
 
     else:

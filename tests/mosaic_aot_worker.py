@@ -91,6 +91,39 @@ def main() -> int:
         Xp, W, off, internal, leaf,
     )
     print("extended dense: machine compile ok", flush=True)
+
+    # --- O(h) dynamic-gather walk kernels (pallas_walk) ---
+    from isoforest_tpu.ops import pallas_walk as pw
+
+    Xw = jnp.asarray(np.ascontiguousarray(X[: pw._ROW_TILE]))
+    forest = std.forest
+    h = height_of(forest.max_nodes)
+    thr, feat, leafw = pw.walk_tables_standard(forest, h)
+    aot(
+        lambda a, b, c, d: pw._standard_walk(a, b, c, d, h, X.shape[1]),
+        Xw, thr, feat, leafw,
+    )
+    print("walk standard: machine compile ok", flush=True)
+    # wide-F variant drives the multi-chunk sublane feature gather
+    Xwide = jnp.asarray(rng.normal(size=(pw._ROW_TILE, 24)).astype(np.float32))
+    stdw = IsolationForest(num_estimators=3, max_samples=64.0, random_seed=1).fit(
+        np.asarray(Xwide)
+    )
+    thr24, feat24, leaf24 = pw.walk_tables_standard(stdw.forest, h)
+    aot(
+        lambda a, b, c, d: pw._standard_walk(a, b, c, d, h, 24),
+        Xwide, thr24, feat24, leaf24,
+    )
+    print("walk standard wide-F: machine compile ok", flush=True)
+    forest = ext.forest
+    h = height_of(forest.max_nodes)
+    k = forest.indices.shape[2]
+    offw, idx_packed, w_packed, leafe = pw.walk_tables_extended(forest, h)
+    aot(
+        lambda a, b, c, d, e: pw._extended_walk(a, b, c, d, e, h, X.shape[1], k),
+        Xw, offw, idx_packed, w_packed, leafe,
+    )
+    print("walk extended: machine compile ok", flush=True)
     return 0
 
 

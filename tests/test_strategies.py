@@ -23,7 +23,7 @@ def models():
     return X, std, ext
 
 
-@pytest.mark.parametrize("strategy", ["dense", "pallas", "native"])
+@pytest.mark.parametrize("strategy", ["dense", "pallas", "walk", "native"])
 class TestStrategyEquivalence:
     def test_standard(self, models, strategy):
         X, std, _ = models
@@ -228,9 +228,59 @@ class TestAutoStrategy:
         X = np.full((1100, 3), 2.0, np.float32)
         ext = ExtendedIsolationForest(num_estimators=4, max_samples=32.0).fit(X)
         base = score_matrix(ext.forest, X, ext.num_samples, strategy="gather")
-        for strategy in ["dense", "pallas", "native"]:
+        for strategy in ["dense", "pallas", "walk", "native"]:
             got = score_matrix(ext.forest, X, ext.num_samples, strategy=strategy)
             np.testing.assert_allclose(got, base, atol=3e-6)
+
+
+class TestQuantizedTieRouting:
+    """EIF exact-tie routing on quantized data (PARITY.md deviation note).
+
+    When every chosen hyperplane coordinate is constant within a node, the
+    intercept point coincides with the in-node rows coordinate-wise and
+    ``dot == offset`` holds exactly — but only under the accumulation
+    rounding growth itself used (XLA's k-axis reduce). Strategies sharing
+    that reduce (dense/pallas) must match gather bitwise-tight; strategies
+    with their own accumulation (native's separate mul+add, the walk
+    kernel's stacked-term sum) may flip exact ties 1 ulp and take the other
+    child. This pins BOTH facts: the XLA family stays exact, and the
+    independent-accumulation family's deviation stays bounded and
+    quality-invisible (measured on mammography: 3,329/11,183 rows,
+    max score delta 0.011, AUROC delta < 1e-3)."""
+
+    @pytest.fixture(scope="class")
+    def quantized(self):
+        rng = np.random.default_rng(11)
+        # heavily quantized integer grid -> constant coordinates abound in
+        # deep nodes, exactly the mammography tie mechanism
+        X = rng.integers(0, 4, size=(6000, 5)).astype(np.float32)
+        X[:60] += 9.0  # a separable outlier block for the AUROC check
+        y = np.zeros(len(X))
+        y[:60] = 1.0
+        ext = ExtendedIsolationForest(
+            num_estimators=30, max_samples=256.0, random_seed=5
+        ).fit(X)
+        base = score_matrix(ext.forest, X, ext.num_samples, strategy="gather")
+        return X, y, ext, base
+
+    @pytest.mark.parametrize("strategy", ["dense", "pallas"])
+    def test_xla_reduce_family_is_tie_exact(self, quantized, strategy):
+        X, _, ext, base = quantized
+        got = score_matrix(ext.forest, X, ext.num_samples, strategy=strategy)
+        np.testing.assert_allclose(got, base, atol=3e-6)
+
+    @pytest.mark.parametrize("strategy", ["walk", "native"])
+    def test_independent_accumulation_bounded(self, quantized, strategy):
+        from conftest import auroc  # tie-aware (average ranks) shared helper
+
+        X, y, ext, base = quantized
+        got = score_matrix(ext.forest, X, ext.num_samples, strategy=strategy)
+        diff = np.abs(got - base)
+        # tie flips change one exit leaf's depth; scores stay close
+        assert diff.max() < 0.05, f"max tie deviation {diff.max()}"
+        assert (diff > 1e-5).mean() < 0.5, "tie flips must stay a minority"
+        assert abs(auroc(got, y) - auroc(base, y)) < 1e-3
+        assert abs(got.mean() - base.mean()) < 1e-3
 
 
 class TestPallasExtendedDispatch:
@@ -334,7 +384,7 @@ class TestPallasMosaicMachineCompile:
             f"Mosaic machine compile failed (rc={out.returncode}):\n"
             f"{out.stdout[-500:]}\n{out.stderr[-2000:]}"
         )
-        assert out.stdout.count("machine compile ok") == 4
+        assert out.stdout.count("machine compile ok") == 7
 
 
 class TestPallasTpuLowering:

@@ -11,11 +11,30 @@ Run via::
     PALLAS_AXON_POOL_IPS= TF_CPP_MIN_LOG_LEVEL=0 python tools/probe_tpu.py [timeout_s]
 
 Exit codes: 0 = TPU live (prints devices), 2 = registration/claim failed.
+
+Every outcome the probe can observe is auto-appended to
+``benchmarks/tpu_probe_history.log`` (the hang case is the caller's to log —
+a wedged ``PJRT_Client_Create`` never returns control to this process, so
+``bench.py`` logs the timeout-kill on our behalf).
 """
 
+import datetime
 import os
+import pathlib
 import sys
 import uuid
+
+_HISTORY = pathlib.Path(__file__).resolve().parent.parent / "benchmarks" / "tpu_probe_history.log"
+
+
+def append_history(outcome: str) -> None:
+    """Append a timestamped probe outcome to the shared history log."""
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%MZ")
+    try:
+        with _HISTORY.open("a") as fh:
+            fh.write(f"{stamp} probe: {outcome}\n")
+    except OSError as e:  # read-only checkout: report but don't fail the probe
+        print(f"probe: history log unwritable: {e}", file=sys.stderr)
 
 
 def main() -> int:
@@ -45,6 +64,7 @@ def main() -> int:
         )
     except Exception as e:  # noqa: BLE001 - report, don't crash the probe
         print(f"probe: register() failed: {type(e).__name__}: {e}", file=sys.stderr)
+        append_history(f"register() failed ({type(e).__name__}: {e})")
         return 2
     import jax
 
@@ -53,10 +73,14 @@ def main() -> int:
         x = jax.numpy.ones((8, 8))
         y = jax.jit(lambda a: (a @ a).sum())(x)
         y.block_until_ready()
+        # machine-readable line first: callers (bench.py) parse "platform=..."
+        print(f"probe: live platform={devs[0].platform} ndev={len(devs)}")
         print(f"probe: live devices={devs} matmul_ok={float(y)}")
+        append_history(f"LIVE ({len(devs)}x {devs[0].platform}, matmul ok)")
         return 0
     except Exception as e:  # noqa: BLE001
         print(f"probe: device query failed: {type(e).__name__}: {e}", file=sys.stderr)
+        append_history(f"device query failed ({type(e).__name__})")
         return 2
 
 
