@@ -738,8 +738,12 @@ void run_row_ranges(int64_t n_rows, RangeFn fn) {
     return;
   }
   // 16-row-aligned partition so every thread's slab boundary is also a SIMD
-  // block boundary (keeps per-row results independent of the partition)
-  const int64_t chunk = ((n_rows / nt + 15) / 16) * 16 + 16;
+  // block boundary (keeps per-row results independent of the partition);
+  // true 16-aligned ceiling with a floor of one SIMD block, so the
+  // requested thread count is actually delivered (ADVICE r4: the former
+  // "+16" under-spawned and left the last worker systematically short)
+  const int64_t chunk =
+      std::max<int64_t>(16, ((n_rows + nt - 1) / nt + 15) / 16 * 16);
   std::vector<std::thread> workers;
   workers.reserve(nt);
   // An exception here (thread-ctor resource failure, worker bad_alloc)

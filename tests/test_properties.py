@@ -2,9 +2,29 @@
 must hold their invariants for *arbitrary* inputs, not just the fixtures —
 the fuzzing layer the reference's example-based suite lacks."""
 
+import contextlib
+import os
+
 import numpy as np
 import pytest
 from hypothesis import HealthCheck, given, settings, strategies as st
+
+
+@contextlib.contextmanager
+def _env(**kv):
+    """Scoped os.environ override that restores on any exit — hypothesis
+    re-runs example bodies, and monkeypatch is not hypothesis-safe, so env
+    toggles live in an explicit context manager (ADVICE r4)."""
+    prev = {k: os.environ.get(k) for k in kv}
+    try:
+        os.environ.update(kv)
+        yield
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 from isoforest_tpu.io import avro
 from isoforest_tpu.io.persistence import (
@@ -574,8 +594,6 @@ class TestNativeScorerVariantProperties:
     def test_simd_matches_scalar_bitwise(
         self, n_rows, n_trees, h, f, k, seed, extended
     ):
-        import os
-
         from isoforest_tpu import native
 
         if not native.available():
@@ -599,14 +617,18 @@ class TestNativeScorerVariantProperties:
             ).astype(np.int32)
             thr = rng.normal(size=(n_trees, m)).astype(np.float32)
             run = lambda: native.score_standard(feat, thr, ni, X, h)
-        prev = os.environ.get("ISOFOREST_NATIVE_SIMD")
-        try:
-            os.environ["ISOFOREST_NATIVE_SIMD"] = "0"
+        # ISOFOREST_NATIVE_THREADS joins the fuzzed toggles because the
+        # thread partition boundary interacts with the 16-row SIMD blocks —
+        # an explicit setting bypasses the 16k-row auto gate precisely so
+        # tiny fuzz inputs exercise it. The reference run pins BOTH vars so
+        # an ambient shell ISOFOREST_NATIVE_THREADS cannot silently turn
+        # the scalar baseline into a threaded run.
+        with _env(ISOFOREST_NATIVE_SIMD="0", ISOFOREST_NATIVE_THREADS="1"):
             ref = run()
-            os.environ["ISOFOREST_NATIVE_SIMD"] = "1"
+        with _env(ISOFOREST_NATIVE_SIMD="1", ISOFOREST_NATIVE_THREADS="1"):
             assert np.array_equal(ref, run())
-        finally:
-            if prev is None:
-                os.environ.pop("ISOFOREST_NATIVE_SIMD", None)
-            else:
-                os.environ["ISOFOREST_NATIVE_SIMD"] = prev
+        threads = str(2 + seed % 3)
+        with _env(ISOFOREST_NATIVE_SIMD="1", ISOFOREST_NATIVE_THREADS=threads):
+            assert np.array_equal(ref, run())
+        with _env(ISOFOREST_NATIVE_SIMD="0", ISOFOREST_NATIVE_THREADS=threads):
+            assert np.array_equal(ref, run())

@@ -6,10 +6,12 @@ about, complementary to bench.py's bulk-throughput headline. Run with
 ``PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python tools/serving_latency.py``
 in this image (see benchmarks/README.md for the tunnel-wedge context).
 
-Round-4 build host (1 core, avx512f/dq, final kernels): batch 1 p50
-0.57 ms / p99 1.15 ms; batch 64 p50 0.63 ms; batch 1024 p50 0.93 ms;
-batch 8192 p50 2.98 ms — the 16k-row thread gate keeps serving batches
-single-threaded by design.
+Round-5 build host (1 core, avx512f/dq; iters in each JSON row — p99 is a
+real tail statistic now, ADVICE r4): batch 1 p50 0.94 ms / p99 2.45 ms;
+batch 64 p50 0.98 ms; batch 1024 p50 1.49 ms; batch 8192 p50 3.57 ms —
+the 16k-row thread gate keeps serving batches single-threaded by design.
+(Round-4 p50s at 50/10 iters were 0.57/0.63/0.93/2.98 ms; the spread is
+shared-host contention, not a kernel change.)
 """
 
 import json
@@ -27,8 +29,11 @@ def main() -> None:
     for bs in (1, 64, 1024, 8192):
         xb = X[:bs]
         model.score(xb)  # warm: compile/prep caches
+        # enough iterations that p99 is a real tail statistic, not the max
+        # of a tiny sample (ADVICE r4); the sample size ships in the JSON
+        iters = 200 if bs <= 1024 else 100
         times = []
-        for _ in range(50 if bs <= 1024 else 10):
+        for _ in range(iters):
             t0 = time.perf_counter()
             model.score(xb)
             times.append(time.perf_counter() - t0)
@@ -37,8 +42,10 @@ def main() -> None:
                 {
                     "metric": "serving_latency_ms",
                     "batch": bs,
+                    "iters": iters,
                     "p50": round(float(np.percentile(times, 50)) * 1e3, 3),
                     "p99": round(float(np.percentile(times, 99)) * 1e3, 3),
+                    "max": round(float(np.max(times)) * 1e3, 3),
                 }
             ),
             flush=True,

@@ -134,9 +134,7 @@ def main() -> None:
 
     X, _ = kddcup_http_hard(n=args.rows)
 
-    from isoforest_tpu.ops.traversal import score_matrix
-
-    from isoforest_tpu.ops.traversal import default_strategy
+    from isoforest_tpu.ops.traversal import default_strategy, score_matrix
 
     # sections 1-3b (rankings, fit timing, chunk sweep); the fitted forest
     # is also section 6's trace subject, so it is built regardless.
