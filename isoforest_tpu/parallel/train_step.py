@@ -86,8 +86,35 @@ def make_train_step(
         check_vma=False,
     )
 
+    # Tree-block size for the scoring scan: the full vmap materialises
+    # [T, rows_local] walk intermediates — ~25 GB/device at the north-star
+    # shape (10M rows x 1000 trees on 8 devices; measured by XLA's memory
+    # analysis, tools/scaling_curve.py --northstar-dryrun), which would OOM
+    # a 16 GB v5e. Scanning tree blocks bounds the transient at
+    # [block, rows_local] while keeping identical scores up to f32 addition
+    # order. Largest power-of-two divisor of T, capped at 8.
+    score_block = 1
+    while score_block < 8 and num_trees % (score_block * 2) == 0:
+        score_block *= 2
+
     def score_local(forest_rep, x_local):
-        return score_from_path_length(path_lengths(forest_rep, x_local), num_samples)
+        if num_trees <= score_block:
+            return score_from_path_length(
+                path_lengths(forest_rep, x_local), num_samples
+            )
+        n_blocks = num_trees // score_block
+        blocks = jax.tree_util.tree_map(
+            lambda a: a.reshape((n_blocks, score_block) + a.shape[1:]), forest_rep
+        )
+
+        def body(total, block):
+            # scan preserves the forest NamedTuple structure of `blocks`
+            return total + path_lengths(block, x_local) * score_block, None
+
+        total, _ = jax.lax.scan(
+            body, jnp.zeros((x_local.shape[0],), jnp.float32), blocks
+        )
+        return score_from_path_length(total / num_trees, num_samples)
 
     score_sharded = jax.shard_map(
         score_local,
