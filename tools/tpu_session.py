@@ -4,7 +4,7 @@ Chip time in this environment is scarce (the tunnel wedges for hours; see
 benchmarks/tpu_probe_history.log), so when it IS live, this script captures
 every measurement the round needs in one serialized process:
 
-  1. strategy ranking (gather / dense / pallas) on the standard forest,
+  1. strategy ranking (walk / dense / pallas / gather) on the standard forest,
   2. the same for the extended family (sparse-k and full-extension dispatch),
   3. fit-only timing (growth + bagging, separate from scoring),
   4. ``--headline``: the 1M-row bench.py headline (fit+score vs sklearn),
@@ -146,11 +146,14 @@ def main() -> None:
     std = IsolationForest(num_estimators=100, random_seed=1).fit(X)
     winner_strat = default_strategy()
     if not args.skip_rankings:
-        # 1. standard-forest strategy ranking (pallas off-TPU would run in
-        # interpret mode — minutes per rep — so it only joins on the chip)
+        # 1. standard-forest strategy ranking (pallas/walk off-TPU would run
+        # in interpret mode — minutes per rep — so they only join on the
+        # chip). "walk" is the round-5 O(h) dynamic-gather kernel: rank it
+        # FIRST in the session so even a short window captures its
+        # predicted-vs-measured slot (benchmarks/README.md).
         cands = ["gather", "dense"]
         if jax.devices()[0].platform == "tpu":
-            cands.append("pallas")
+            cands = ["walk", "dense", "pallas", "gather"]
         std_rank = strategy_ranking(std, X, "standard", cands)
 
         # 2. extended family, both kernel dispatches
@@ -201,6 +204,39 @@ def main() -> None:
             ),
             flush=True,
         )
+
+        # 3c. serving-batch latency on the LIVE backend (VERDICT r4 item 6:
+        # the only serving numbers so far are CPU-native; the "pallas wins
+        # small batches" claim needs a current on-chip row). p50/p99 per
+        # strategy at deployment batch sizes, warm caches.
+        import numpy as np
+
+        on_tpu = jax.devices()[0].platform == "tpu"
+        serve_cands = ["walk", "pallas", "dense"] if on_tpu else ["dense"]
+        serve_iters = 100 if on_tpu else 5  # off-TPU runs are mechanics tests
+        for bs in (1, 64, 1024, 8192):
+            xb = X[:bs]
+            row = {
+                "metric": "serving_latency_ms",
+                "batch": bs,
+                "backend": jax.devices()[0].platform,
+                "iters": serve_iters,
+            }
+            for strat in serve_cands:
+                try:
+                    score_matrix(std.forest, xb, std.num_samples, strategy=strat)
+                    times = []
+                    for _ in range(serve_iters):
+                        t0 = time.perf_counter()
+                        score_matrix(std.forest, xb, std.num_samples, strategy=strat)
+                        times.append(time.perf_counter() - t0)
+                    row[strat] = {
+                        "p50": round(float(np.percentile(times, 50)) * 1e3, 3),
+                        "p99": round(float(np.percentile(times, 99)) * 1e3, 3),
+                    }
+                except Exception as exc:  # noqa: BLE001 — a failed strategy is data
+                    row[strat] = f"error: {str(exc)[:120]}"
+            print(json.dumps(row), flush=True)
 
     # 4. the bench.py headline (1M rows, sklearn comparison) in-process —
     # bench's own backend probe is skipped; we already brought the chip up
