@@ -6,7 +6,10 @@ every measurement the round needs in one serialized process:
 
   1. strategy ranking (walk / dense / pallas / gather) on the standard forest,
   2. the same for the extended family (sparse-k and full-extension dispatch),
-  3. fit-only timing (growth + bagging, separate from scoring),
+  3. fit-only timing (growth + bagging, separate from scoring), a scoring
+     chunk-size sweep (3b), and a per-strategy serving-batch latency sweep
+     at {1, 64, 1024, 8192} rows (3c — flat rows schema-compatible with
+     ``tools/serving_latency.py``),
   4. ``--headline``: the 1M-row bench.py headline (fit+score vs sklearn),
   5. ``--northstar``: the 10M-row BASELINE.json scale config,
   6. ``--trace DIR``: a ``jax.profiler`` trace of one scoring pass (winning
@@ -117,8 +120,10 @@ def main() -> None:
     ap.add_argument("--trace", metavar="DIR", default=None,
                     help="write a jax.profiler trace of scoring + fit")
     ap.add_argument("--skip-rankings", action="store_true",
-                    help="skip sections 1-3b (strategy rankings, fit timing, "
-                         "chunk sweep) and jump to --headline/--northstar — "
+                    help="skip sections 1-3c (strategy rankings, fit timing, "
+                         "chunk sweep, serving-latency sweep — the round-5 "
+                         "serving rows are NOT collected under this flag) "
+                         "and jump to --headline/--northstar — "
                          "on CPU the dense rankings cost ~2 min each and can "
                          "starve a wall-clock-budgeted session of the "
                          "sections it was launched for (round-4 lesson)")
@@ -215,14 +220,20 @@ def main() -> None:
         serve_cands = ["walk", "pallas", "dense"] if on_tpu else ["dense"]
         serve_iters = 100 if on_tpu else 5  # off-TPU runs are mechanics tests
         for bs in (1, 64, 1024, 8192):
+            if bs > len(X):
+                continue  # never mislabel a truncated batch as the nominal size
             xb = X[:bs]
-            row = {
-                "metric": "serving_latency_ms",
-                "batch": bs,
-                "backend": jax.devices()[0].platform,
-                "iters": serve_iters,
-            }
             for strat in serve_cands:
+                # one FLAT row per (batch, strategy) — the same schema
+                # tools/serving_latency.py emits (plus backend/strategy), so
+                # a consumer keyed on the metric name can diff both sources
+                row = {
+                    "metric": "serving_latency_ms",
+                    "batch": bs,
+                    "backend": jax.devices()[0].platform,
+                    "strategy": strat,
+                    "iters": serve_iters,
+                }
                 try:
                     score_matrix(std.forest, xb, std.num_samples, strategy=strat)
                     times = []
@@ -230,13 +241,12 @@ def main() -> None:
                         t0 = time.perf_counter()
                         score_matrix(std.forest, xb, std.num_samples, strategy=strat)
                         times.append(time.perf_counter() - t0)
-                    row[strat] = {
-                        "p50": round(float(np.percentile(times, 50)) * 1e3, 3),
-                        "p99": round(float(np.percentile(times, 99)) * 1e3, 3),
-                    }
+                    row["p50"] = round(float(np.percentile(times, 50)) * 1e3, 3)
+                    row["p99"] = round(float(np.percentile(times, 99)) * 1e3, 3)
+                    row["max"] = round(float(np.max(times)) * 1e3, 3)
                 except Exception as exc:  # noqa: BLE001 — a failed strategy is data
-                    row[strat] = f"error: {str(exc)[:120]}"
-            print(json.dumps(row), flush=True)
+                    row["error"] = str(exc)[:120]
+                print(json.dumps(row), flush=True)
 
     # 4. the bench.py headline (1M rows, sklearn comparison) in-process —
     # bench's own backend probe is skipped; we already brought the chip up
