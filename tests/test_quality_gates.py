@@ -298,7 +298,7 @@ class TestBandDocSync:
         ).read_text()
         cited = set(
             (float(lo), float(hi))
-            for lo, hi in re.findall(r"\[(0\.\d+),\s*(0\.\d+)\]", doc)
+            for lo, hi in re.findall(r"\[(\d\.\d+),\s*(\d\.\d+|\d\.?\d*)\]", doc)
         )
         assert cited, "QUALITY.md cites no bracketed bands - pattern drift?"
         source = set(BANDS.values())

@@ -283,6 +283,34 @@ class TestQuantizedTieRouting:
         assert abs(got.mean() - base.mean()) < 1e-3
 
 
+class TestWalkWideKFallback:
+    def test_wide_k_routes_to_dense_with_one_warning(self, caplog, monkeypatch):
+        """EIF hyperplanes beyond _WALK_K_MAX coordinates dispatch to dense
+        (the gather+fma chain stops paying) — warned once, never silently
+        mislabeled (same contract as the pallas fence)."""
+        import logging
+
+        import isoforest_tpu.ops.traversal as tv
+        from isoforest_tpu.ops.pallas_walk import _WALK_K_MAX, supports
+
+        rng = np.random.default_rng(2)
+        Xw = rng.normal(size=(1100, _WALK_K_MAX + 4)).astype(np.float32)
+        ext = ExtendedIsolationForest(
+            num_estimators=6, max_samples=64.0, random_seed=1
+        ).fit(Xw)
+        assert ext.forest.indices.shape[2] == _WALK_K_MAX + 4
+        assert not supports(ext.forest)
+        monkeypatch.setattr(tv, "_warned_walk_wide_k", False)
+        with caplog.at_level(logging.WARNING, logger="isoforest_tpu"):
+            got = score_matrix(ext.forest, Xw, ext.num_samples, strategy="walk")
+            again = score_matrix(ext.forest, Xw, ext.num_samples, strategy="walk")
+        base = score_matrix(ext.forest, Xw, ext.num_samples, strategy="dense")
+        np.testing.assert_array_equal(got, base)
+        np.testing.assert_array_equal(again, base)
+        warnings = [r for r in caplog.records if "walk" in r.getMessage()]
+        assert len(warnings) == 1, "wide-k fallback must warn exactly once"
+
+
 class TestPallasExtendedDispatch:
     def test_dense_large_k_path_matches(self, models, monkeypatch):
         # force the large-k dense-table kernel (production trigger is
