@@ -44,12 +44,20 @@ def make_train_step(
     contamination_error: float = 0.0,
     extended: bool = False,
     extension_level: int = 0,
+    score_strategy: str = "auto",
 ):
     """Build a jitted ``(key, X) -> TrainStepResult`` over ``mesh``.
 
     ``num_trees`` and ``num_rows`` must divide the total device count (the
     whole pipeline is shape-fused; pad upstream otherwise — see
     :func:`isoforest_tpu.parallel.sharded._pad_axis`).
+
+    ``score_strategy``: the in-step scoring formulation — ``"auto"``
+    (``ISOFOREST_TPU_STRATEGY`` when it names an eligible formulation,
+    else dense on a TPU mesh, gather elsewhere; resolved at trace time
+    from the MESH's platform), or an explicit ``"gather"``/``"dense"``.
+    Other strategies (native, pallas, walk) are not eligible: the step
+    body must be a single jittable program under ``shard_map``.
 
     Threshold computation (``contamination > 0``): with
     ``contamination_error == 0`` an exact rank pick over the globally sorted
@@ -86,6 +94,37 @@ def make_train_step(
         check_vma=False,
     )
 
+    # In-step scoring strategy, resolved at TRACE time (the choice is a
+    # Python branch, not jit control flow). Only the two fully-jittable
+    # formulations are eligible inside shard_map: the gather pointer walk
+    # (CPU winner) and the dense level-walk (TPU winner — per-lane gathers
+    # serialise on TPU: 15.1 s vs 0.63 s at 1M rows, benchmarks/README.md;
+    # before this resolve the fused TPU train step always scored via
+    # gather, its measured worst strategy).
+    if score_strategy == "auto":
+        # honor the process-wide strategy pin when it names a formulation
+        # eligible inside shard_map (score_matrix's "auto" honors the same
+        # env var; a pinned measurement must not be silently mislabeled)
+        import os
+
+        pinned = os.environ.get("ISOFOREST_TPU_STRATEGY")
+        if pinned in ("gather", "dense"):
+            score_strategy = pinned
+        else:
+            # the mesh's own platform, not jax.devices() — a host-CPU mesh
+            # on a TPU VM must resolve the CPU winner
+            platform = next(iter(mesh.devices.flat)).platform
+            score_strategy = "dense" if platform == "tpu" else "gather"
+    if score_strategy == "dense":
+        from ..ops.dense_traversal import path_lengths_dense as _path_lengths
+    elif score_strategy == "gather":
+        _path_lengths = path_lengths
+    else:
+        raise ValueError(
+            f"score_strategy must be 'auto', 'gather' or 'dense' (jittable "
+            f"inside shard_map), got {score_strategy!r}"
+        )
+
     # Tree-block size for the scoring scan: the full vmap materialises
     # [T, rows_local] walk intermediates — ~25 GB/device at the north-star
     # shape (10M rows x 1000 trees on 8 devices; measured by XLA's memory
@@ -100,7 +139,7 @@ def make_train_step(
     def score_local(forest_rep, x_local):
         if num_trees <= score_block:
             return score_from_path_length(
-                path_lengths(forest_rep, x_local), num_samples
+                _path_lengths(forest_rep, x_local), num_samples
             )
         n_blocks = num_trees // score_block
         blocks = jax.tree_util.tree_map(
@@ -109,7 +148,7 @@ def make_train_step(
 
         def body(total, block):
             # scan preserves the forest NamedTuple structure of `blocks`
-            return total + path_lengths(block, x_local) * score_block, None
+            return total + _path_lengths(block, x_local) * score_block, None
 
         total, _ = jax.lax.scan(
             body, jnp.zeros((x_local.shape[0],), jnp.float32), blocks

@@ -138,6 +138,33 @@ class TestFitViaMesh:
 
 
 class TestFusedTrainStep:
+    def test_score_strategy_dense_matches_gather(self, mesh, data):
+        """The in-step scoring formulation is selectable (dense is the TPU
+        resolve of "auto"); both jittable strategies must agree on the mesh
+        to f32 tolerance, and ineligible strategies are rejected eagerly."""
+        kw = dict(
+            num_rows=len(data),
+            num_features_total=5,
+            num_trees=16,
+            num_samples=64,
+            num_features=5,
+            contamination=0.1,
+        )
+        r_gather = make_train_step(mesh, score_strategy="gather", **kw)(
+            jax.random.PRNGKey(0), data
+        )
+        r_dense = make_train_step(mesh, score_strategy="dense", **kw)(
+            jax.random.PRNGKey(0), data
+        )
+        np.testing.assert_allclose(
+            np.asarray(r_dense.scores), np.asarray(r_gather.scores), atol=3e-6
+        )
+        assert float(r_dense.threshold) == pytest.approx(
+            float(r_gather.threshold), abs=3e-6
+        )
+        with pytest.raises(ValueError, match="score_strategy"):
+            make_train_step(mesh, score_strategy="native", **kw)
+
     def test_runs_and_matches_quantile(self, mesh, data):
         T, S = 16, 64
         step = make_train_step(
