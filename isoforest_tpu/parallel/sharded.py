@@ -23,6 +23,65 @@ from ..utils.math import score_from_path_length
 from .mesh import DATA_AXIS, TREES_AXIS
 
 
+_warned_ineligible_pin = False
+
+
+def resolve_jittable_strategy(mesh, score_strategy: str = "auto"):
+    """Resolve the path-length formulation used INSIDE shard_map programs;
+    returns ``(name, path_lengths_fn)``.
+
+    Only the two fully-jittable formulations are eligible (native/pallas/
+    walk need host prep or pallas_call row padding that the fused programs
+    don't do): the gather pointer walk (CPU winner) and the dense level-walk
+    (TPU winner — per-lane gathers serialise on TPU: 15.1 s vs 0.63 s at 1M
+    rows, benchmarks/README.md). ``"auto"`` honors an eligible
+    ``ISOFOREST_TPU_STRATEGY`` pin — an INELIGIBLE pin is warned about once
+    and ignored, so a pinned measurement is never silently mislabeled —
+    else resolves from the MESH's platform (a host-CPU mesh on a TPU VM
+    keeps the CPU winner). Shared by :func:`sharded_score`,
+    :func:`sharded_score_2d` and
+    :func:`~isoforest_tpu.parallel.train_step.make_train_step`.
+    """
+    import os
+
+    if score_strategy == "auto":
+        pinned = os.environ.get("ISOFOREST_TPU_STRATEGY")
+        if pinned in ("gather", "dense"):
+            score_strategy = pinned
+        else:
+            if pinned:
+                global _warned_ineligible_pin
+                if not _warned_ineligible_pin:
+                    _warned_ineligible_pin = True
+                    from ..utils import logger
+
+                    logger.warning(
+                        "ISOFOREST_TPU_STRATEGY=%r is not eligible inside "
+                        "shard_map programs (gather/dense only); sharded "
+                        "scoring resolves its own per-platform default",
+                        pinned,
+                    )
+            platform = next(iter(mesh.devices.flat)).platform
+            score_strategy = "dense" if platform == "tpu" else "gather"
+    if score_strategy not in ("gather", "dense"):
+        raise ValueError(
+            f"score_strategy must be 'auto', 'gather' or 'dense' (jittable "
+            f"inside shard_map), got {score_strategy!r}"
+        )
+    return score_strategy, _path_lengths_fn(score_strategy)
+
+
+def _path_lengths_fn(score_strategy: str):
+    """Module-internal name -> fn lookup; external callers get the pair from
+    :func:`resolve_jittable_strategy` (the lru_cached program builders below
+    key on the NAME and look the fn up here, keeping cache keys hashable)."""
+    if score_strategy == "dense":
+        from ..ops.dense_traversal import path_lengths_dense
+
+        return path_lengths_dense
+    return path_lengths
+
+
 def _pad_axis(arr, axis: int, multiple: int):
     """Pad ``axis`` up to a multiple by repeating the last slice (padding trees
     are grown redundantly and sliced off; padding rows are scored and dropped)."""
@@ -125,16 +184,19 @@ def _pad_trees_neutral(forest, multiple: int):
 
 
 @functools.lru_cache(maxsize=64)
-def _score_2d_program(mesh, is_standard: bool, num_samples: int, num_trees: int):
+def _score_2d_program(
+    mesh, is_standard: bool, num_samples: int, num_trees: int, strategy: str
+):
     forest_cls = StandardForest if is_standard else ExtendedForest
     n_fields = len(forest_cls._fields)
     forest_spec = forest_cls(*([P(TREES_AXIS)] * n_fields))
+    pl_fn = _path_lengths_fn(strategy)
 
     def score_local(forest_loc, x_local):
-        # path_lengths returns the local-shard MEAN; scale back to a sum so
-        # the psum over tree shards (neutral pads contribute 0) recovers the
-        # global total, then normalise by the TRUE tree count
-        pl_sum = path_lengths(forest_loc, x_local) * forest_loc.num_trees
+        # the path-length fn returns the local-shard MEAN; scale back to a
+        # sum so the psum over tree shards (neutral pads contribute 0)
+        # recovers the global total, then normalise by the TRUE tree count
+        pl_sum = pl_fn(forest_loc, x_local) * forest_loc.num_trees
         total = jax.lax.psum(pl_sum, TREES_AXIS)
         return score_from_path_length(total / num_trees, num_samples)
 
@@ -165,19 +227,25 @@ def sharded_score_2d(mesh, forest, X, num_samples: int) -> np.ndarray:
     n = X.shape[0]
     Xp, _ = _pad_axis(X, 0, mesh.shape[DATA_AXIS])
     forest_p, _ = _pad_trees_neutral(forest, mesh.shape[TREES_AXIS])
+    strategy, _ = resolve_jittable_strategy(mesh)
     f = _score_2d_program(
-        mesh, isinstance(forest, StandardForest), num_samples, forest.num_trees
+        mesh,
+        isinstance(forest, StandardForest),
+        num_samples,
+        forest.num_trees,
+        strategy,
     )
     return np.asarray(f(forest_p, Xp)[:n])
 
 
 @functools.lru_cache(maxsize=64)
-def _score_replicated_program(mesh, is_standard: bool, num_samples: int):
+def _score_replicated_program(mesh, is_standard: bool, num_samples: int, strategy: str):
     forest_cls = StandardForest if is_standard else ExtendedForest
     forest_spec = forest_cls(*([P()] * len(forest_cls._fields)))
+    pl_fn = _path_lengths_fn(strategy)
 
     def score_local(forest_rep, x_local):
-        return score_from_path_length(path_lengths(forest_rep, x_local), num_samples)
+        return score_from_path_length(pl_fn(forest_rep, x_local), num_samples)
 
     return jax.jit(
         jax.shard_map(
@@ -197,7 +265,11 @@ def sharded_score(mesh, forest, X, num_samples: int) -> np.ndarray:
     X = jnp.asarray(X, jnp.float32)
     n = X.shape[0]
     Xp, _ = _pad_axis(X, 0, n_devices)
+    strategy, _ = resolve_jittable_strategy(mesh)
     f = _score_replicated_program(
-        mesh, isinstance(forest, StandardForest), num_samples
+        mesh,
+        isinstance(forest, StandardForest),
+        num_samples,
+        strategy,
     )
     return np.asarray(f(forest, Xp)[:n])

@@ -19,7 +19,6 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.bagging import bagged_indices, feature_subsets, per_tree_keys
 from ..ops.ext_growth import ExtendedForest, grow_extended_forest
-from ..ops.traversal import path_lengths
 from ..ops.tree_growth import StandardForest, grow_forest
 from ..utils.math import height_limit, score_from_path_length
 from .mesh import DATA_AXIS, TREES_AXIS
@@ -95,35 +94,12 @@ def make_train_step(
     )
 
     # In-step scoring strategy, resolved at TRACE time (the choice is a
-    # Python branch, not jit control flow). Only the two fully-jittable
-    # formulations are eligible inside shard_map: the gather pointer walk
-    # (CPU winner) and the dense level-walk (TPU winner — per-lane gathers
-    # serialise on TPU: 15.1 s vs 0.63 s at 1M rows, benchmarks/README.md;
-    # before this resolve the fused TPU train step always scored via
-    # gather, its measured worst strategy).
-    if score_strategy == "auto":
-        # honor the process-wide strategy pin when it names a formulation
-        # eligible inside shard_map (score_matrix's "auto" honors the same
-        # env var; a pinned measurement must not be silently mislabeled)
-        import os
+    # Python branch, not jit control flow) — shared resolver with the
+    # sharded scoring programs; before this resolve the fused TPU train
+    # step always scored via gather, its measured worst TPU strategy.
+    from .sharded import resolve_jittable_strategy
 
-        pinned = os.environ.get("ISOFOREST_TPU_STRATEGY")
-        if pinned in ("gather", "dense"):
-            score_strategy = pinned
-        else:
-            # the mesh's own platform, not jax.devices() — a host-CPU mesh
-            # on a TPU VM must resolve the CPU winner
-            platform = next(iter(mesh.devices.flat)).platform
-            score_strategy = "dense" if platform == "tpu" else "gather"
-    if score_strategy == "dense":
-        from ..ops.dense_traversal import path_lengths_dense as _path_lengths
-    elif score_strategy == "gather":
-        _path_lengths = path_lengths
-    else:
-        raise ValueError(
-            f"score_strategy must be 'auto', 'gather' or 'dense' (jittable "
-            f"inside shard_map), got {score_strategy!r}"
-        )
+    score_strategy, _path_lengths = resolve_jittable_strategy(mesh, score_strategy)
 
     # Tree-block size for the scoring scan: the full vmap materialises
     # [T, rows_local] walk intermediates — ~25 GB/device at the north-star

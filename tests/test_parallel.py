@@ -138,6 +138,23 @@ class TestFitViaMesh:
 
 
 class TestFusedTrainStep:
+    def test_ineligible_strategy_pin_warns_once(self, mesh, monkeypatch, caplog):
+        """An ISOFOREST_TPU_STRATEGY pin that shard_map programs cannot honor
+        (walk/native/pallas) is warned about once and ignored — a pinned
+        measurement must never be silently mislabeled."""
+        import logging
+
+        import isoforest_tpu.parallel.sharded as sh
+
+        monkeypatch.setenv("ISOFOREST_TPU_STRATEGY", "walk")
+        monkeypatch.setattr(sh, "_warned_ineligible_pin", False)
+        with caplog.at_level(logging.WARNING, logger="isoforest_tpu"):
+            name1, fn1 = sh.resolve_jittable_strategy(mesh)
+            name2, _ = sh.resolve_jittable_strategy(mesh)
+        assert name1 == name2 == "gather"  # CPU mesh default
+        warnings = [r for r in caplog.records if "shard_map" in r.getMessage()]
+        assert len(warnings) == 1
+
     def test_score_strategy_dense_matches_gather(self, mesh, data):
         """The in-step scoring formulation is selectable (dense is the TPU
         resolve of "auto"); both jittable strategies must agree on the mesh

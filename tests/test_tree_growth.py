@@ -283,6 +283,64 @@ class TestTraversal:
         np.testing.assert_allclose(got, want, rtol=1e-6)
         # golden numerics: 1 + c(10) = 4.7488806
         assert got[0] == pytest.approx(4.7488806, abs=1e-4)
+        # the O(h) walk kernel must hit the same golden values on the same
+        # hand-built tree — independent of growth AND of the gather path
+        from isoforest_tpu.ops.pallas_walk import path_lengths_walk
+
+        got_walk = np.asarray(path_lengths_walk(forest, X, interpret=True))
+        np.testing.assert_allclose(got_walk, want, rtol=1e-6)
+
+    def test_hand_built_early_leaf_hole_chain_walk(self):
+        """A leaf ABOVE the bottom level: the walk kernel (which cannot stop
+        early — it descends the hole chain under a leaf with +inf
+        thresholds) must still credit exactly the leaf's depth + c(n),
+        proving the hole-table semantics on a hand-built h=2 heap."""
+        from isoforest_tpu.ops.pallas_walk import path_lengths_walk
+
+        # slot 0: split f0 @ 0.5; slot 1: LEAF(n=5) at depth 1 (holes 3,4);
+        # slot 2: split f1 @ 0.0; slots 5,6: leaves n=1, n=7 at depth 2
+        forest = StandardForest(
+            feature=np.array([[0, -1, 1, -1, -1, -1, -1]], np.int32),
+            threshold=np.array([[0.5, 0, 0.0, 0, 0, 0, 0]], np.float32),
+            num_instances=np.array([[-1, 5, -1, -1, -1, 1, 7]], np.int32),
+        )
+        X = np.array(
+            [[0.2, 9.0], [0.9, -1.0], [0.9, 3.0]], np.float32
+        )
+        want = np.array(
+            [
+                1.0 + float(avg_path_length(5)),  # left -> early leaf
+                2.0 + float(avg_path_length(1)),  # right, dot< -> leaf n=1
+                2.0 + float(avg_path_length(7)),  # right, >= -> leaf n=7
+            ]
+        )
+        got_walk = np.asarray(path_lengths_walk(forest, X, interpret=True))
+        np.testing.assert_allclose(got_walk, want, rtol=1e-6)
+        got_gather = np.asarray(standard_path_lengths(forest, X))
+        np.testing.assert_allclose(got_gather, want, rtol=1e-6)
+
+    def test_hand_built_extended_tree_walk(self):
+        """Hand-built EIF tree through the walk kernel: exact hyperplane
+        routing ``dot >= offset -> right`` and leaf credit, analogous to
+        ExtendedIsolationTreeTest's exact path lengths (:32-49)."""
+        from isoforest_tpu.ops.ext_growth import ExtendedForest
+        from isoforest_tpu.ops.pallas_walk import path_lengths_walk
+        from isoforest_tpu.ops.traversal import extended_path_lengths
+
+        forest = ExtendedForest(
+            indices=np.array([[[0, 1], [-1, -1], [-1, -1]]], np.int32),
+            weights=np.array([[[0.6, 0.8], [0, 0], [0, 0]]], np.float32),
+            offset=np.array([[0.1, 0.0, 0.0]], np.float32),
+            num_instances=np.array([[-1, 3, 9]], np.int32),
+        )
+        X = np.array([[0.0, 0.0], [1.0, 1.0]], np.float32)  # dots 0.0, 1.4
+        want = np.array(
+            [1.0 + float(avg_path_length(3)), 1.0 + float(avg_path_length(9))]
+        )
+        got_walk = np.asarray(path_lengths_walk(forest, X, interpret=True))
+        np.testing.assert_allclose(got_walk, want, rtol=1e-6)
+        got_gather = np.asarray(extended_path_lengths(forest, X))
+        np.testing.assert_allclose(got_gather, want, rtol=1e-6)
 
 
 class TestExtendedStructure:
