@@ -284,16 +284,29 @@ def _roofline(strategy: str, n: int, f: int, elapsed_s: float, platform: str) ->
     m = (1 << (h + 1)) - 1
     if strategy == "walk":
         # O(h) dynamic-gather walk (pallas_walk): ~8 vector-element ops per
-        # (row, tree, level); X is re-read once per 8-tree block, node
-        # tables stay VMEM-resident across the row sweep, scores are
-        # read-modify-written once per tree block.
-        from isoforest_tpu.ops.pallas_walk import _SUBLANES, _level_layout
+        # (row, tree, level). Grid is rows-major / trees-minor: X tiles and
+        # the accumulating score block stay VMEM-resident across each tree
+        # sweep (scores hit HBM once per row tile), while the per-step
+        # node tables re-stream — 3 [8, L] tables for the standard forest,
+        # (2 + 2k) L-lane planes (offset, leaf, k idx + k weight) for EIF.
+        from isoforest_tpu.ops.pallas_walk import (
+            _ROW_TILE,
+            _SUBLANES,
+            _level_layout,
+        )
 
         _, _, L = _level_layout(h)
         tree_blocks = -(-t // _SUBLANES)
+        row_tiles = -(-n // _ROW_TILE)
+        # 3 [8, L] tables — the STANDARD forest (the only _roofline caller
+        # is the standard headline); an EIF walk model would need
+        # (2 + 2k) * L lanes per step instead
+        table_lanes = 3 * L
         flops = 8.0 * n * t * (h + 1)
         bytes_moved = (
-            4.0 * n * f * tree_blocks + 8.0 * n * tree_blocks + 12.0 * t * L
+            4.0 * n * f
+            + 4.0 * _SUBLANES * table_lanes * row_tiles * tree_blocks
+            + 4.0 * n
         )
     elif strategy == "dense":
         flops = 2.0 * n * f * m * t + 6.0 * n * m * t

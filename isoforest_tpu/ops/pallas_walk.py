@@ -26,8 +26,12 @@ Layout that makes every lookup single-vreg:
   transposed ``[8, 128]`` X tile (features on sublanes).
 
 Work per (row, tree): ~8 vector-element ops per level, ~70 for the default
-h=8 forest — against the dense walk's ~6,600 — with all tables VMEM-resident
-across the whole row sweep (tree-block grid axis is major, row axis minor).
+h=8 forest — against the dense walk's ~6,600. The grid is rows-major /
+trees-MINOR: each row tile's partial-score block accumulates over
+consecutive grid steps (the revisit pattern the shipped dense-pallas kernel
+already proves on the remote toolchain) while the small ``[8, L]`` node
+tables re-stream per step (~123 KB — ~2 ms of HBM traffic over the 1M-row
+headline) and the X tile stays resident across each tree sweep.
 
 The extended variant replaces the feature lookup with ``k`` sublane-gathers
 and an f32 multiply-add reduction — **no matmul anywhere**, so it runs at
@@ -226,7 +230,7 @@ def _accumulate(tb, out_ref, res):
 
 
 def _standard_walk_kernel(h, fchunks, xt_ref, thr_ref, feat_ref, leaf_ref, out_ref):
-    tb = pl.program_id(0)
+    tb = pl.program_id(1)
     offs, chunks, _ = _level_layout(h)
     x_all = xt_ref[...]  # [fchunks*8, ROW_TILE]
     parts = []
@@ -251,7 +255,7 @@ def _standard_walk_kernel(h, fchunks, xt_ref, thr_ref, feat_ref, leaf_ref, out_r
 def _extended_walk_kernel(
     h, fchunks, k, L, xt_ref, off_ref, idx_ref, w_ref, leaf_ref, out_ref
 ):
-    tb = pl.program_id(0)
+    tb = pl.program_id(1)
     offs, chunks, _ = _level_layout(h)
     x_all = xt_ref[...]
     parts = []
@@ -305,18 +309,25 @@ def _standard_walk(X, thr, feat, leaf, h, f_raw, interpret=False):
     f8 = -(-f_raw // _SUBLANES) * _SUBLANES
     XT = jnp.pad(X, ((0, 0), (0, f8 - f_raw))).T  # [f8, Np]
     t_pad, L = thr.shape
-    grid = (t_pad // _SUBLANES, n_pad // _ROW_TILE)  # rows minor: tables stay resident
-    table = _vmem_spec((_SUBLANES, L), lambda tb, rc: (tb, 0))
+    # Tree blocks MINOR: the out block at (rc) is revisited in CONSECUTIVE
+    # grid steps — the accumulation pattern the shipped dense-pallas kernel
+    # already proves on the remote Mosaic toolchain. The cost is
+    # re-streaming the [8, L] tables per step (~123 KB; ~1.6 GB over the 1M
+    # headline, ~2 ms at HBM rate) while the X tile stays resident across
+    # each row tile's tree sweep — cheap insurance against an unproven
+    # non-consecutive-revisit pattern on chip.
+    grid = (n_pad // _ROW_TILE, t_pad // _SUBLANES)
+    table = _vmem_spec((_SUBLANES, L), lambda rc, tb: (tb, 0))
     out = pl.pallas_call(
         functools.partial(_standard_walk_kernel, h, f8 // _SUBLANES),
         grid=grid,
         in_specs=[
-            _vmem_spec((f8, _ROW_TILE), lambda tb, rc: (0, rc)),
+            _vmem_spec((f8, _ROW_TILE), lambda rc, tb: (0, rc)),
             table,
             table,
             table,
         ],
-        out_specs=_vmem_spec((1, _ROW_TILE), lambda tb, rc: (0, rc)),
+        out_specs=_vmem_spec((1, _ROW_TILE), lambda rc, tb: (0, rc)),
         out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
         interpret=interpret,
     )(XT, thr, feat, leaf)
@@ -329,20 +340,21 @@ def _extended_walk(X, off, idx_packed, w_packed, leaf, h, f_raw, k, interpret=Fa
     f8 = -(-f_raw // _SUBLANES) * _SUBLANES
     XT = jnp.pad(X, ((0, 0), (0, f8 - f_raw))).T
     t_pad, L = off.shape
-    grid = (t_pad // _SUBLANES, n_pad // _ROW_TILE)
-    table = _vmem_spec((_SUBLANES, L), lambda tb, rc: (tb, 0))
-    packed = _vmem_spec((_SUBLANES, k * L), lambda tb, rc: (tb, 0))
+    # trees minor for consecutive out-block accumulation (see _standard_walk)
+    grid = (n_pad // _ROW_TILE, t_pad // _SUBLANES)
+    table = _vmem_spec((_SUBLANES, L), lambda rc, tb: (tb, 0))
+    packed = _vmem_spec((_SUBLANES, k * L), lambda rc, tb: (tb, 0))
     out = pl.pallas_call(
         functools.partial(_extended_walk_kernel, h, f8 // _SUBLANES, k, L),
         grid=grid,
         in_specs=[
-            _vmem_spec((f8, _ROW_TILE), lambda tb, rc: (0, rc)),
+            _vmem_spec((f8, _ROW_TILE), lambda rc, tb: (0, rc)),
             table,
             packed,
             packed,
             table,
         ],
-        out_specs=_vmem_spec((1, _ROW_TILE), lambda tb, rc: (0, rc)),
+        out_specs=_vmem_spec((1, _ROW_TILE), lambda rc, tb: (0, rc)),
         out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
         interpret=interpret,
     )(XT, off, idx_packed, w_packed, leaf)
