@@ -6,7 +6,7 @@
 #
 # `check` = lint + coverage: the coverage gate runs the FULL test suite once
 # under line monitoring and enforces two floors (onnx >= 90%, matching the
-# reference's setup.cfg fail_under=90; rest of the package >= 85%), so a
+# reference's setup.cfg fail_under=90; whole package >= 90% since r5), so a
 # separate `test` pass would run every test twice (ADVICE r2). `test` stays
 # for quick monitoring-free local runs.
 

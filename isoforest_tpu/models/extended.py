@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.bagging import bagged_indices, feature_subsets, per_tree_keys
-from ..ops.ext_growth import ExtendedForest, grow_extended_forest
+from ..ops.ext_growth import ExtendedForest, grow_extended_forest_fused
 from ..utils import (
     ExtendedIsolationForestParams,
     UNKNOWN_TOTAL_NUM_FEATURES,
@@ -73,29 +73,39 @@ class ExtendedIsolationForest(_ParamSetters):
 
         h = height_limit(resolved.num_samples)
         key = jax.random.PRNGKey(np.uint32(p.random_seed & 0xFFFFFFFF))
-        k_bag, k_feat, k_grow = jax.random.split(key, 3)
 
         Xd = jnp.asarray(X, jnp.float32)
-        with phase("extended_isolation_forest.fit.bagging"):
-            bag = bagged_indices(
-                k_bag, total_rows, resolved.num_samples, p.num_estimators, p.bootstrap
-            )
-            fidx = feature_subsets(
-                k_feat, total_feats, resolved.num_features, p.num_estimators
-            )
-        tree_keys = per_tree_keys(k_grow, p.num_estimators)
         with phase("extended_isolation_forest.fit.grow"):
             if mesh is not None:
                 from ..parallel.sharded import sharded_grow_extended_forest
 
+                k_bag, k_feat, k_grow = jax.random.split(key, 3)
+                bag = bagged_indices(
+                    k_bag,
+                    total_rows,
+                    resolved.num_samples,
+                    p.num_estimators,
+                    p.bootstrap,
+                )
+                fidx = feature_subsets(
+                    k_feat, total_feats, resolved.num_features, p.num_estimators
+                )
+                tree_keys = per_tree_keys(k_grow, p.num_estimators)
                 forest = sharded_grow_extended_forest(
                     mesh, tree_keys, Xd, bag, fidx, h, ext_level
                 )
             else:
-                forest = jax.jit(
-                    grow_extended_forest,
-                    static_argnames=("height", "extension_level"),
-                )(tree_keys, Xd, bag, fidx, height=h, extension_level=ext_level)
+                # single fused program — see grow_forest_fused's rationale
+                forest = grow_extended_forest_fused(
+                    key,
+                    Xd,
+                    num_samples=resolved.num_samples,
+                    num_trees=p.num_estimators,
+                    bootstrap=p.bootstrap,
+                    num_features=resolved.num_features,
+                    height=h,
+                    extension_level=ext_level,
+                )
             forest = jax.tree_util.tree_map(jax.block_until_ready, forest)
 
         model = ExtendedIsolationForestModel(

@@ -22,7 +22,7 @@ import numpy as np
 from ..ops.bagging import bagged_indices, feature_subsets, per_tree_keys
 from ..ops.quantile import contamination_threshold, observed_contamination
 from ..ops.traversal import score_matrix
-from ..ops.tree_growth import StandardForest, grow_forest
+from ..ops.tree_growth import StandardForest, grow_forest_fused
 from ..utils import (
     IsolationForestParams,
     UNKNOWN_TOTAL_NUM_FEATURES,
@@ -105,25 +105,38 @@ class IsolationForest(_ParamSetters):
 
         h = height_limit(resolved.num_samples)
         key = jax.random.PRNGKey(np.uint32(p.random_seed & 0xFFFFFFFF))
-        k_bag, k_feat, k_grow = jax.random.split(key, 3)
 
         Xd = jnp.asarray(X, jnp.float32)
-        with phase("isolation_forest.fit.bagging"):
-            bag = bagged_indices(
-                k_bag, total_rows, resolved.num_samples, p.num_estimators, p.bootstrap
-            )
-            fidx = feature_subsets(
-                k_feat, total_feats, resolved.num_features, p.num_estimators
-            )
-        tree_keys = per_tree_keys(k_grow, p.num_estimators)
         with phase("isolation_forest.fit.grow"):
             if mesh is not None:
                 from ..parallel.sharded import sharded_grow_forest
 
+                k_bag, k_feat, k_grow = jax.random.split(key, 3)
+                bag = bagged_indices(
+                    k_bag,
+                    total_rows,
+                    resolved.num_samples,
+                    p.num_estimators,
+                    p.bootstrap,
+                )
+                fidx = feature_subsets(
+                    k_feat, total_feats, resolved.num_features, p.num_estimators
+                )
+                tree_keys = per_tree_keys(k_grow, p.num_estimators)
                 forest = sharded_grow_forest(mesh, tree_keys, Xd, bag, fidx, h)
             else:
-                forest = jax.jit(grow_forest, static_argnames=("height",))(
-                    tree_keys, Xd, bag, fidx, height=h
+                # single fused program — one device dispatch instead of ~4
+                # (bagging/subsets/keys/growth); key-split order inside is
+                # identical, so the forest is stream-identical to the
+                # sharded path's
+                forest = grow_forest_fused(
+                    key,
+                    Xd,
+                    num_samples=resolved.num_samples,
+                    num_trees=p.num_estimators,
+                    bootstrap=p.bootstrap,
+                    num_features=resolved.num_features,
+                    height=h,
                 )
             forest = jax.tree_util.tree_map(jax.block_until_ready, forest)
 

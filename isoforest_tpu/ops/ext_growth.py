@@ -24,6 +24,7 @@ float-cast dot (ExtendedUtils.scala:46-55).
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -218,3 +219,37 @@ def grow_extended_forest(
         offset=offset,
         num_instances=num_instances,
     )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_samples",
+        "num_trees",
+        "bootstrap",
+        "num_features",
+        "height",
+        "extension_level",
+    ),
+)
+def grow_extended_forest_fused(
+    key: jax.Array,
+    X: jax.Array,
+    *,
+    num_samples: int,
+    num_trees: int,
+    bootstrap: bool,
+    num_features: int,
+    height: int,
+    extension_level: int,
+) -> ExtendedForest:
+    """Single-jit EIF fit program — same dispatch-fusion rationale and
+    key-split order as :func:`..tree_growth.grow_forest_fused`."""
+    from .bagging import bagged_indices, feature_subsets, per_tree_keys
+
+    num_rows, num_features_total = X.shape
+    k_bag, k_feat, k_grow = jax.random.split(key, 3)
+    bag = bagged_indices(k_bag, num_rows, num_samples, num_trees, bootstrap)
+    fidx = feature_subsets(k_feat, num_features_total, num_features, num_trees)
+    tree_keys = per_tree_keys(k_grow, num_trees)
+    return grow_extended_forest(tree_keys, X, bag, fidx, height, extension_level)

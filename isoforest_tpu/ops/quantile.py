@@ -45,7 +45,7 @@ def histogram_quantile(
     lo: float | None = None,
     hi: float | None = None,
     eps: float = 1e-3,
-    max_passes: int = 12,
+    max_passes: int = 24,
 ) -> float:
     """Iteratively-refined histogram quantile returning an **actual element**.
 
@@ -59,7 +59,11 @@ def histogram_quantile(
     is within the rank budget (so even a range inflated by a lone extreme
     outlier — heavy-tailed score columns are the norm in anomaly detection —
     converges; each pass shrinks the bin ``num_bins``-fold) or the bin is below
-    float32 resolution (tie-heavy data; rank error 0). The final answer snaps
+    float32 resolution (tie-heavy data; rank error 0). ``max_passes=24``
+    covers the FULL f32 dynamic range (~84 decades at ~4 decades/pass;
+    fuzz-caught r5: 12 passes exhausted on a {~-1e-29, 0, ~1e21} column
+    one pass short of separating the near-zero tie class, returning an
+    element 2 ranks off). The final answer snaps
     to the smallest score ≥ the bin's lower edge, so the returned value is
     always an element of the input. This is the eager/host-driven variant
     (Python loop, host scalars) — it cannot run under jit/shard_map; use
@@ -96,15 +100,28 @@ def histogram_quantile(
         counts = all_counts[1 : num_bins + 1]
         cum = all_counts[0] + np.cumsum(counts)
         idx = min(int(np.searchsorted(cum, target)), num_bins - 1)
-        # the top bin's right edge is exactly hi: recomputing it as
-        # lo + width re-rounds in float and can EXCLUDE the true maximum
-        # (e.g. hi=1 with lo=-2^53 gives lo + width == 0) — fuzz-caught
-        new_hi = hi if idx == num_bins - 1 else lo + (idx + 1) * width / num_bins
-        lo, hi = lo + idx * width / num_bins, new_hi
-        # Adaptive stop: once the target bin holds <= eps*N elements every
-        # element in it satisfies the rank budget; the float-resolution check
-        # stops tie-heavy bins that can never thin out (rank error 0 there).
-        if counts[idx] <= rank_budget or (hi - lo) <= _f32_resolution(lo, hi):
+        # Conservative ONE-BIN widening around the target bin (fuzz-caught
+        # r5): the f32 bin assignment can place a score one bin away from
+        # where the recomputed (higher-precision) edges say it belongs — a
+        # zero was binned into a window whose edges evaluated to
+        # [27.9, 72984), and the next pass narrowed to an empty range that
+        # excluded the true median entirely. Refining to bins
+        # [idx-1, idx+1] keeps every possibly-misplaced element inside the
+        # range; the shrink per pass is still num_bins/3.
+        lo_i = max(idx - 1, 0)
+        hi_i = min(idx + 1, num_bins - 1)
+        # the bottom/top bins keep the exact lo/hi: recomputing them as
+        # lo + k*width/num_bins re-rounds in float and can EXCLUDE the true
+        # extremes (e.g. hi=1 with lo=-2^53 gives lo + width == 0) — fuzz-caught
+        new_lo = lo if lo_i == 0 else lo + lo_i * width / num_bins
+        new_hi = hi if hi_i == num_bins - 1 else lo + (hi_i + 1) * width / num_bins
+        window = int(cum[hi_i] - (cum[lo_i - 1] if lo_i > 0 else all_counts[0]))
+        lo, hi = new_lo, new_hi
+        # Adaptive stop: once the refined window holds <= eps*N elements
+        # every element in it satisfies the rank budget; the
+        # float-resolution check stops tie-heavy bins that can never thin
+        # out (rank error 0 there).
+        if window <= rank_budget or (hi - lo) <= _f32_resolution(lo, hi):
             break
     # Snap to an actual element: smallest score >= the refined lower edge.
     return float(jnp.min(jnp.where(scores >= lo, scores, jnp.inf)))
@@ -115,7 +132,7 @@ def histogram_quantile_jit(
     q: float,
     num_bins: int = 8192,
     eps: float = 1e-3,
-    max_passes: int = 12,
+    max_passes: int = 24,
     lo=None,
     hi=None,
 ):
@@ -167,19 +184,21 @@ def histogram_quantile_jit(
         counts = jnp.zeros((num_bins + 2,), jnp.int32).at[bins + 1].add(1)
         cum = counts[0] + jnp.cumsum(counts[1 : num_bins + 1])
         idx = jnp.clip(jnp.searchsorted(cum, target), 0, num_bins - 1)
-        idx_f = idx.astype(jnp.float32)
-        # top bin keeps its exact right edge (see the eager variant)
+        # conservative one-bin widening + exact bottom/top edges — same
+        # f32-misplacement reasoning as the eager variant (fuzz-caught r5)
+        lo_i = jnp.maximum(idx - 1, 0)
+        hi_i = jnp.minimum(idx + 1, num_bins - 1)
+        new_lo = jnp.where(
+            lo_i == 0, lo_c, lo_c + lo_i.astype(jnp.float32) * width / num_bins
+        )
         new_hi = jnp.where(
-            idx == num_bins - 1,
+            hi_i == num_bins - 1,
             hi_c,
-            lo_c + (idx_f + 1.0) * width / num_bins,
+            lo_c + (hi_i + 1).astype(jnp.float32) * width / num_bins,
         )
-        return (
-            lo_c + idx_f * width / num_bins,
-            new_hi,
-            counts[idx + 1],
-            passes + 1,
-        )
+        below = jnp.where(lo_i > 0, cum[jnp.maximum(lo_i - 1, 0)], counts[0])
+        window = cum[hi_i] - below
+        return (new_lo, new_hi, window, passes + 1)
 
     lo_f, _, _, _ = lax.while_loop(
         cond, body, (lo0, hi0, jnp.int32(n), jnp.int32(0))

@@ -31,6 +31,7 @@ than being impossible — same convention the extended forest already uses
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -214,3 +215,34 @@ def grow_forest(
         threshold=threshold,
         num_instances=num_instances,
     )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_samples", "num_trees", "bootstrap", "num_features", "height"),
+)
+def grow_forest_fused(
+    key: jax.Array,
+    X: jax.Array,
+    *,
+    num_samples: int,
+    num_trees: int,
+    bootstrap: bool,
+    num_features: int,
+    height: int,
+) -> StandardForest:
+    """Whole single-device fit program under ONE jit: key split -> bagging ->
+    feature subsets -> per-tree keys -> growth. The estimator's unfused path
+    issued ~4 separate device programs; on the TPU tunnel each dispatch is a
+    network round trip and the round-2 profiler trace showed fit is
+    dispatch-bound, not compute-bound (fit_s 0.467 at 1M rows with trivial
+    growth compute). Key-split order matches the unfused estimator path
+    exactly, so the grown forest is stream-identical."""
+    from .bagging import bagged_indices, feature_subsets, per_tree_keys
+
+    num_rows, num_features_total = X.shape
+    k_bag, k_feat, k_grow = jax.random.split(key, 3)
+    bag = bagged_indices(k_bag, num_rows, num_samples, num_trees, bootstrap)
+    fidx = feature_subsets(k_feat, num_features_total, num_features, num_trees)
+    tree_keys = per_tree_keys(k_grow, num_trees)
+    return grow_forest(tree_keys, X, bag, fidx, height)

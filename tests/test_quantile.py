@@ -175,3 +175,27 @@ class TestContaminationThreshold:
         ).fit(X)
         labels = m.transform(X)["predictedLabel"]
         assert labels.mean() == pytest.approx(0.1, abs=0.02)
+
+
+class TestRankErrorBranches:
+    def test_non_member_threshold_rejected(self):
+        with pytest.raises(ValueError, match="not an element"):
+            quantile_rank_error(np.array([1.0, 2.0, 3.0]), 2.5, 0.5)
+
+    def test_rank_interval_distances(self):
+        s = np.array([1.0, 2.0, 2.0, 3.0, 4.0], np.float32)
+        # element 4.0 occupies rank interval [5, 5]; target for q=0.2 is 1
+        assert quantile_rank_error(s, 4.0, 0.2) == 4  # target below interval
+        # element 1.0 occupies [1, 1]; target for q=1.0 is 5
+        assert quantile_rank_error(s, 1.0, 1.0) == 4  # target above interval
+        # tie interval covers the target exactly
+        assert quantile_rank_error(s, 2.0, 0.5) == 0
+
+    def test_contamination_threshold_engages_sketch_above_limit(self):
+        rng = np.random.default_rng(4)
+        s = rng.random(512).astype(np.float32)
+        thr = contamination_threshold(
+            s, contamination=0.1, contamination_error=0.01, exact_size_limit=100
+        )
+        assert thr in s
+        assert quantile_rank_error(s, float(thr), 0.9) <= max(int(0.01 * 512), 1)

@@ -3,12 +3,13 @@ of the reference's ``coverage fail_under = 90`` on its converter module
 (``/root/reference/isolation-forest-onnx/setup.cfg`` [coverage:report]; its
 CI runs pytest under coverage and fails the build below the bar).
 
-Two floors (VERDICT r2 item 7): the ONNX subpackage keeps the reference's
-own 90% bar; the rest of the package — where this framework's risk mass
-actually lives (``ops``/``io``/``models``/``utils``/``parallel``) — gates at
-85%. The whole test suite runs ONCE under monitoring, so ``make check``
-needs no separate ``test`` pass (the round-2 Makefile ran the ONNX files
-twice; ADVICE r2).
+Two floors, both at 90% since round 5 (VERDICT r4 item 7): the ONNX
+subpackage keeps the reference's own 90% bar, and the rest of the package —
+where this framework's risk mass actually lives
+(``ops``/``io``/``models``/``utils``/``parallel``) — now gates at the same
+90% (measured 91%+ with the known subprocess-undercount included). The whole test suite runs exactly once (as batches, below), so
+``make check`` needs no separate ``test`` pass (the round-2 Makefile ran
+the ONNX files twice; ADVICE r2).
 
 The image ships no ``coverage``/``pytest-cov`` and installs are forbidden,
 so this uses :mod:`sys.monitoring` (PEP 669, py3.12+) with a
@@ -16,14 +17,20 @@ so this uses :mod:`sys.monitoring` (PEP 669, py3.12+) with a
 then measures them against the executable-line set derived from each
 module's AST.
 
-Lines that only execute in SUBPROCESSES the suite spawns (the Mosaic AOT
-worker, the 2-process Gloo test, CLI subprocess tests) are invisible to
-in-process monitoring; the floors below are calibrated with that known
+The suite runs as PER-TEST-FILE batches in subprocess workers whose hit
+sets the parent merges (round 5): a single monitored process running the
+whole grown suite segfaulted XLA:CPU's compiler non-deterministically three
+times in a row (in ``backend_compile`` / cache reads, at different tests,
+with 125 GB free — an upstream fragility this tool cannot fix), and
+batching both isolates such a crash to one retryable batch and caps
+per-process state. Lines that only execute in SUBPROCESSES the suite spawns
+(the Mosaic AOT worker, the 2-process Gloo test, CLI subprocess tests) are
+invisible to monitoring; the floors below are calibrated with that known
 undercount included.
 
 Run via ``make coverage`` (or directly)::
 
-    python tools/coverage_gate.py [--fail-under-core 85] [--fail-under-onnx 90]
+    python tools/coverage_gate.py [--fail-under-core 90] [--fail-under-onnx 90]
 
 Exit 0 at/above both bars, 1 below either (per-file table printed always).
 """
@@ -38,7 +45,6 @@ import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 PKG = ROOT / "isoforest_tpu"
-TESTS = ["tests/"]
 
 
 def _executable_lines(path: pathlib.Path) -> set:
@@ -64,8 +70,64 @@ def _executable_lines(path: pathlib.Path) -> set:
     return lines
 
 
-def _run_tests_with_monitoring(watched: dict) -> int:
-    """Run pytest over TESTS recording executed lines for files in
+def _run_batches(watched: dict) -> int:
+    """Run the suite as per-test-file subprocess batches, merging each
+    worker's executed-line sets into ``watched``. A batch that dies on a
+    signal (the non-deterministic XLA:CPU compile segfault) is retried
+    once; a second death fails the gate loudly. Returns 0 when every batch's
+    pytest run passed."""
+    import json
+    import subprocess
+    import tempfile
+
+    # rglob over BOTH of pytest's default python_files patterns so test
+    # files later added in subdirectories or named *_test.py still run; a
+    # mismatch between this discovery and pytest's is a silently-shrinking
+    # suite. Batches stay SEQUENTIAL on purpose: parallel workers would
+    # race reads/writes on the shared persistent compile cache — the exact
+    # corruption class that segfaulted the gate this round — and the warm-
+    # cache wall time (~6 min) doesn't justify that risk.
+    test_files = sorted(
+        set((ROOT / "tests").rglob("test_*.py"))
+        | set((ROOT / "tests").rglob("*_test.py"))
+    )
+    for tf in test_files:
+        for attempt in (1, 2):
+            with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as out:
+                out_path = out.name
+            proc = subprocess.run(
+                [sys.executable, __file__, "--worker", out_path, str(tf)],
+            )
+            if proc.returncode in (0, 5):  # 5: batch collected no tests
+                # (a file whose tests are env-gated out — e.g. the CI-only
+                # converter-interop gate — is an empty batch, not a failure)
+                with open(out_path) as fh:
+                    hits = json.load(fh)
+                os.unlink(out_path)
+                for fname, lines in hits.items():
+                    if fname in watched:
+                        watched[fname].update(lines)
+                break
+            os.unlink(out_path)
+            if proc.returncode > 0:  # real pytest failure: do not retry
+                print(
+                    f"coverage gate: tests failed in {tf.name} "
+                    f"(rc={proc.returncode})",
+                    file=sys.stderr,
+                )
+                return proc.returncode
+            print(
+                f"coverage gate: batch {tf.name} died on signal "
+                f"{-proc.returncode} (attempt {attempt})",
+                file=sys.stderr,
+            )
+            if attempt == 2:
+                return 1
+    return 0
+
+
+def _run_tests_with_monitoring(watched: dict, tests: list) -> int:
+    """Run pytest over ``tests`` recording executed lines for files in
     ``watched`` ({abspath: set}); returns the pytest exit code."""
     import pytest
 
@@ -87,7 +149,7 @@ def _run_tests_with_monitoring(watched: dict) -> int:
         mon.register_callback(tool, mon.events.LINE, on_line)
         mon.set_events(tool, mon.events.LINE)
         try:
-            rc = pytest.main(["-q", "--no-header", *TESTS])
+            rc = pytest.main(["-q", "--no-header", *tests])
         finally:
             mon.set_events(tool, 0)
             mon.free_tool_id(tool)
@@ -103,9 +165,22 @@ def _run_tests_with_monitoring(watched: dict) -> int:
 
     sys.settrace(tracer)
     try:
-        rc = pytest.main(["-q", "--no-header", *TESTS])
+        rc = pytest.main(["-q", "--no-header", *tests])
     finally:
         sys.settrace(None)
+    return rc
+
+
+def _worker(out_path: str, tests: list) -> int:
+    """Batch worker: run the given tests under monitoring and dump the
+    executed-line sets as JSON {abspath: [lines]}. Exit = pytest rc."""
+    import json
+
+    files = sorted(PKG.rglob("*.py"))
+    watched = {str(p.resolve()): set() for p in files}
+    rc = _run_tests_with_monitoring(watched, tests)
+    with open(out_path, "w") as fh:
+        json.dump({k: sorted(v) for k, v in watched.items() if v}, fh)
     return rc
 
 
@@ -135,6 +210,13 @@ def _gate(name: str, rows: list, fail_under: float) -> bool:
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
+        "--worker",
+        nargs="+",
+        metavar=("OUT_JSON", "TEST"),
+        help="internal: run the given test files under line monitoring and "
+        "dump hit sets to OUT_JSON",
+    )
+    ap.add_argument(
         "--fail-under-onnx",
         type=float,
         default=90.0,
@@ -143,8 +225,9 @@ def main() -> int:
     ap.add_argument(
         "--fail-under-core",
         type=float,
-        default=85.0,
-        help="floor for the rest of the package (VERDICT r2 item 7)",
+        default=90.0,
+        help="floor for the rest of the package (raised from 85 in round 5, "
+        "VERDICT r4 item 7)",
     )
     args = ap.parse_args()
 
@@ -156,11 +239,14 @@ def main() -> int:
     if "host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
+    if args.worker:
+        return _worker(args.worker[0], args.worker[1:])
+
     files = sorted(PKG.rglob("*.py"))
     watched = {str(p.resolve()): set() for p in files}
-    rc = _run_tests_with_monitoring(watched)
+    rc = _run_batches(watched)
     if rc != 0:
-        print(f"coverage gate: tests failed (pytest rc={rc})", file=sys.stderr)
+        print(f"coverage gate: tests failed (rc={rc})", file=sys.stderr)
         return 1
 
     onnx_rows, core_rows = [], []
