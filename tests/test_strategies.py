@@ -283,6 +283,21 @@ class TestQuantizedTieRouting:
         assert abs(got.mean() - base.mean()) < 1e-3
 
 
+class TestWalkDeepHeap:
+    def test_h12_chunked_levels_match_gather(self):
+        """max_samples=4096 -> h=12: the bottom level spans 32 x 128-lane
+        chunks, driving the chunk-select path of every per-level lookup
+        (the default-config tests never leave single-chunk levels). Also
+        machine-compiled through the chipless Mosaic AOT pipeline r5."""
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(8192, 5)).astype(np.float32)
+        m = IsolationForest(num_estimators=2, max_samples=4096.0, random_seed=1).fit(X)
+        assert m.forest.max_nodes == 8191
+        base = score_matrix(m.forest, X[:2048], m.num_samples, strategy="gather")
+        got = score_matrix(m.forest, X[:2048], m.num_samples, strategy="walk")
+        np.testing.assert_allclose(got, base, atol=3e-6)
+
+
 class TestWalkWideKFallback:
     def test_wide_k_routes_to_dense_with_one_warning(self, caplog, monkeypatch):
         """EIF hyperplanes beyond _WALK_K_MAX coordinates dispatch to dense
