@@ -1,0 +1,40 @@
+// ASan fuzz of the native snappy + Avro decoders on random/mutated bytes.
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <random>
+#include <vector>
+
+extern "C" int64_t if_snappy_uncompressed_len(const uint8_t*, int64_t);
+extern "C" int64_t if_snappy_decompress(const uint8_t*, int64_t, uint8_t*, int64_t);
+extern "C" int64_t if_decode_standard(const uint8_t*, int64_t, int64_t, int32_t*,
+                                      int32_t*, int32_t*, int32_t*, int32_t*,
+                                      double*, int64_t*);
+extern "C" int64_t if_decode_extended(const uint8_t*, int64_t, int64_t, int32_t*,
+                                      int32_t*, int32_t*, int32_t*, double*,
+                                      int64_t*, int32_t*, int32_t*, float*, int64_t);
+
+int main() {
+  std::mt19937 rng(11);
+  for (int it = 0; it < 20000; ++it) {
+    int64_t len = 1 + rng() % 512;
+    std::vector<uint8_t> buf(len);
+    for (auto& b : buf) b = uint8_t(rng());
+    std::vector<uint8_t> out(1024);
+    if_snappy_uncompressed_len(buf.data(), len);
+    if_snappy_decompress(buf.data(), len, out.data(), out.size());
+    int64_t count = 1 + rng() % 64;
+    std::vector<int32_t> a(count), b_(count), c(count), d(count), e(count), hl(count);
+    std::vector<double> sv(count), off(count);
+    std::vector<int64_t> ni(count);
+    std::vector<int32_t> fi(256);
+    std::vector<float> fw(256);
+    if_decode_standard(buf.data(), len, count, a.data(), b_.data(), c.data(),
+                       d.data(), e.data(), sv.data(), ni.data());
+    if_decode_extended(buf.data(), len, count, a.data(), b_.data(), c.data(),
+                       d.data(), off.data(), ni.data(), hl.data(), fi.data(),
+                       fw.data(), 256);
+  }
+  fprintf(stderr, "IO FUZZ ALL OK\n");
+  return 0;
+}

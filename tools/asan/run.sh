@@ -1,0 +1,21 @@
+#!/bin/sh
+# AddressSanitizer fuzz of the native C++ layer (r5). Build+run both
+# harnesses; requires g++ with libasan (baked into this image).
+#   scorer_fuzz: 300 random forest/shape combos x {scalar, AVX-512} x
+#                {1, 3, 5 threads} through both scoring kernels — the
+#                paths the hypothesis bitwise-contract fuzz drives from
+#                Python, here under full ASan instrumentation.
+#   io_fuzz:     20k random byte buffers through the snappy decompressor
+#                and both Avro record decoders (hostile-input sweep).
+# Both were clean on 2026-07-30 (used to rule the native layer out as the
+# source of the XLA:CPU compile segfaults — README "Known environment
+# issue").
+set -e
+cd "$(dirname "$0")/../.."
+g++ -O1 -g -fsanitize=address -ffp-contract=off -pthread -std=c++17 \
+    tools/asan/scorer_fuzz.cpp isoforest_tpu/native/scorer.cpp -o /tmp/if_asan_scorer
+g++ -O1 -g -fsanitize=address -std=c++17 \
+    tools/asan/io_fuzz.cpp isoforest_tpu/native/isoforest_io.cpp -o /tmp/if_asan_io
+ASAN_OPTIONS=detect_leaks=0 /tmp/if_asan_scorer
+ASAN_OPTIONS=detect_leaks=0 /tmp/if_asan_io
+echo "asan fuzz: all clean"
