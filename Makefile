@@ -28,13 +28,16 @@ lint:
 # never did across repeated full passes; the native scorer is ASan-clean,
 # and cache on/off + codegen-split made no difference). Same total suite,
 # fail-fast per file, robust to the environment.
+# Discovery matches tools/coverage_gate.py (and pytest's own defaults):
+# recursive over tests/, BOTH test_*.py and *_test.py — a top-level-only
+# glob silently skipped files later added in subdirectories.
 test:
-	@set -e; found=0; for f in tests/test_*.py; do \
-		[ -e "$$f" ] || continue; found=1; \
+	@set -e; files=$$(find tests -name 'test_*.py' -o -name '*_test.py' | sort -u); \
+	[ -n "$$files" ] || { echo "make test: no test files under tests/" >&2; exit 1; }; \
+	for f in $$files; do \
 		echo "== $$f"; \
 		$(PY) -m pytest -x -q "$$f" || { rc=$$?; [ $$rc -eq 5 ] || exit $$rc; }; \
-	done; \
-	[ "$$found" = 1 ] || { echo "make test: no tests/test_*.py found" >&2; exit 1; }
+	done
 
 bench:
 	$(PY) bench.py

@@ -75,7 +75,7 @@ def _time_strategies(model, sl: np.ndarray) -> dict:
     return timings
 
 
-def _pick_strategy(model, X: np.ndarray) -> str:
+def _pick_strategy(model, X: np.ndarray) -> tuple:
     """Auto-tune the traversal strategy on the live backend: time each
     candidate on a slice and pin the winner via ISOFOREST_TPU_STRATEGY.
 
@@ -94,19 +94,19 @@ def _pick_strategy(model, X: np.ndarray) -> str:
     if not timings:
         print("[bench] all strategies failed to time; defaulting to gather", file=sys.stderr)
         os.environ["ISOFOREST_TPU_STRATEGY"] = "gather"
-        return "gather"
+        return "gather", {}
     best = min(timings, key=timings.get)
     print(f"[bench] traversal strategy timings {timings} -> {best}", file=sys.stderr)
     os.environ["ISOFOREST_TPU_STRATEGY"] = best
-    return best
+    return best, timings
 
 
 def bench_ours(
     X: np.ndarray, strategy: str | None = None
-) -> tuple[float, float, float, np.ndarray, str]:
-    """Returns (total_s, fit_s, score_s, scores, strategy). Pass ``strategy``
-    to pin a pre-measured winner (tools/tpu_session.py ranks strategies
-    itself and must not burn chip time re-ranking here)."""
+) -> tuple[float, float, float, np.ndarray, str, dict]:
+    """Returns (total_s, fit_s, score_s, scores, strategy, strategy_timings).
+    Pass ``strategy`` to pin a pre-measured winner (tools/tpu_session.py
+    ranks strategies itself and must not burn chip time re-ranking here)."""
     import os
 
     from isoforest_tpu import IsolationForest
@@ -118,8 +118,9 @@ def bench_ours(
     # measures steady-state execution, not XLA compilation; auto-tune the
     # scoring strategy for this backend along the way
     model = est.fit(X)
+    timings: dict = {}
     if strategy is None:
-        strategy = _pick_strategy(model, X)
+        strategy, timings = _pick_strategy(model, X)
     else:
         os.environ["ISOFOREST_TPU_STRATEGY"] = strategy
     model.score(X)
@@ -134,7 +135,7 @@ def bench_ours(
         scores = model.score(X)
         total_s = time.perf_counter() - start
         if best is None or total_s < best[0]:
-            best = (total_s, fit_s, total_s - fit_s, scores, strategy)
+            best = (total_s, fit_s, total_s - fit_s, scores, strategy, timings)
     return best
 
 
@@ -316,14 +317,34 @@ def _roofline(strategy: str, n: int, f: int, elapsed_s: float, platform: str) ->
 
         flops = 2.0 * n * f * m * t + 6.0 * n * m * t
         blocks = max(1, -(-n // _ROW_BLOCK))  # kernel pads rows up to a block
-        bytes_moved = 4.0 * n * f + 12.0 * t * m * blocks + 4.0 * n
-    else:  # gather / native pointer walks
+        # finalized layout: 2 tables/tree (feature i32 + merged value f32)
+        # instead of the pre-layout feature/threshold/leaf triple
+        bytes_moved = 4.0 * n * f + 8.0 * t * m * blocks + 4.0 * n
+    else:  # gather / native packed-record walks (ops/scoring_layout.py)
+        # 8 B/node record (merged value f32 + feature i32; the leaf LUT is
+        # folded into value, so no third array and no end-of-walk gather),
+        # tree-tiled: node tables stay cache-resident across a row tile
+        # (native: 768 KB L2 tiles with rows inner; gather: the tree-block
+        # scan reuses each block's tables across the whole row chunk), so
+        # HBM traffic is X once per tree tile + tables once per row tile +
+        # scores — not the pre-layout per-step worst case (12 B * h per
+        # row-tree, the 6.4 GB BENCH_r05 number this layout existed to cut).
+        rec_bytes = 8.0
+        table_bytes = rec_bytes * t * m
+        tile_bytes = 768.0 * 1024.0  # scorer.cpp TILE_BYTES
+        n_tree_tiles = max(1.0, np.ceil(table_bytes / tile_bytes))
+        row_tile = 16.0 * 1024.0  # rows per table-resident pass
         flops = 4.0 * n * t * h
-        bytes_moved = 8.0 * n * t * h + 4.0 * n * f
+        bytes_moved = (
+            n_tree_tiles * 4.0 * n * f
+            + table_bytes * np.ceil(n / row_tile)
+            + 4.0 * n
+        )
     flops_growth = 2.0 * t * s * f * h
     out = {
         "scoring_gflops": round(flops / 1e9, 1),
         "scoring_gbytes": round(bytes_moved / 1e9, 3),
+        "bytes_per_row": round(bytes_moved / n, 1),
         "growth_gflops": round(flops_growth / 1e9, 3),
     }
     peaks = _PEAKS.get(platform)
@@ -342,7 +363,7 @@ def main() -> None:
     backend = _ensure_live_backend()
     platform = backend if backend != "cpu_fallback" else "cpu"
     X, y = make_data()
-    ours_s, fit_s, score_s, ours_scores, strategy = bench_ours(X)
+    ours_s, fit_s, score_s, ours_scores, strategy, strategy_timings = bench_ours(X)
     ours_rps = NUM_ROWS / ours_s
     ours_auroc = auroc(ours_scores, y)
     roof = _roofline(strategy, NUM_ROWS, NUM_FEATURES, score_s, platform)
@@ -377,6 +398,11 @@ def main() -> None:
                 "score_s": round(score_s, 3),
                 "mfu": roof["mfu"],
                 "bw_util": roof["bw_util"],
+                "scoring_gbytes": roof["scoring_gbytes"],
+                "bytes_per_row": roof["bytes_per_row"],
+                "strategy_timings_s": {
+                    k: round(v, 4) for k, v in strategy_timings.items()
+                },
             }
         )
     )

@@ -7,7 +7,7 @@ level-synchronous tree growth, batched gather traversal, and tree/row
 sharding over a `jax.sharding.Mesh`.
 """
 
-__version__ = "0.5.0"
+__version__ = "0.6.0"
 
 from . import ops, parallel, utils  # noqa: F401
 from .models import (

@@ -116,6 +116,10 @@ class ExtendedIsolationForest(_ParamSetters):
             extension_level=ext_level,
             total_num_features=total_feats,
         )
+        # finalize the packed scoring layout (offset + leaf LUT merged into
+        # the value plane, hyperplanes inlined in the record) before the
+        # threshold pass — same contract as the standard estimator
+        model.finalize_scoring()
         _compute_and_set_threshold(model, Xd, mesh=mesh)
         return model
 
@@ -136,8 +140,10 @@ class ExtendedIsolationForest(_ParamSetters):
 
 class ExtendedIsolationForestModel(IsolationForestModel):
     """Fitted EIF model. Scoring dispatches on the forest type (hyperplane
-    traversal, ExtendedIsolationForestModel.scala:98-135); only persistence
-    and the recorded ``extension_level`` differ from the base model."""
+    traversal, ExtendedIsolationForestModel.scala:98-135) and consumes the
+    inherited finalized scoring layout (:meth:`finalize_scoring` packs the
+    ``1 + 2k``-float hyperplane records); only persistence and the recorded
+    ``extension_level`` differ from the base model."""
 
     def __init__(
         self,

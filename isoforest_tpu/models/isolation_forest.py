@@ -147,6 +147,9 @@ class IsolationForest(_ParamSetters):
             num_features=resolved.num_features,
             total_num_features=total_feats,
         )
+        # finalize the packed scoring layout eagerly: the contamination
+        # threshold pass below (and every later score) consumes it
+        model.finalize_scoring()
         _compute_and_set_threshold(model, Xd, mesh=mesh)
         return model
 
@@ -221,6 +224,11 @@ class IsolationForestModel:
         self.total_num_features = int(total_num_features)
         self.outlier_score_threshold = float(outlier_score_threshold)
         self.uid = uid or _new_uid("isolation-forest")
+        # packed scoring layout (ops.scoring_layout): built eagerly by
+        # fit()/finalize_scoring(), lazily on first score for persisted
+        # models — the on-disk format stays the reference Avro node arrays
+        # and the layout is rebuilt from them after load
+        self._scoring_layout = None
 
     def set_outlier_score_threshold(self, value: float) -> "IsolationForestModel":
         """Manually override the threshold (IsolationForestModel.scala:86-95)."""
@@ -233,6 +241,23 @@ class IsolationForestModel:
 
     # ------------------------------------------------------------------ #
 
+    def finalize_scoring(self) -> "IsolationForestModel":
+        """Build the finalized scoring layout (packed node records + leaf
+        path-length LUT, :mod:`~isoforest_tpu.ops.scoring_layout`) once for
+        this forest. ``fit`` calls this; loaded models hit it lazily on the
+        first :meth:`score` — persistence round-trips through the reference
+        Avro node arrays unchanged and rebuilds the layout here. Returns
+        self."""
+        from ..ops.scoring_layout import get_layout
+
+        width = (
+            self.total_num_features
+            if self.total_num_features != UNKNOWN_TOTAL_NUM_FEATURES
+            else None
+        )
+        self._scoring_layout = get_layout(self.forest, num_features=width)
+        return self
+
     def score(self, X, mesh=None) -> np.ndarray:
         """Outlier scores ``2^(-E[h(x)]/c(n))`` for an ``[N, F]`` matrix."""
         X = np.asarray(X, np.float32)
@@ -241,7 +266,11 @@ class IsolationForestModel:
             from ..parallel.sharded import sharded_score
 
             return sharded_score(mesh, self.forest, X, self.num_samples)
-        return score_matrix(self.forest, X, self.num_samples)
+        if self._scoring_layout is None:
+            self.finalize_scoring()
+        return score_matrix(
+            self.forest, X, self.num_samples, layout=self._scoring_layout
+        )
 
     def warmup(
         self,

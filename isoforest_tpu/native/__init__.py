@@ -104,11 +104,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     i32 = ctypes.c_int32
     lib.if_score_standard.restype = None
     lib.if_score_standard.argtypes = [
-        f32p, i64, i32, i32p, f32p, f32p, i64, i64, i32, f32p,
+        f32p, i64, i32, i32p, f32p, i64, i64, i32, f32p,
     ]
     lib.if_score_extended.restype = None
     lib.if_score_extended.argtypes = [
-        f32p, i64, i32, i32p, f32p, f32p, f32p, i64, i64, i32, i32, f32p,
+        f32p, i64, i32, i32p, f32p, f32p, i64, i64, i32, i32, f32p,
     ]
     lib.if_encode_standard.restype = i64
     lib.if_encode_standard.argtypes = [
@@ -274,31 +274,45 @@ def _cached(arrays: tuple, build):
     return prep
 
 
+def _merged_value(is_internal, internal_value, num_instances, height: int):
+    """Host-side merged value plane of the finalized scoring layout
+    (ops/scoring_layout.py): threshold/offset at internal slots, the leaf
+    LUT ``depth + c(numInstances)`` at leaves, 0 at holes."""
+    from ..utils.math import leaf_value_table
+
+    return np.where(
+        is_internal,
+        np.asarray(internal_value, np.float32),
+        leaf_value_table(num_instances, height),
+    ).astype(np.float32)
+
+
 def score_standard(feature, threshold, num_instances, X, height: int):
     """Mean path length f32[N] via the native walker; None if unavailable.
 
-    Arrays follow ops/tree_growth.StandardForest layout ([T, M] i32/f32/i32).
+    Arrays follow ops/tree_growth.StandardForest layout ([T, M] i32/f32/i32);
+    the prep merges threshold + leaf LUT into the single value plane the
+    packed C++ walker consumes.
     """
     lib = get_library()
     if lib is None:
         return None
-    from ..utils.math import leaf_value_table
-
     X = np.ascontiguousarray(X, np.float32)
-    feature, threshold, leaf_value = _cached(
+    feature, value = _cached(
         (feature, threshold, num_instances),
         lambda: (
             np.ascontiguousarray(feature, np.int32),
-            np.ascontiguousarray(threshold, np.float32),
-            leaf_value_table(num_instances, height),
+            _merged_value(
+                np.asarray(feature) >= 0, threshold, num_instances, height
+            ),
         ),
     )
     n, f = X.shape
     t, m = feature.shape
     out = np.empty(n, np.float32)
     lib.if_score_standard(
-        _f32ptr(X), n, f, _i32ptr(feature), _f32ptr(threshold),
-        _f32ptr(leaf_value), t, m, height, _f32ptr(out),
+        _f32ptr(X), n, f, _i32ptr(feature), _f32ptr(value),
+        t, m, height, _f32ptr(out),
     )
     return out
 
@@ -308,24 +322,23 @@ def score_extended(indices, weights, offset, num_instances, X, height: int):
     lib = get_library()
     if lib is None:
         return None
-    from ..utils.math import leaf_value_table
-
     X = np.ascontiguousarray(X, np.float32)
-    indices, weights, offset, leaf_value = _cached(
+    indices, weights, value = _cached(
         (indices, weights, offset, num_instances),
         lambda: (
             np.ascontiguousarray(indices, np.int32),
             np.ascontiguousarray(weights, np.float32),
-            np.ascontiguousarray(offset, np.float32),
-            leaf_value_table(num_instances, height),
+            _merged_value(
+                np.asarray(indices)[..., 0] >= 0, offset, num_instances, height
+            ),
         ),
     )
     n, f = X.shape
     t, m, k = indices.shape
     out = np.empty(n, np.float32)
     lib.if_score_extended(
-        _f32ptr(X), n, f, _i32ptr(indices), _f32ptr(weights), _f32ptr(offset),
-        _f32ptr(leaf_value), t, m, k, height, _f32ptr(out),
+        _f32ptr(X), n, f, _i32ptr(indices), _f32ptr(weights), _f32ptr(value),
+        t, m, k, height, _f32ptr(out),
     )
     return out
 

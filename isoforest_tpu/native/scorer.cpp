@@ -3,11 +3,14 @@
 // The TPU path scores via XLA/Pallas dense level-walks; on CPU the XLA
 // lowering of either formulation is gather- or bandwidth-bound and loses to
 // hand-scheduled C++ (round-1 bench: 6.3 s to score 1M rows x 100 trees).
-// This kernel walks the same implicit-heap struct-of-arrays forest
-// (ops/tree_growth.py StandardForest / ops/ext_growth.py ExtendedForest,
-// reference semantics IsolationTree.scala:213-229: feature < threshold ->
-// left, >= -> right; leaf adds avgPathLength(numInstances)) with the
-// per-slot leaf value (depth + c(n)) precomputed host-side.
+// This kernel walks the implicit-heap forest in the finalized scoring
+// layout (ops/scoring_layout.py; reference semantics
+// IsolationTree.scala:213-229: feature < threshold -> left, >= -> right;
+// leaf adds avgPathLength(numInstances)): one merged value[T, M] plane
+// holds the split threshold at internal slots and the precomputed leaf LUT
+// (depth + c(n)) at leaves, so the walk's compare and the exit-leaf credit
+// read the same 8-byte-per-node table pair (feature + value) — a third
+// less L2 tree-tile footprint than the pre-layout 12-byte triple.
 //
 // Three levels of parallelism, all outside the floating-point semantics:
 //   1. Chain interleaving — the scalar walk runs TREE_BLOCK independent
@@ -65,10 +68,9 @@ inline int64_t tile_trees(int64_t bytes_per_tree) {
 
 void score_standard_rows_scalar(const float* X, int64_t r0, int64_t r1,
                                 int32_t n_features, const int32_t* feature,
-                                const float* threshold,
-                                const float* leaf_value, int64_t n_trees,
+                                const float* value, int64_t n_trees,
                                 int64_t m_nodes, int32_t height, float* out) {
-  const int64_t tile = tile_trees(m_nodes * 12);  // feat+thr+leaf per node
+  const int64_t tile = tile_trees(m_nodes * 8);  // feat+value per node
   std::vector<double> acc_buf;
   double* acc = nullptr;
   if (n_trees > tile) {
@@ -90,12 +92,12 @@ void score_standard_rows_scalar(const float* X, int64_t r0, int64_t r1,
             const int32_t f = feature[base + n];
             const bool internal = f >= 0;
             const float xv = x[internal ? f : 0];
-            const int32_t nxt = 2 * n + 1 + (xv >= threshold[base + n] ? 1 : 0);
+            const int32_t nxt = 2 * n + 1 + (xv >= value[base + n] ? 1 : 0);
             nd[j] = internal ? nxt : n;
           }
         }
         for (int j = 0; j < TREE_BLOCK; ++j)
-          total += leaf_value[(t0 + j) * m_nodes + nd[j]];
+          total += value[(t0 + j) * m_nodes + nd[j]];
       }
       for (; t0 < g1; ++t0) {
         const int64_t base = t0 * m_nodes;
@@ -103,9 +105,9 @@ void score_standard_rows_scalar(const float* X, int64_t r0, int64_t r1,
         for (int32_t s = 0; s < height; ++s) {
           const int32_t f = feature[base + n];
           if (f < 0) break;
-          n = 2 * n + 1 + (x[f] >= threshold[base + n] ? 1 : 0);
+          n = 2 * n + 1 + (x[f] >= value[base + n] ? 1 : 0);
         }
-        total += leaf_value[base + n];
+        total += value[base + n];
       }
       if (acc) {
         acc[r - r0] += total;
@@ -122,11 +124,10 @@ void score_standard_rows_scalar(const float* X, int64_t r0, int64_t r1,
 
 void score_extended_rows_scalar(const float* X, int64_t r0, int64_t r1,
                                 int32_t n_features, const int32_t* indices,
-                                const float* weights, const float* offset,
-                                const float* leaf_value, int64_t n_trees,
-                                int64_t m_nodes, int32_t k, int32_t height,
-                                float* out) {
-  const int64_t tile = tile_trees(m_nodes * (8 * (int64_t)k + 8));
+                                const float* weights, const float* value,
+                                int64_t n_trees, int64_t m_nodes, int32_t k,
+                                int32_t height, float* out) {
+  const int64_t tile = tile_trees(m_nodes * (8 * (int64_t)k + 4));
   std::vector<double> acc_buf;
   double* acc = nullptr;
   if (n_trees > tile) {
@@ -152,12 +153,12 @@ void score_extended_rows_scalar(const float* X, int64_t r0, int64_t r1,
               const int32_t f = indices[sub + q];
               dot += x[f >= 0 ? f : 0] * weights[sub + q];
             }
-            const int32_t nxt = 2 * n + 1 + (dot >= offset[base + n] ? 1 : 0);
+            const int32_t nxt = 2 * n + 1 + (dot >= value[base + n] ? 1 : 0);
             nd[j] = internal ? nxt : n;
           }
         }
         for (int j = 0; j < TREE_BLOCK; ++j)
-          total += leaf_value[(t0 + j) * m_nodes + nd[j]];
+          total += value[(t0 + j) * m_nodes + nd[j]];
       }
       for (; t0 < g1; ++t0) {
         const int64_t base = t0 * m_nodes;
@@ -170,9 +171,9 @@ void score_extended_rows_scalar(const float* X, int64_t r0, int64_t r1,
             const int32_t f = indices[sub + q];
             dot += x[f >= 0 ? f : 0] * weights[sub + q];
           }
-          n = 2 * n + 1 + (dot >= offset[base + n] ? 1 : 0);
+          n = 2 * n + 1 + (dot >= value[base + n] ? 1 : 0);
         }
-        total += leaf_value[base + n];
+        total += value[base + n];
       }
       if (acc) {
         acc[r - r0] += total;
@@ -412,9 +413,9 @@ step_extended(__m512i nd, const int32_t* idxb, const float* wb,
 
 __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
     const float* X, int64_t r0, int64_t r1, int32_t n_features,
-    const int32_t* feature, const float* threshold, const float* leaf_value,
-    int64_t n_trees, int64_t m_nodes, int32_t height, float* out) {
-  const int64_t tile = tile_trees(m_nodes * 12);
+    const int32_t* feature, const float* value, int64_t n_trees,
+    int64_t m_nodes, int32_t height, float* out) {
+  const int64_t tile = tile_trees(m_nodes * 8);
   const __m512i zero = _mm512_setzero_si512();
   // per-lane row offsets into the 16-row slab (lane j -> row r + j)
   alignas(64) int32_t roff_arr[LANES];
@@ -449,7 +450,7 @@ __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
           nd[u] = zero;
           if (perm)
             tab[u] = load_table32(feature + (t + u) * m_nodes,
-                                  threshold + (t + u) * m_nodes);
+                                  value + (t + u) * m_nodes);
         }
         for (int32_t s = 0; s < perm; ++s)
           for (int u = 0; u < TREE_IL; ++u)
@@ -459,7 +460,7 @@ __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
         if (perm == PERM_LEVELS && height > PERM_LEVELS && m_nodes >= 63) {
           for (int u = 0; u < TREE_IL; ++u)
             tab[u] = load_table32(feature + (t + u) * m_nodes + 31,
-                                  threshold + (t + u) * m_nodes + 31);
+                                  value + (t + u) * m_nodes + 31);
           for (int u = 0; u < TREE_IL; ++u)
             nd[u] = step_standard_perm_l5(nd[u], tab[u], xt, use_xt, Xb, vroff);
           deep = perm + 1;
@@ -469,7 +470,7 @@ __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
             for (int u = 0; u < TREE_IL; ++u) {
               const NodeTable64 l6 =
                   load_table64(feature + (t + u) * m_nodes + 63,
-                               threshold + (t + u) * m_nodes + 63);
+                               value + (t + u) * m_nodes + 63);
               nd[u] = step_standard_perm_l6(nd[u], l6, xt, use_xt, Xb, vroff);
             }
             deep += 1;
@@ -479,21 +480,21 @@ __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
           for (int u = 0; u < TREE_IL; ++u)
             nd[u] = use_xt
                         ? step_standard_xt(nd[u], feature + (t + u) * m_nodes,
-                                           threshold + (t + u) * m_nodes, xt,
+                                           value + (t + u) * m_nodes, xt,
                                            vroff)
                         : step_standard(nd[u], feature + (t + u) * m_nodes,
-                                        threshold + (t + u) * m_nodes, Xb,
+                                        value + (t + u) * m_nodes, Xb,
                                         vroff);
         for (int u = 0; u < TREE_IL; ++u)
           acc_leaf_f64(
-              _mm512_i32gather_ps(nd[u], leaf_value + (t + u) * m_nodes, 4),
+              _mm512_i32gather_ps(nd[u], value + (t + u) * m_nodes, 4),
               tot_lo, tot_hi);
       }
       for (; t < g1; ++t) {  // remainder trees, one at a time
         __m512i nd = zero;
         if (perm) {
           const NodeTable32 tab =
-              load_table32(feature + t * m_nodes, threshold + t * m_nodes);
+              load_table32(feature + t * m_nodes, value + t * m_nodes);
           for (int32_t s = 0; s < perm; ++s)
             nd = use_xt ? step_standard_perm_xt(nd, tab, xt, vroff)
                         : step_standard_perm(nd, tab, Xb, vroff);
@@ -501,22 +502,22 @@ __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
         int32_t deep = perm;
         if (perm == PERM_LEVELS && height > PERM_LEVELS && m_nodes >= 63) {
           const NodeTable32 l5 = load_table32(feature + t * m_nodes + 31,
-                                              threshold + t * m_nodes + 31);
+                                              value + t * m_nodes + 31);
           nd = step_standard_perm_l5(nd, l5, xt, use_xt, Xb, vroff);
           deep = perm + 1;
           if (height > deep && m_nodes >= 127) {
             const NodeTable64 l6 = load_table64(feature + t * m_nodes + 63,
-                                                threshold + t * m_nodes + 63);
+                                                value + t * m_nodes + 63);
             nd = step_standard_perm_l6(nd, l6, xt, use_xt, Xb, vroff);
             deep += 1;
           }
         }
         for (int32_t s = deep; s < height; ++s)
           nd = use_xt ? step_standard_xt(nd, feature + t * m_nodes,
-                                         threshold + t * m_nodes, xt, vroff)
+                                         value + t * m_nodes, xt, vroff)
                       : step_standard(nd, feature + t * m_nodes,
-                                      threshold + t * m_nodes, Xb, vroff);
-        acc_leaf_f64(_mm512_i32gather_ps(nd, leaf_value + t * m_nodes, 4),
+                                      value + t * m_nodes, Xb, vroff);
+        acc_leaf_f64(_mm512_i32gather_ps(nd, value + t * m_nodes, 4),
                      tot_lo, tot_hi);
       }
       acc_lo = _mm512_add_pd(acc_lo, tot_lo);
@@ -527,8 +528,8 @@ __attribute__((target("avx512f,avx512dq"))) void score_standard_rows_avx512(
     _mm256_storeu_ps(out + r + 8, _mm512_cvtpd_ps(_mm512_div_pd(acc_hi, vn)));
   }
   if (r < r1)
-    score_standard_rows_scalar(X, r, r1, n_features, feature, threshold,
-                               leaf_value, n_trees, m_nodes, height, out);
+    score_standard_rows_scalar(X, r, r1, n_features, feature, value,
+                               n_trees, m_nodes, height, out);
 }
 
 // k <= 4 EIF fast path for the first 4 heap levels (extensionLevel 1-3,
@@ -605,10 +606,9 @@ step_extended_perm(__m512i nd, const ExtTableK4& tab, const float* Xb,
 
 __attribute__((target("avx512f,avx512dq"))) void score_extended_rows_avx512(
     const float* X, int64_t r0, int64_t r1, int32_t n_features,
-    const int32_t* indices, const float* weights, const float* offset,
-    const float* leaf_value, int64_t n_trees, int64_t m_nodes, int32_t k,
-    int32_t height, float* out) {
-  const int64_t tile = tile_trees(m_nodes * (8 * (int64_t)k + 8));
+    const int32_t* indices, const float* weights, const float* value,
+    int64_t n_trees, int64_t m_nodes, int32_t k, int32_t height, float* out) {
+  const int64_t tile = tile_trees(m_nodes * (8 * (int64_t)k + 4));
   const __m512i zero = _mm512_setzero_si512();
   const __m512i vk = _mm512_set1_epi32(k);
   alignas(64) int32_t roff_arr[LANES];
@@ -642,7 +642,7 @@ __attribute__((target("avx512f,avx512dq"))) void score_extended_rows_avx512(
           for (int u = 0; u < 2; ++u)
             tab[u] = load_ext_table(indices + (t + u) * m_nodes * k,
                                     weights + (t + u) * m_nodes * k,
-                                    offset + (t + u) * m_nodes);
+                                    value + (t + u) * m_nodes);
           for (int32_t s = 0; s < perm; ++s)
             for (int u = 0; u < 2; ++u)
               nd[u] = step_extended_perm(nd[u], tab[u], Xb, vroff, vk, k,
@@ -652,11 +652,11 @@ __attribute__((target("avx512f,avx512dq"))) void score_extended_rows_avx512(
           for (int u = 0; u < 2; ++u)
             nd[u] = step_extended(nd[u], indices + (t + u) * m_nodes * k,
                                   weights + (t + u) * m_nodes * k,
-                                  offset + (t + u) * m_nodes, Xb, vroff, vk, k,
+                                  value + (t + u) * m_nodes, Xb, vroff, vk, k,
                                   use_xt, xt);
         for (int u = 0; u < 2; ++u)
           acc_leaf_f64(
-              _mm512_i32gather_ps(nd[u], leaf_value + (t + u) * m_nodes, 4),
+              _mm512_i32gather_ps(nd[u], value + (t + u) * m_nodes, 4),
               tot_lo, tot_hi);
       }
       for (; t < g1; ++t) {
@@ -664,15 +664,15 @@ __attribute__((target("avx512f,avx512dq"))) void score_extended_rows_avx512(
         if (perm) {
           const ExtTableK4 tab =
               load_ext_table(indices + t * m_nodes * k,
-                             weights + t * m_nodes * k, offset + t * m_nodes);
+                             weights + t * m_nodes * k, value + t * m_nodes);
           for (int32_t s = 0; s < perm; ++s)
             nd = step_extended_perm(nd, tab, Xb, vroff, vk, k, use_xt, xt);
         }
         for (int32_t s = perm; s < height; ++s)
           nd = step_extended(nd, indices + t * m_nodes * k,
-                             weights + t * m_nodes * k, offset + t * m_nodes,
+                             weights + t * m_nodes * k, value + t * m_nodes,
                              Xb, vroff, vk, k, use_xt, xt);
-        acc_leaf_f64(_mm512_i32gather_ps(nd, leaf_value + t * m_nodes, 4),
+        acc_leaf_f64(_mm512_i32gather_ps(nd, value + t * m_nodes, 4),
                      tot_lo, tot_hi);
       }
       acc_lo = _mm512_add_pd(acc_lo, tot_lo);
@@ -683,8 +683,8 @@ __attribute__((target("avx512f,avx512dq"))) void score_extended_rows_avx512(
     _mm256_storeu_ps(out + r + 8, _mm512_cvtpd_ps(_mm512_div_pd(acc_hi, vn)));
   }
   if (r < r1)
-    score_extended_rows_scalar(X, r, r1, n_features, indices, weights, offset,
-                               leaf_value, n_trees, m_nodes, k, height, out);
+    score_extended_rows_scalar(X, r, r1, n_features, indices, weights, value,
+                               n_trees, m_nodes, k, height, out);
 }
 #endif  // IF_X86
 
@@ -774,51 +774,54 @@ void run_row_ranges(int64_t n_rows, RangeFn fn) {
 
 extern "C" {
 
-// Mean path length per row over a standard forest.
+// Mean path length per row over a standard forest, in the finalized
+// scoring layout (ops/scoring_layout.py):
 //   X[n_rows, n_features] f32 row-major; feature[T, M] i32 (-1 leaf);
-//   threshold[T, M] f32; leaf_value[T, M] f32 (depth + c(numInstances) at
-//   leaves, 0 elsewhere); out[n_rows] f32.
+//   value[T, M] f32 merged plane — split threshold at internal slots, leaf
+//   LUT (depth + c(numInstances)) at leaves, 0 at holes. One 8-byte node
+//   record instead of the pre-layout 12: the walk's compare and the exit
+//   leaf credit read the SAME table, shrinking the L2 tree-tile footprint
+//   by a third; out[n_rows] f32.
 void if_score_standard(const float* X, int64_t n_rows, int32_t n_features,
-                       const int32_t* feature, const float* threshold,
-                       const float* leaf_value, int64_t n_trees,
-                       int64_t m_nodes, int32_t height, float* out) {
+                       const int32_t* feature, const float* value,
+                       int64_t n_trees, int64_t m_nodes, int32_t height,
+                       float* out) {
   const bool simd = use_simd();
   run_row_ranges(n_rows, [=](int64_t r0, int64_t r1) {
 #if IF_X86
     if (simd) {
-      score_standard_rows_avx512(X, r0, r1, n_features, feature, threshold,
-                                 leaf_value, n_trees, m_nodes, height, out);
+      score_standard_rows_avx512(X, r0, r1, n_features, feature, value,
+                                 n_trees, m_nodes, height, out);
       return;
     }
 #endif
     (void)simd;
-    score_standard_rows_scalar(X, r0, r1, n_features, feature, threshold,
-                               leaf_value, n_trees, m_nodes, height, out);
+    score_standard_rows_scalar(X, r0, r1, n_features, feature, value,
+                               n_trees, m_nodes, height, out);
   });
 }
 
 // Extended (hyperplane) variant. indices[T, M, k] i32 (-1 padding; node is a
 // leaf iff indices[t, m, 0] < 0); weights[T, M, k] f32 (0 at padding, so the
 // unmasked dot matches the XLA gather path bit-for-bit in structure);
-// offset[T, M] f32.
+// value[T, M] f32 merged plane (hyperplane offset | leaf LUT | 0), same
+// layout contract as if_score_standard.
 void if_score_extended(const float* X, int64_t n_rows, int32_t n_features,
                        const int32_t* indices, const float* weights,
-                       const float* offset, const float* leaf_value,
-                       int64_t n_trees, int64_t m_nodes, int32_t k,
-                       int32_t height, float* out) {
+                       const float* value, int64_t n_trees, int64_t m_nodes,
+                       int32_t k, int32_t height, float* out) {
   const bool simd = use_simd();
   run_row_ranges(n_rows, [=](int64_t r0, int64_t r1) {
 #if IF_X86
     if (simd) {
       score_extended_rows_avx512(X, r0, r1, n_features, indices, weights,
-                                 offset, leaf_value, n_trees, m_nodes, k,
-                                 height, out);
+                                 value, n_trees, m_nodes, k, height, out);
       return;
     }
 #endif
     (void)simd;
-    score_extended_rows_scalar(X, r0, r1, n_features, indices, weights, offset,
-                               leaf_value, n_trees, m_nodes, k, height, out);
+    score_extended_rows_scalar(X, r0, r1, n_features, indices, weights, value,
+                               n_trees, m_nodes, k, height, out);
   });
 }
 

@@ -11,6 +11,12 @@ from .quantile import (
     histogram_quantile,
     observed_contamination,
 )
+from .scoring_layout import (
+    PackedExtendedLayout,
+    PackedStandardLayout,
+    get_layout,
+    pack_forest,
+)
 from .traversal import (
     extended_path_lengths,
     path_lengths,
@@ -32,6 +38,10 @@ __all__ = [
     "exact_quantile",
     "histogram_quantile",
     "observed_contamination",
+    "PackedExtendedLayout",
+    "PackedStandardLayout",
+    "get_layout",
+    "pack_forest",
     "extended_path_lengths",
     "path_lengths",
     "score_matrix",

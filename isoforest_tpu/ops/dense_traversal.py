@@ -4,11 +4,18 @@ The pointer-walk formulation of :mod:`.traversal` performs ``height`` rounds
 of data-dependent gathers per (row, tree). TPUs have no fast per-lane vector
 gather (dynamic indexing in the hardware is slice-granular), so that lowering
 serialises; CPUs fare little better on scattered access. This module
-restructures scoring as pure dense algebra over the implicit heap:
+restructures scoring as pure dense algebra over the implicit heap, consuming
+the finalized scoring layout of :mod:`.scoring_layout` — the merged
+``value`` plane (threshold at internal slots, leaf path-length LUT at
+leaves) and the width-narrowed ``feature`` table (i8/i16 when the feature
+count permits), which halves-or-better the node-table bytes each level walk
+streams:
 
   1. **Node comparisons without gathers**: the go-right bit of node ``n`` for
-     row ``c`` is ``B[c, n] = x[c, feat[n]] >= thr[n]``. Two formulations,
-     dispatched on feature count (crossover measured on a live v5e chip,
+     row ``c`` is ``B[c, n] = x[c, feat[n]] >= value[n]`` (value IS the
+     threshold wherever the bit can matter — leaf/hole bits are masked by
+     the reachability recurrence). Two formulations, dispatched on feature
+     count (crossover measured on a live v5e chip,
      ``tools/dense_experiments.py``):
 
      * ``F <= _SELECT_MAX_FEATURES``: per-level *select* — ``F`` masked
@@ -22,7 +29,7 @@ restructures scoring as pure dense algebra over the implicit heap:
        exact walk — so the full-precision contraction is mandatory, not a
        nicety (0.20 s vs the select loop's 1.20 s at F=274).
 
-     For the extended forest the per-node test is ``dot(x, w_n) >= offset_n``
+     For the extended forest the per-node test is ``dot(x, w_n) >= value_n``
      — a *real* matmul per heap level (``X @ W_l^T``, HIGHEST) that lands on
      the MXU (BASELINE.json north star: "hyperplane splits lower directly to
      XLA matmul").
@@ -30,15 +37,17 @@ restructures scoring as pure dense algebra over the implicit heap:
      reaches ``i`` and its bit matches. Expanding level ``l`` to ``l+1`` is a
      mask-and-interleave of the ``[C, 2^l]`` reach matrix — stack + reshape,
      no indexing at all.
-  3. **Path length**: sum over levels of ``reach * leaf * (l + c(n))`` — a
-     masked elementwise reduction (kept off the MXU so leaf values never
-     round through bf16).
+  3. **Path length**: sum over levels of ``reach * (value at non-internal
+     slots)`` — leaf slots hold exactly ``l + c(n)`` in the merged plane and
+     holes hold 0, so no separate leaf table exists anywhere (kept off the
+     MXU so leaf values never round through bf16).
 
 Work per tree is ``O(C * M)`` dense ops versus ``O(C * h)`` gathers — a
 ~57x op-count increase (M=511, h=8) that is nonetheless far faster on vector
 hardware because every op is a fused, full-width VPU/MXU instruction. Trees
-are processed under ``lax.scan`` (constant memory in T), rows chunked by the
-caller.
+are processed in blocks of :data:`_TREE_BLOCK` under ``lax.scan`` (row-tile
+x tree-tile schedule: one block's node tables stay live across the caller's
+whole row chunk), rows chunked by the caller.
 """
 
 from __future__ import annotations
@@ -47,8 +56,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..utils.math import avg_path_length, height_of as _height_of
+from ..utils.math import height_of as _height_of
 from .ext_growth import ExtendedForest
+from .scoring_layout import pack_forest
 from .tree_growth import StandardForest
 
 # Feature-count crossover between the fused per-level select formulation and
@@ -59,33 +69,34 @@ from .tree_growth import StandardForest
 # 1.20 s vs matmul 0.20 s — the flip sits between 8 and 16.
 _SELECT_MAX_FEATURES = 12
 
-# Multi-tree blocking of the tree scan (VERDICT r2 item 1): each lax.scan
-# step is an XLA While iteration whose per-step dispatch and [C, width] walk
-# intermediates are paid per tree; ``unroll=G`` processes G trees per
-# iteration so XLA fuses across tree bodies and the row chunk stays live.
-# ``None`` means the measured default; tools/unroll_sweep.py overrides the
-# module global. Measured on a live v5e (2026-07-29, 524k rows x 100
-# trees): G=1 0.532s; G in {2..100} 0.55-0.61s — unrolling is a wash-to-
-# loss on every platform, so the per-step dispatch is NOT the dense
-# bottleneck (the [C, width] walk intermediates are; benchmarks/README.md
-# round-3 section). Default therefore 1 everywhere, with no device probe.
-_SCAN_UNROLL: int | None = None
+# Trees per lax.scan step (row-tile x tree-tile blocking knob). The tree
+# bodies are PYTHON-unrolled inside each step — a vmap would batch the
+# per-tree HIGHEST-precision contractions and change their reduction
+# order, breaking exact dot == offset tie routing (TestQuantizedTieRouting)
+# — so G > 1 multiplies the step's HLO and its compile time. The r2 sweep
+# measured G in {2..100} as a wash-to-loss at runtime on BOTH backends
+# (0.532s at G=1 vs 0.55-0.61s, 524k rows x 100 trees, live v5e): the
+# dense bottleneck is the [C, width] walk intermediates, which blocking
+# does not shrink. Default therefore 1; tools/unroll_sweep.py re-measures
+# (override the module global to sweep).
+_TREE_BLOCK = 1
 
 
-def _scan_unroll(num_trees: int) -> int:
-    g = 1 if _SCAN_UNROLL is None else _SCAN_UNROLL
-    return max(1, min(int(g), num_trees))
+def _tree_block(num_trees: int) -> int:
+    return max(1, min(int(_TREE_BLOCK), num_trees))
 
 
-def _level_walk(bits_fn, is_internal: jax.Array, leaf_value: jax.Array, C: int, h: int):
+def _level_walk(bits_fn, is_internal: jax.Array, value: jax.Array, C: int, h: int):
     """Shared reach-propagation over the implicit heap.
 
     ``bits_fn(start, width)`` returns the ``[C, width]`` go-right bits of one
     heap level (lazy so the select formulation never materialises ``[C, M]``);
-    ``is_internal``: [M]; ``leaf_value``: [M] (``depth + c(numInstances)`` at
-    leaves, 0 elsewhere). Returns [C] path lengths. Python loop over levels is
-    static (h+1 iterations) and fuses into one XLA computation.
+    ``is_internal``: [M]; ``value``: [M] merged plane (``depth +
+    c(numInstances)`` at leaves, threshold at internal slots, 0 at holes).
+    Returns [C] path lengths. Python loop over levels is static (h+1
+    iterations) and fuses into one XLA computation.
     """
+    leaf_value = jnp.where(is_internal, 0.0, value)
     total = jnp.zeros((C,), jnp.float32)
     reach = jnp.ones((C, 1), jnp.bool_)
     for level in range(h + 1):
@@ -104,67 +115,96 @@ def _level_walk(bits_fn, is_internal: jax.Array, leaf_value: jax.Array, C: int, 
     return total
 
 
-def _leaf_values(num_instances: jax.Array, h: int) -> jax.Array:
-    """Per-slot ``depth + c(numInstances)`` at leaves, 0 elsewhere."""
-    depth = jnp.concatenate(
-        [jnp.full(((1 << level),), float(level), jnp.float32) for level in range(h + 1)]
-    )  # exact static per-slot depth (slot levels of the implicit heap)
-    is_leaf = num_instances >= 0
-    return jnp.where(is_leaf, depth + avg_path_length(num_instances), 0.0)
+def _pad_tree_axis(arr: jax.Array, block: int, fill) -> jax.Array:
+    pad = (-arr.shape[0]) % block
+    if not pad:
+        return arr
+    return jnp.concatenate(
+        [arr, jnp.full((pad,) + arr.shape[1:], fill, arr.dtype)], axis=0
+    )
 
 
-def standard_path_lengths_dense(forest: StandardForest, X: jax.Array) -> jax.Array:
+def _scan_tree_blocks(one_tree, tables: tuple, fills: tuple, num_trees: int, C: int):
+    """Sum ``one_tree(*tree_tables) -> f32[C]`` over all trees: scan over
+    blocks of :data:`_TREE_BLOCK`, the G tree bodies python-unrolled inside
+    each step. NOT a vmap: batching the per-tree HIGHEST-precision
+    contractions changes their reduction order, and exact ``dot == offset``
+    ties on quantized/constant data must round exactly like the unblocked
+    per-tree matmul (the tie-exactness TestQuantizedTieRouting pins).
+    Padding trees use neutral ``fills`` (leaf-at-root records with value 0)
+    and contribute 0."""
+    g = _tree_block(num_trees)
+    padded = tuple(_pad_tree_axis(a, g, f) for a, f in zip(tables, fills))
+    blocks = tuple(a.reshape(a.shape[0] // g, g, *a.shape[1:]) for a in padded)
+
+    def block_step(total, blk):
+        for i in range(g):
+            total = total + one_tree(*(a[i] for a in blk))
+        return total, None
+
+    total, _ = lax.scan(block_step, jnp.zeros((C,), jnp.float32), blocks)
+    return total / num_trees
+
+
+def standard_path_lengths_dense(
+    forest: StandardForest, X: jax.Array, layout=None
+) -> jax.Array:
     """Dense scoring for the standard forest; ``f32[C]`` mean path lengths."""
+    if layout is None:
+        layout = pack_forest(forest, num_features=int(X.shape[1]))
     h = _height_of(forest.max_nodes)
     C, F = X.shape
 
-    def one_tree(carry, tree):
-        feature, threshold, num_instances = tree
+    def one_tree(feature, value):
+        internal = feature >= 0
 
         if F <= _SELECT_MAX_FEATURES:
 
             def bits(start, width):
                 feat_l = feature[start : start + width]
-                thr_l = threshold[start : start + width]
+                val_l = value[start : start + width]
                 xv = jnp.zeros((C, width), X.dtype)
                 for f in range(F):
                     xv = jnp.where(feat_l[None, :] == f, X[:, f][:, None], xv)
-                return xv >= thr_l[None, :]
+                return xv >= val_l[None, :]
 
         else:
             # one-hot feature selection: xv[c, n] = X[c, feature[n]]
-            foh = jax.nn.one_hot(jnp.maximum(feature, 0), F, dtype=X.dtype)  # [M, F]
+            foh = jax.nn.one_hot(
+                jnp.maximum(feature, 0).astype(jnp.int32), F, dtype=X.dtype
+            )  # [M, F]
             xv_all = jnp.einsum(
                 "cf,mf->cm", X, foh, precision=lax.Precision.HIGHEST
             )
-            B_all = xv_all >= threshold[None, :]
+            B_all = xv_all >= value[None, :]
 
             def bits(start, width):
                 return B_all[:, start : start + width]
 
-        leaf_value = _leaf_values(num_instances, h)
-        pl = _level_walk(bits, feature >= 0, leaf_value, C, h)
-        return carry + pl, None
+        return _level_walk(bits, internal, value, C, h)
 
-    total, _ = lax.scan(
+    return _scan_tree_blocks(
         one_tree,
-        jnp.zeros((C,), jnp.float32),
-        (forest.feature, forest.threshold, forest.num_instances),
-        unroll=_scan_unroll(forest.num_trees),
+        (layout.feature, layout.value),
+        (-1, 0.0),
+        forest.num_trees,
+        C,
     )
-    return total / forest.num_trees
 
 
-def extended_path_lengths_dense(forest: ExtendedForest, X: jax.Array) -> jax.Array:
+def extended_path_lengths_dense(
+    forest: ExtendedForest, X: jax.Array, layout=None
+) -> jax.Array:
     """Dense EIF scoring: per-level hyperplane tests as HIGHEST-precision
     MXU matmuls (f32 dot parity with ExtendedUtils.scala:46-55; measured
     7.6e-6 max path-length deviation from the elementwise walk vs 0.24 at
     the TPU default bf16 passes)."""
+    if layout is None:
+        layout = pack_forest(forest)
     h = _height_of(forest.max_nodes)
     C, F = X.shape
 
-    def one_tree(carry, tree):
-        indices, weights, offset, num_instances = tree
+    def one_tree(indices, weights, value):
         # densify the sparse hyperplanes: W[n, f] = sum_j w[n,j][indices[n,j]==f]
         foh = jax.nn.one_hot(jnp.maximum(indices, 0), F, dtype=X.dtype)  # [M,k,F]
         valid = (indices >= 0).astype(X.dtype)
@@ -174,24 +214,22 @@ def extended_path_lengths_dense(forest: ExtendedForest, X: jax.Array) -> jax.Arr
 
         def bits(start, width):
             W_l = W[start : start + width]  # [W, F]
-            off_l = offset[start : start + width]
+            val_l = value[start : start + width]
             dots = jnp.matmul(X, W_l.T, precision=lax.Precision.HIGHEST)  # [C, W]
-            return dots >= off_l[None, :]
+            return dots >= val_l[None, :]
 
-        leaf_value = _leaf_values(num_instances, h)
-        pl = _level_walk(bits, indices[:, 0] >= 0, leaf_value, C, h)
-        return carry + pl, None
+        return _level_walk(bits, indices[:, 0] >= 0, value, C, h)
 
-    total, _ = lax.scan(
+    return _scan_tree_blocks(
         one_tree,
-        jnp.zeros((C,), jnp.float32),
-        (forest.indices, forest.weights, forest.offset, forest.num_instances),
-        unroll=_scan_unroll(forest.num_trees),
+        (forest.indices, forest.weights, layout.value),
+        (-1, 0.0, 0.0),
+        forest.num_trees,
+        C,
     )
-    return total / forest.num_trees
 
 
-def path_lengths_dense(forest, X: jax.Array) -> jax.Array:
+def path_lengths_dense(forest, X: jax.Array, layout=None) -> jax.Array:
     if isinstance(forest, StandardForest):
-        return standard_path_lengths_dense(forest, X)
-    return extended_path_lengths_dense(forest, X)
+        return standard_path_lengths_dense(forest, X, layout)
+    return extended_path_lengths_dense(forest, X, layout)

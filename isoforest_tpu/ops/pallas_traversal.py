@@ -74,14 +74,18 @@ def _concat_order(m: int) -> tuple:
     return tuple(order)
 
 
-def _leaf_value_tables(num_instances: np.ndarray, h: int, m_pad: int) -> jax.Array:
-    """[T, 1, m_pad] leaf-value table (:func:`..utils.math.leaf_value_table`
-    padded; pad slots contribute 0 to every walk). The unit middle axis makes
-    each per-tree block's trailing two dims equal the array dims, which
-    Mosaic's block-shape rules require."""
+def _merged_value_heap(is_internal: np.ndarray, internal_value, num_instances, h: int):
+    """[T, M] merged value plane in heap order: ``internal_value`` (threshold
+    / hyperplane offset) at internal slots, the leaf path-length LUT
+    ``depth + c(numInstances)`` at leaves, 0 at holes — the scoring_layout
+    merge, built host-side for the kernel tables."""
     from ..utils.math import leaf_value_table
 
-    return jnp.asarray(_pad_table(leaf_value_table(num_instances, h), m_pad, 0.0))
+    return np.where(
+        is_internal,
+        np.asarray(internal_value, np.float32),
+        leaf_value_table(num_instances, h),
+    ).astype(np.float32)
 
 
 def _pad_table(arr: np.ndarray, m_pad: int, fill: float) -> np.ndarray:
@@ -135,14 +139,16 @@ def _bcast_rows(row, c: int, precision=None):
     )
 
 
-def _standard_kernel(h, T, f_raw, x_ref, feat_ref, thr_ref, leaf_ref, out_ref):
+def _standard_kernel(h, T, f_raw, x_ref, feat_ref, val_ref, out_ref):
     t = pl.program_id(1)
     x = x_ref[...]  # [C_blk, F_pad]
     # node-table refs are [1, 1, M_pad] blocks (trailing two dims equal the
     # [T, 1, M_pad] array dims — a Mosaic block-shape requirement); drop the
-    # leading tree axis
+    # leading tree axis. ``val`` is the merged value plane (threshold at
+    # internal slots, leaf LUT at leaves, 0 at holes/pads) — the kernel
+    # streams TWO node tables per tree instead of three.
     feature = feat_ref[0]  # [1, M_pad] int32 (feature id; -1 leaf/pad)
-    thr = thr_ref[0]
+    val = val_ref[0]
     f_pad = x.shape[1]
     m_pad = feature.shape[1]
     c_blk = x.shape[0]
@@ -168,10 +174,14 @@ def _standard_kernel(h, T, f_raw, x_ref, feat_ref, thr_ref, leaf_ref, out_ref):
         xv = jax.lax.dot_general(
             x, sel, (((1,), (0,)), ((), ())), precision=jax.lax.Precision.HIGHEST, preferred_element_type=jnp.float32
         )  # [C_blk, M_pad]
-    B = (xv >= thr).astype(jnp.float32)
+    # leaf/hole bits are garbage (val holds the LUT there) but the level
+    # walk masks them with the internal plane, exactly like the XLA dense path
+    B = (xv >= val).astype(jnp.float32)
     hp = jax.lax.Precision.HIGHEST
-    internal = _bcast_rows((feature >= 0).astype(jnp.float32), c_blk, hp)
-    pl_len = _walk_levels(B, internal, _bcast_rows(leaf_ref[0], c_blk, hp), h)
+    internal_row = (feature >= 0).astype(jnp.float32)  # [1, M_pad]
+    leaf_row = val * (1.0 - internal_row)  # LUT at leaves, 0 elsewhere
+    internal = _bcast_rows(internal_row, c_blk, hp)
+    pl_len = _walk_levels(B, internal, _bcast_rows(leaf_row, c_blk, hp), h)
 
     @pl.when(t == 0)
     def _init():
@@ -181,14 +191,16 @@ def _standard_kernel(h, T, f_raw, x_ref, feat_ref, thr_ref, leaf_ref, out_ref):
 
 
 def _extended_kernel_sparse(
-    h, T, x_ref, idx_ref, w_ref, off_ref, internal_ref, leaf_ref, out_ref
+    h, T, x_ref, idx_ref, w_ref, val_ref, internal_ref, out_ref
 ):
     """EIF scoring from SPARSE hyperplane tables: densify in VMEM (k one-hot
     accumulation passes, pure VPU) instead of materialising [T, M_pad, F_pad]
     in HBM — at T=1000, F=274 the precomputed dense table cost ~786 MB; the
     sparse tables are ~2k/F of that. Used when k is small (the common sparse
     extension levels); large k dispatches to :func:`_extended_kernel_dense`
-    where the HBM table is no bigger than the sparse form anyway."""
+    where the HBM table is no bigger than the sparse form anyway.
+    ``val`` is the merged value plane (offset | leaf LUT | 0), so each tree
+    streams one fewer table than the pre-layout kernels."""
     t = pl.program_id(1)
     x = x_ref[...]  # [C_blk, F_pad]
     idx = idx_ref[0]  # [k, M_pad] sparse hyperplane coordinates (-1 pad)
@@ -211,10 +223,14 @@ def _extended_kernel_sparse(
     dots = jax.lax.dot_general(
         x, w_dense, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )  # [C_blk, M_pad] — MXU
-    B = (dots >= off_ref[0]).astype(jnp.float32)
+    val = val_ref[0]
+    B = (dots >= val).astype(jnp.float32)
     c_blk = dots.shape[0]
-    internal = _bcast_rows(internal_ref[0], c_blk)
-    pl_len = _walk_levels(B, internal, _bcast_rows(leaf_ref[0], c_blk), h)
+    internal_row = internal_ref[0]
+    internal = _bcast_rows(internal_row, c_blk)
+    pl_len = _walk_levels(
+        B, internal, _bcast_rows(val * (1.0 - internal_row), c_blk), h
+    )
 
     @pl.when(t == 0)
     def _init():
@@ -224,7 +240,7 @@ def _extended_kernel_sparse(
 
 
 def _extended_kernel_dense(
-    h, T, x_ref, w_ref, off_ref, internal_ref, leaf_ref, out_ref
+    h, T, x_ref, w_ref, val_ref, internal_ref, out_ref
 ):
     """EIF scoring from a precomputed dense [T, M_pad, F_pad] table — for
     near-fully-extended forests, where sparse storage saves nothing and the
@@ -237,10 +253,14 @@ def _extended_kernel_dense(
     dots = jax.lax.dot_general(
         x, W, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # [C_blk, M_pad] — MXU
-    B = (dots >= off_ref[0]).astype(jnp.float32)
+    val = val_ref[0]
+    B = (dots >= val).astype(jnp.float32)
     c_blk = dots.shape[0]
-    internal = _bcast_rows(internal_ref[0], c_blk)
-    pl_len = _walk_levels(B, internal, _bcast_rows(leaf_ref[0], c_blk), h)
+    internal_row = internal_ref[0]
+    internal = _bcast_rows(internal_row, c_blk)
+    pl_len = _walk_levels(
+        B, internal, _bcast_rows(val * (1.0 - internal_row), c_blk), h
+    )
 
     @pl.when(t == 0)
     def _init():
@@ -255,9 +275,9 @@ def _vmem_spec(block_shape, index_map):
 
 
 @functools.partial(jax.jit, static_argnames=("h", "f_raw", "interpret"))
-def _standard_pallas(X, feature_f32, threshold, leaf_value, h, f_raw, interpret=False):
+def _standard_pallas(X, feature, value, h, f_raw, interpret=False):
     C, Fp = X.shape
-    T, _, Mp = threshold.shape
+    T, _, Mp = value.shape
     grid = (C // _ROW_BLOCK, T)
     table = _vmem_spec((1, 1, Mp), lambda rb, t: (t, 0, 0))
     return pl.pallas_call(
@@ -267,12 +287,11 @@ def _standard_pallas(X, feature_f32, threshold, leaf_value, h, f_raw, interpret=
             _vmem_spec((_ROW_BLOCK, Fp), lambda rb, t: (rb, 0)),
             table,
             table,
-            table,
         ],
         out_specs=_vmem_spec((_ROW_BLOCK, 1), lambda rb, t: (rb, 0)),
         out_shape=jax.ShapeDtypeStruct((C, 1), jnp.float32),
         interpret=interpret,
-    )(X, feature_f32, threshold, leaf_value)[:, 0]
+    )(X, feature, value)[:, 0]
 
 
 # In-kernel densify beyond this many nonzero coordinates loses: the per-row-
@@ -283,10 +302,10 @@ _SPARSE_K_MAX = 32
 
 @functools.partial(jax.jit, static_argnames=("h", "interpret"))
 def _extended_pallas_sparse(
-    X, indices, weights, offset, internal, leaf_value, h, interpret=False
+    X, indices, weights, value, internal, h, interpret=False
 ):
     C, Fp = X.shape
-    T, _, Mp = offset.shape
+    T, _, Mp = value.shape
     k = indices.shape[1]
     grid = (C // _ROW_BLOCK, T)
     table = _vmem_spec((1, 1, Mp), lambda rb, t: (t, 0, 0))
@@ -301,20 +320,19 @@ def _extended_pallas_sparse(
             sparse,
             table,
             table,
-            table,
         ],
         out_specs=_vmem_spec((_ROW_BLOCK, 1), lambda rb, t: (rb, 0)),
         out_shape=jax.ShapeDtypeStruct((C, 1), jnp.float32),
         interpret=interpret,
-    )(X, indices, weights, offset, internal, leaf_value)[:, 0]
+    )(X, indices, weights, value, internal)[:, 0]
 
 
 @functools.partial(jax.jit, static_argnames=("h", "interpret"))
 def _extended_pallas_dense(
-    X, W_dense, offset, internal, leaf_value, h, interpret=False
+    X, W_dense, value, internal, h, interpret=False
 ):
     C, Fp = X.shape
-    T, _, Mp = offset.shape
+    T, _, Mp = value.shape
     grid = (C // _ROW_BLOCK, T)
     table = _vmem_spec((1, 1, Mp), lambda rb, t: (t, 0, 0))
     return pl.pallas_call(
@@ -325,12 +343,11 @@ def _extended_pallas_dense(
             _vmem_spec((1, Mp, Fp), lambda rb, t: (t, 0, 0)),
             table,
             table,
-            table,
         ],
         out_specs=_vmem_spec((_ROW_BLOCK, 1), lambda rb, t: (rb, 0)),
         out_shape=jax.ShapeDtypeStruct((C, 1), jnp.float32),
         interpret=interpret,
-    )(X, W_dense, offset, internal, leaf_value)[:, 0]
+    )(X, W_dense, value, internal)[:, 0]
 
 
 # The forest is immutable once trained/loaded, but the kernel needs host-side
@@ -359,30 +376,36 @@ def _cached_prep(forest, build, extra_key=()):
 
 
 def standard_tables(forest, m_pad: int, h: int):
-    """Kernel-layout node tables for a standard forest: ``(feature, threshold,
-    leaf_value)`` permuted/padded ``[T, 1, m_pad]``. Single source for the
-    production prep, the TPU-lowering tests, and the Mosaic machine-compile
-    worker so they cannot diverge. Pads: feature -1 (no one-hot match,
-    non-internal), threshold +inf (go-right bit 0), leaf value 0."""
+    """Kernel-layout node tables for a standard forest: ``(feature, value)``
+    permuted/padded ``[T, 1, m_pad]`` — the finalized scoring layout's TWO
+    tables (value = threshold at internal slots, leaf LUT at leaves) in the
+    level-concat order, replacing the pre-layout feature/threshold/leaf
+    triple. Single source for the production prep, the TPU-lowering tests,
+    and the Mosaic machine-compile worker so they cannot diverge. Pads:
+    feature -1 (no one-hot match, non-internal), value 0 (contributes 0 to
+    every walk; the pad's go-right bit is masked by internal=0)."""
+    feat_heap = np.asarray(forest.feature, np.int32)
+    value_heap = _merged_value_heap(
+        feat_heap >= 0, forest.threshold, forest.num_instances, h
+    )
     return (
-        jnp.asarray(_pad_table(np.asarray(forest.feature, np.int32), m_pad, -1)),
-        jnp.asarray(
-            _pad_table(np.asarray(forest.threshold, np.float32), m_pad, np.inf)
-        ),
-        _leaf_value_tables(forest.num_instances, h, m_pad),
+        jnp.asarray(_pad_table(feat_heap, m_pad, -1)),
+        jnp.asarray(_pad_table(value_heap, m_pad, 0.0)),
     )
 
 
 def extended_common_tables(forest, m_pad: int, h: int):
-    """Kernel-layout ``(offset, internal, leaf_value)`` tables shared by both
-    extended kernels — same single-source rationale as :func:`standard_tables`."""
+    """Kernel-layout ``(value, internal)`` tables shared by both extended
+    kernels — value merges offset and leaf LUT (scoring_layout), same
+    single-source rationale as :func:`standard_tables`."""
     indices = np.asarray(forest.indices)
+    internal_heap = indices[..., 0] >= 0
+    value_heap = _merged_value_heap(
+        internal_heap, forest.offset, forest.num_instances, h
+    )
     return (
-        jnp.asarray(_pad_table(np.asarray(forest.offset, np.float32), m_pad, np.inf)),
-        jnp.asarray(
-            _pad_table((indices[..., 0] >= 0).astype(np.float32), m_pad, 0.0)
-        ),
-        _leaf_value_tables(forest.num_instances, h, m_pad),
+        jnp.asarray(_pad_table(value_heap, m_pad, 0.0)),
+        jnp.asarray(_pad_table(internal_heap.astype(np.float32), m_pad, 0.0)),
     )
 
 
@@ -436,10 +459,8 @@ def path_lengths_pallas(forest, X, interpret: bool = False) -> jax.Array:
         def build_standard():
             return standard_tables(forest, m_pad, h)
 
-        feature_f32, threshold, leaf_value = _cached_prep(forest, build_standard)
-        out = _standard_pallas(
-            X, feature_f32, threshold, leaf_value, h, F, interpret=interpret
-        )
+        feature, value = _cached_prep(forest, build_standard)
+        out = _standard_pallas(X, feature, value, h, F, interpret=interpret)
     else:
 
         k = forest.indices.shape[2]
@@ -455,13 +476,13 @@ def path_lengths_pallas(forest, X, interpret: bool = False) -> jax.Array:
             forest, build_extended, extra_key=("sparse",) if sparse else ("dense", f_pad)
         )
         if sparse:
-            idx_p, w_p, offset, internal, leaf_value = prep
+            idx_p, w_p, value, internal = prep
             out = _extended_pallas_sparse(
-                X, idx_p, w_p, offset, internal, leaf_value, h, interpret=interpret
+                X, idx_p, w_p, value, internal, h, interpret=interpret
             )
         else:
-            W, offset, internal, leaf_value = prep
+            W, value, internal = prep
             out = _extended_pallas_dense(
-                X, W, offset, internal, leaf_value, h, interpret=interpret
+                X, W, value, internal, h, interpret=interpret
             )
     return out[:n]

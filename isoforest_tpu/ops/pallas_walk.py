@@ -82,6 +82,14 @@ _ROW_TILE = _ROW_GROUPS * _LANES
 # Beyond this many hyperplane coordinates the per-level gather+fma chain
 # approaches the dense kernels' matmul cost; larger k dispatches elsewhere.
 _WALK_K_MAX = 16
+# VMEM budget for the per-grid-step node tables. The standard kernel holds
+# 3 [8, L] f32 tables, the EIF kernel (2 + 2k) L-lane planes — L grows
+# ~2^h/128 lanes past h=7, so a deep forest with a wide k (e.g. k=16, h=12:
+# (2+32) * 8 * 8960 * 4 B ~ 9.7 MB) exceeds what fits next to the X tile
+# and the Mosaic allocator fails the compile outright. Route such forests
+# to dense instead (score_matrix warns once). 4 MB leaves headroom for the
+# X tile and double-buffering within a ~16 MB/core VMEM.
+_WALK_TABLE_BYTES_MAX = 4 * 1024 * 1024
 
 
 @functools.lru_cache(maxsize=None)
@@ -361,13 +369,42 @@ def _extended_walk(X, off, idx_packed, w_packed, leaf, h, f_raw, k, interpret=Fa
     return out[0]
 
 
+def _table_bytes(forest) -> int:
+    """Per-grid-step VMEM footprint of the walk-layout node tables, in bytes."""
+    h = _height_of(forest.max_nodes)
+    _, _, L = _level_layout(h)
+    if isinstance(forest, StandardForest):
+        planes = 3  # threshold, feature, leaf
+    else:
+        planes = 2 + 2 * forest.indices.shape[2]  # offset, leaf, k idx + k w
+    return planes * _SUBLANES * L * 4
+
+
+def unsupported_reason(forest) -> str | None:
+    """Why the walk kernel cannot cover this forest (``None`` = supported).
+
+    Two fences: EIF hyperplanes beyond ``_WALK_K_MAX`` coordinates (the
+    gather+fma chain stops paying vs the dense matmul), and node tables past
+    ``_WALK_TABLE_BYTES_MAX`` (the per-step [8, L] planes would not fit
+    VMEM and Mosaic compilation fails, rather than degrades)."""
+    if not isinstance(forest, StandardForest):
+        k = forest.indices.shape[2]
+        if k > _WALK_K_MAX:
+            return f"EIF hyperplane k={k} exceeds the kernel's k<={_WALK_K_MAX}"
+    bytes_needed = _table_bytes(forest)
+    if bytes_needed > _WALK_TABLE_BYTES_MAX:
+        return (
+            f"walk-layout node tables need {bytes_needed} B of VMEM per grid "
+            f"step (height {_height_of(forest.max_nodes)}), over the "
+            f"{_WALK_TABLE_BYTES_MAX} B budget"
+        )
+    return None
+
+
 def supports(forest) -> bool:
-    """Whether the walk kernel covers this forest: EIF hyperplanes beyond
-    ``_WALK_K_MAX`` coordinates dispatch to the dense kernels instead."""
-    return (
-        isinstance(forest, StandardForest)
-        or forest.indices.shape[2] <= _WALK_K_MAX
-    )
+    """Whether the walk kernel covers this forest (see
+    :func:`unsupported_reason` for the specific fence)."""
+    return unsupported_reason(forest) is None
 
 
 def path_lengths_walk(forest, X, interpret: bool = False) -> jax.Array:

@@ -1,0 +1,221 @@
+"""Finalized scoring layout: packed node records + leaf path-length LUT.
+
+``BENCH_r05.json`` pinned scoring as bandwidth-bound: every traversal step of
+the pointer-walk strategies gathered three separate full-width node arrays
+(``feature: i32``, ``threshold: f32`` and — at walk exit — ``num_instances:
+i32`` followed by the ``avg_path_length`` transcendental) per (row, tree).
+This module builds, once per fitted/loaded forest, the layout every scoring
+strategy consumes instead of the raw growth arrays:
+
+  1. **Leaf path-length LUT, merged into the value slot.** Internal slots
+     carry their split threshold (standard) / hyperplane offset (extended);
+     leaf slots carry ``depth + c(numInstances)`` — the exact quantity a walk
+     ending there must credit (IsolationTree.scala:213-229). Slot depth is
+     static in the implicit heap, so the merge is exact and bitwise equal to
+     computing ``depth + avg_path_length(n)`` at walk exit: the final
+     ``num_instances`` gather AND the per-row transcendental disappear from
+     every inner loop, and threshold + leaf tables collapse into ONE array
+     (node tables shrink 12 -> 8 bytes/slot).
+  2. **Packed node record.** The value slot and the split feature id (int
+     bits placed in a float lane via bitcast) interleave into one contiguous
+     ``f32[T, M, 2]`` buffer (extended: ``f32[T, M, 1 + 2k]`` with the
+     hyperplane coordinates and weights inline), so a traversal step issues
+     ONE coalesced gather of the whole record instead of three strided ones.
+  3. **Narrowed feature ids.** For strategies that stream the feature table
+     separately (the dense level-walk), ``feature`` is stored at the
+     narrowest width the feature count permits — ``i8`` up to F=128, ``i16``
+     up to F=32768 — cutting that stream 4x/2x. The ``-1`` leaf sentinel
+     fits every width.
+
+Builders are pure ``jnp`` so they run inside ``jit``/``shard_map`` regions
+(tree-sharded scoring packs its LOCAL tree shard — the packed buffer is
+sharded exactly like the forest, never materialised replicated). For the
+eager ``score_matrix`` path, :func:`get_layout` caches the built layout per
+forest identity so serving loops pay the build once. Persistence never sees
+this layout: models round-trip through the reference Avro node arrays
+unchanged and rebuild the layout on first score (docs/scoring_layout.md).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..utils.math import avg_path_length, height_of as _height_of
+from .ext_growth import ExtendedForest
+from .tree_growth import StandardForest
+
+# i8 features cover ids 0..127 (plus the -1 sentinel), i16 up to 32767 —
+# hence the F <= 128 / F <= 32768 boundaries pinned in
+# tests/test_scoring_layout.py.
+_I8_MAX_FEATURES = 128
+_I16_MAX_FEATURES = 32768
+
+
+def feature_dtype(num_features: Optional[int]):
+    """Narrowest integer dtype that holds every feature id in ``[0, F)`` plus
+    the ``-1`` sentinel; ``None`` (width unknown, e.g. legacy persisted
+    models) keeps i32."""
+    if num_features is None:
+        return jnp.int32
+    if num_features <= _I8_MAX_FEATURES:
+        return jnp.int8
+    if num_features <= _I16_MAX_FEATURES:
+        return jnp.int16
+    return jnp.int32
+
+
+def _slot_depths(max_nodes: int) -> np.ndarray:
+    """Static per-heap-slot depth ``f32[M]`` (slot levels of the implicit heap)."""
+    h = _height_of(max_nodes)
+    return np.concatenate(
+        [np.full((1 << lv,), float(lv), np.float32) for lv in range(h + 1)]
+    )
+
+
+def _bitcast_i32_to_f32(a: jax.Array) -> jax.Array:
+    return lax.bitcast_convert_type(a.astype(jnp.int32), jnp.float32)
+
+
+def bitcast_f32_to_i32(a: jax.Array) -> jax.Array:
+    return lax.bitcast_convert_type(a, jnp.int32)
+
+
+class PackedStandardLayout(NamedTuple):
+    """Finalized standard-forest scoring layout (see module docstring).
+
+    ``packed[t, m] = (value, bitcast(feature))``: value is the split
+    threshold at internal slots, the leaf LUT ``depth + c(numInstances)`` at
+    leaves, and 0 at non-existent slots; feature is the raw i32 split id
+    (-1 at leaves/holes) in float bits.
+    """
+
+    packed: jax.Array  # f32 [T, M, 2]
+    value: jax.Array  # f32 [T, M] — the unpacked value plane (dense strategy)
+    feature: jax.Array  # i8/i16/i32 [T, M], -1 at leaves/holes
+
+    @property
+    def num_trees(self) -> int:
+        return self.packed.shape[0]
+
+    @property
+    def max_nodes(self) -> int:
+        return self.packed.shape[1]
+
+
+class PackedExtendedLayout(NamedTuple):
+    """Extended-forest analogue: ``packed[t, m] = (value, bitcast(indices),
+    weights)`` — one ``1 + 2k``-float record per node, value merging the
+    hyperplane offset with the leaf LUT exactly like the standard layout."""
+
+    packed: jax.Array  # f32 [T, M, 1 + 2k]
+    value: jax.Array  # f32 [T, M]
+
+    @property
+    def num_trees(self) -> int:
+        return self.packed.shape[0]
+
+    @property
+    def max_nodes(self) -> int:
+        return self.packed.shape[1]
+
+    @property
+    def k(self) -> int:
+        return (self.packed.shape[2] - 1) // 2
+
+
+def leaf_lut(num_instances: jax.Array, max_nodes: int) -> jax.Array:
+    """Leaf path-length LUT ``f32[T, M]``: ``depth + c(numInstances)`` at
+    leaves, 0 elsewhere — the jnp twin of
+    :func:`~isoforest_tpu.utils.math.leaf_value_table` (kept host-side for
+    the native walker), usable inside ``jit``/``shard_map``."""
+    ni = jnp.asarray(num_instances)
+    depth = jnp.asarray(_slot_depths(max_nodes))
+    return jnp.where(ni >= 0, depth[None, :] + avg_path_length(ni), 0.0).astype(
+        jnp.float32
+    )
+
+
+def pack_standard(
+    forest: StandardForest, num_features: Optional[int] = None
+) -> PackedStandardLayout:
+    """Build the finalized layout for a standard forest (pure jnp)."""
+    feature = jnp.asarray(forest.feature, jnp.int32)
+    internal = feature >= 0
+    value = jnp.where(
+        internal,
+        jnp.asarray(forest.threshold, jnp.float32),
+        leaf_lut(forest.num_instances, forest.max_nodes),
+    )
+    packed = jnp.stack([value, _bitcast_i32_to_f32(feature)], axis=-1)
+    return PackedStandardLayout(
+        packed=packed,
+        value=value,
+        feature=feature.astype(feature_dtype(num_features)),
+    )
+
+
+def pack_extended(
+    forest: ExtendedForest, num_features: Optional[int] = None
+) -> PackedExtendedLayout:
+    """Build the finalized layout for an extended forest (pure jnp).
+
+    ``num_features`` is accepted for signature parity with
+    :func:`pack_standard`; the sparse hyperplane coordinates stay i32 in the
+    record's float lanes (a bitcast is width-preserving).
+    """
+    del num_features
+    indices = jnp.asarray(forest.indices, jnp.int32)  # [T, M, k]
+    internal = indices[..., 0] >= 0
+    value = jnp.where(
+        internal,
+        jnp.asarray(forest.offset, jnp.float32),
+        leaf_lut(forest.num_instances, forest.max_nodes),
+    )
+    packed = jnp.concatenate(
+        [
+            value[..., None],
+            _bitcast_i32_to_f32(indices),
+            jnp.asarray(forest.weights, jnp.float32),
+        ],
+        axis=-1,
+    )
+    return PackedExtendedLayout(packed=packed, value=value)
+
+
+def pack_forest(forest, num_features: Optional[int] = None):
+    if isinstance(forest, StandardForest):
+        return pack_standard(forest, num_features)
+    return pack_extended(forest, num_features)
+
+
+# Per-forest layout cache for the eager score_matrix path, keyed by the
+# identities of ALL forest arrays (a _replace of any field must miss) plus
+# the feature width (it picks the narrow dtype). Holding strong references
+# to the keyed arrays prevents id() reuse; bounded FIFO — the same policy as
+# the Pallas/native prep caches.
+_LAYOUT_CACHE: dict = {}
+_LAYOUT_CACHE_MAX = 8
+
+
+def get_layout(forest, num_features: Optional[int] = None):
+    """Cached :func:`pack_forest`: serving loops that score many batches
+    against one fitted model build the layout exactly once."""
+    arrays = tuple(forest)
+    key = (
+        tuple(id(a) for a in arrays),
+        tuple(forest[0].shape),
+        num_features,
+    )
+    hit = _LAYOUT_CACHE.get(key)
+    if hit is not None and all(a is b for a, b in zip(hit[0], arrays)):
+        return hit[1]
+    layout = pack_forest(forest, num_features)
+    if len(_LAYOUT_CACHE) >= _LAYOUT_CACHE_MAX:
+        _LAYOUT_CACHE.pop(next(iter(_LAYOUT_CACHE)))
+    _LAYOUT_CACHE[key] = (arrays, layout)
+    return layout

@@ -4,15 +4,22 @@ The reference scores one row at a time inside a Spark UDF — a tail-recursive
 pointer walk per tree (``IsolationTree.scala:196-229``;
 ``ExtendedIsolationTree.scala:283-355``), with the forest broadcast to every
 executor. Here the forest is a set of HBM-resident arrays and traversal is a
-``[trees, rows]`` batched gather program: a ``fori_loop`` of ``height`` steps,
-each step gathering every row's current node record and advancing
-``node -> 2*node + 1 + (go_right)``. Rows that reached a leaf stop moving —
-the loop is fixed-trip so the whole thing stays a single fused XLA program
-(and vectorises perfectly on TPU; this is also the Pallas candidate of
-SURVEY.md §7.2.4).
+batched gather program over the **finalized scoring layout** of
+:mod:`.scoring_layout`: each step gathers every row's current PACKED node
+record — value (threshold | leaf path-length LUT) and feature interleaved in
+one contiguous buffer, ONE coalesced gather instead of three strided ones —
+and advances ``node -> 2*node + 1 + (go_right)``. The loop is a
+``lax.while_loop`` bounded at ``height + 1`` trips that exits as soon as
+every row in the chunk sits at a leaf (Liu et al. 2008's short-path insight:
+most rows terminate in few levels, so shallow forests pay only the levels
+they use), and trees are processed in blocks of :data:`_TREE_BLOCK` under
+``lax.scan`` so a block's node tables stay cache-resident across the whole
+row tile (the caller's chunk).
 
-Path length = (depth of final leaf) + ``avg_path_length(leaf.numInstances)``
-(IsolationTree.scala:213-229); score ``2^(-E[h]/c(n))``
+Path length = the LUT value at the exit leaf — bitwise equal to
+``depth + avg_path_length(leaf.numInstances)`` (IsolationTree.scala:213-229)
+with the final ``numInstances`` gather and the transcendental hoisted to
+layout build time; score ``2^(-E[h]/c(n))``
 (IsolationForestModel.scala:135-138).
 """
 
@@ -28,71 +35,165 @@ from jax import lax
 
 from ..utils.math import avg_path_length, height_of as _height_of, score_from_path_length
 from .ext_growth import ExtendedForest
+from .scoring_layout import (
+    PackedStandardLayout,
+    bitcast_f32_to_i32,
+    get_layout,
+    pack_forest,
+)
 from .tree_growth import StandardForest
 
+# Trees per lax.scan step of the gather walk. Blocking bounds the live
+# [G, C] walk state while amortising per-step dispatch, and keeps one
+# block's packed tables (G * M * 8 B ~ 32 KB at the default M=511) hot in
+# cache across the entire row tile — the row-tile x tree-tile schedule the
+# native walker applies at L2 scale (scorer.cpp TILE_BYTES).
+_TREE_BLOCK = 8
 
-def standard_path_lengths(forest: StandardForest, X: jax.Array) -> jax.Array:
-    """Per-row mean path length over the forest; ``f32[C]`` for ``X: f32[C, F]``."""
-    h = _height_of(forest.max_nodes)
+
+def _pad_tree_blocks(packed: jax.Array, block: int) -> jax.Array:
+    """Pad the tree axis to a block multiple with NEUTRAL records: feature
+    -1 (immediate leaf) and value 0, so padded trees credit exactly 0 path
+    length and the block sum needs no masking."""
+    t = packed.shape[0]
+    pad = (-t) % block
+    if not pad:
+        return packed
+    neutral = jnp.zeros((pad,) + packed.shape[1:], packed.dtype)
+    feat_lane = lax.bitcast_convert_type(
+        jnp.full((), -1, jnp.int32), jnp.float32
+    )
+    if packed.shape[-1] == 2:  # standard record: (value, feature)
+        neutral = neutral.at[..., 1].set(feat_lane)
+    else:  # extended record: (value, indices..., weights...)
+        k = (packed.shape[-1] - 1) // 2
+        neutral = neutral.at[..., 1 : 1 + k].set(feat_lane)
+    return jnp.concatenate([packed, neutral], axis=0)
+
+
+def _walk_blocks(packed: jax.Array, num_trees: int, num_rows: int, one_tree) -> jax.Array:
+    """Mean path length over all trees: scan over tree blocks, vmap inside.
+
+    ``one_tree(packed_tree) -> f32[C]`` is the early-exit walk for a single
+    packed ``[M, R]`` table.
+    """
+    padded = _pad_tree_blocks(packed, _TREE_BLOCK)
+    g = min(_TREE_BLOCK, padded.shape[0])
+    blocks = padded.reshape(padded.shape[0] // g, g, *padded.shape[1:])
+
+    def block_step(total, blk):
+        pl = jax.vmap(one_tree)(blk)  # [G, C]
+        return total + jnp.sum(pl, axis=0), None
+
+    total, _ = lax.scan(block_step, jnp.zeros((num_rows,), jnp.float32), blocks)
+    return total / num_trees
+
+
+def _walk_one_standard(packed: jax.Array, X: jax.Array, h: int) -> jax.Array:
+    """Early-exit packed walk of one standard tree; ``packed: f32[M, 2]``."""
     C = X.shape[0]
 
-    def one_tree(feature, threshold, num_instances):
-        def step(_, carry):
-            node, depth = carry
-            f = feature[node]  # [C]
-            leaf = f < 0
-            xv = jnp.take_along_axis(X, jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
-            go_right = (xv >= threshold[node]).astype(jnp.int32)
-            nxt = 2 * node + 1 + go_right
-            node = jnp.where(leaf, node, nxt)
-            depth = jnp.where(leaf, depth, depth + 1)
-            return node, depth
+    def cond(carry):
+        i, node, out, done = carry
+        return (i < h + 1) & ~jnp.all(done)
 
-        node0 = jnp.zeros((C,), jnp.int32)
-        depth0 = jnp.zeros((C,), jnp.int32)
-        node, depth = lax.fori_loop(0, h, step, (node0, depth0))
-        return depth.astype(jnp.float32) + avg_path_length(num_instances[node])
+    def body(carry):
+        i, node, out, done = carry
+        rec = jnp.take(packed, node, axis=0)  # [C, 2] — ONE coalesced gather
+        value = rec[:, 0]
+        f = bitcast_f32_to_i32(rec[:, 1])
+        leaf = f < 0
+        out = jnp.where(leaf & ~done, value, out)
+        xv = jnp.take_along_axis(X, jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+        go_right = (xv >= value).astype(jnp.int32)
+        node = jnp.where(leaf | done, node, 2 * node + 1 + go_right)
+        return i + 1, node, out, done | leaf
 
-    per_tree = jax.vmap(one_tree)(
-        forest.feature, forest.threshold, forest.num_instances
-    )  # [T, C]
-    return jnp.mean(per_tree, axis=0)
+    init = (
+        jnp.zeros((), jnp.int32),
+        jnp.zeros((C,), jnp.int32),
+        jnp.zeros((C,), jnp.float32),
+        jnp.zeros((C,), jnp.bool_),
+    )
+    _, _, out, _ = lax.while_loop(cond, body, init)
+    return out
 
 
-def extended_path_lengths(forest: ExtendedForest, X: jax.Array) -> jax.Array:
+def _walk_one_extended(packed: jax.Array, X: jax.Array, h: int, k: int) -> jax.Array:
+    """Early-exit packed walk of one EIF tree; ``packed: f32[M, 1 + 2k]``."""
+    C = X.shape[0]
+
+    def cond(carry):
+        i, node, out, done = carry
+        return (i < h + 1) & ~jnp.all(done)
+
+    def body(carry):
+        i, node, out, done = carry
+        rec = jnp.take(packed, node, axis=0)  # [C, 1 + 2k] — one gather
+        value = rec[:, 0]
+        sub = bitcast_f32_to_i32(rec[:, 1 : 1 + k])  # [C, k]
+        w = rec[:, 1 + k :]
+        leaf = sub[:, 0] < 0
+        out = jnp.where(leaf & ~done, value, out)
+        xv = jnp.take_along_axis(X, jnp.maximum(sub, 0), axis=1)  # [C, k]
+        # jnp.sum over the k axis — the same XLA reduce growth used, which
+        # keeps exact dot == offset ties routing like growth did (PARITY.md)
+        dot = jnp.sum(xv * w, axis=1)
+        go_right = (dot >= value).astype(jnp.int32)
+        node = jnp.where(leaf | done, node, 2 * node + 1 + go_right)
+        return i + 1, node, out, done | leaf
+
+    init = (
+        jnp.zeros((), jnp.int32),
+        jnp.zeros((C,), jnp.int32),
+        jnp.zeros((C,), jnp.float32),
+        jnp.zeros((C,), jnp.bool_),
+    )
+    _, _, out, _ = lax.while_loop(cond, body, init)
+    return out
+
+
+def standard_path_lengths(
+    forest: StandardForest, X: jax.Array, layout: PackedStandardLayout | None = None
+) -> jax.Array:
+    """Per-row mean path length over the forest; ``f32[C]`` for ``X: f32[C, F]``.
+
+    ``layout`` is the prebuilt packed layout; ``None`` packs inline (pure
+    jnp, so this stays legal — and the packed buffer stays sharded — inside
+    ``jit``/``shard_map`` regions).
+    """
+    if layout is None:
+        layout = pack_forest(forest)
+    h = _height_of(forest.max_nodes)
+    return _walk_blocks(
+        layout.packed,
+        forest.num_trees,
+        X.shape[0],
+        lambda p: _walk_one_standard(p, X, h),
+    )
+
+
+def extended_path_lengths(
+    forest: ExtendedForest, X: jax.Array, layout=None
+) -> jax.Array:
     """EIF variant: hyperplane test ``dot(x, w) < offset`` -> left
     (ExtendedIsolationTree.scala:333-355, float32 dot per ExtendedUtils.scala:46-55)."""
+    if layout is None:
+        layout = pack_forest(forest)
     h = _height_of(forest.max_nodes)
-    C = X.shape[0]
-
-    def one_tree(indices, weights, offset, num_instances):
-        def step(_, carry):
-            node, depth = carry
-            sub = indices[node]  # [C, k]
-            leaf = sub[:, 0] < 0
-            xv = jnp.take_along_axis(X, jnp.maximum(sub, 0), axis=1)  # [C, k]
-            dot = jnp.sum(xv * weights[node], axis=1)
-            go_right = (dot >= offset[node]).astype(jnp.int32)
-            nxt = 2 * node + 1 + go_right
-            node = jnp.where(leaf, node, nxt)
-            depth = jnp.where(leaf, depth, depth + 1)
-            return node, depth
-
-        node0 = jnp.zeros((C,), jnp.int32)
-        depth0 = jnp.zeros((C,), jnp.int32)
-        node, depth = lax.fori_loop(0, h, step, (node0, depth0))
-        return depth.astype(jnp.float32) + avg_path_length(num_instances[node])
-
-    per_tree = jax.vmap(one_tree)(
-        forest.indices, forest.weights, forest.offset, forest.num_instances
+    k = forest.indices.shape[2]
+    return _walk_blocks(
+        layout.packed,
+        forest.num_trees,
+        X.shape[0],
+        lambda p: _walk_one_extended(p, X, h, k),
     )
-    return jnp.mean(per_tree, axis=0)
 
 
-def path_lengths(forest, X: jax.Array) -> jax.Array:
+def path_lengths(forest, X: jax.Array, layout=None) -> jax.Array:
     if isinstance(forest, StandardForest):
-        return standard_path_lengths(forest, X)
-    return extended_path_lengths(forest, X)
+        return standard_path_lengths(forest, X, layout)
+    return extended_path_lengths(forest, X, layout)
 
 
 # Per-backend winners for strategy="auto", both MEASURED. CPU: the
@@ -125,7 +226,8 @@ STRATEGIES = ("gather", "dense", "pallas", "walk", "native")
 
 _warned_native_fallback = False
 _warned_eif_pallas_fence = False
-_warned_walk_wide_k = False
+_warned_walk_unsupported = False
+_warned_walk_interpret = False
 
 
 def _live_platform() -> str:
@@ -194,13 +296,15 @@ def _score_native(forest, X, num_samples: int):
 
 
 @functools.partial(jax.jit, static_argnames=("num_samples", "strategy"))
-def _score_chunk(forest, X, num_samples: int, strategy: str = "dense") -> jax.Array:
+def _score_chunk(
+    forest, layout, X, num_samples: int, strategy: str = "dense"
+) -> jax.Array:
     if strategy == "dense":
         from .dense_traversal import path_lengths_dense
 
-        pl = path_lengths_dense(forest, X)
+        pl = path_lengths_dense(forest, X, layout)
     else:
-        pl = path_lengths(forest, X)
+        pl = path_lengths(forest, X, layout)
     return score_from_path_length(pl, num_samples)
 
 
@@ -222,6 +326,7 @@ def score_matrix(
     num_samples: int,
     chunk_size: int | None = None,
     strategy: str = "auto",
+    layout=None,
 ) -> np.ndarray:
     """Score a full ``[N, F]`` matrix, chunked along rows.
 
@@ -255,6 +360,11 @@ def score_matrix(
         its measured/predicted winner with no env var and no bench run.
         ``bench.py`` measures all strategies on the live backend and
         reports the ranking.
+
+    ``layout``: prebuilt finalized scoring layout
+    (:func:`~isoforest_tpu.ops.scoring_layout.pack_forest`); ``None``
+    resolves the per-forest cache (:func:`.scoring_layout.get_layout`).
+    The full strategy-selection table lives in docs/scoring_layout.md.
     """
     if not isinstance(X, (np.ndarray, jax.Array)):
         X = np.asarray(X, np.float32)
@@ -282,24 +392,48 @@ def score_matrix(
     if strategy == "walk":
         from . import pallas_walk
 
-        if not pallas_walk.supports(forest):
-            # wide-k EIF hyperplanes: the gather+fma chain stops paying;
-            # dense keeps HIGHEST-precision semantics. Warn once so pinned
-            # measurements are never silently mislabeled (same contract as
-            # the pallas fence / native fallback below).
-            global _warned_walk_wide_k
-            if not _warned_walk_wide_k:
-                _warned_walk_wide_k = True
+        if _live_platform() != "tpu" and not os.environ.get(
+            "ISOFOREST_TPU_INTERPRET"
+        ):
+            # Off-TPU the walk kernel can only run in Pallas interpret mode
+            # — minutes per rep, never what an operator pinning
+            # ISOFOREST_TPU_STRATEGY=walk on a CPU host meant. Warn once
+            # and take the portable gather path, mirroring the
+            # native-unavailable fallback below. CI's kernel-equivalence
+            # tests opt back into interpret mode via
+            # ISOFOREST_TPU_INTERPRET=1 (tests/conftest.py).
+            global _warned_walk_interpret
+            if not _warned_walk_interpret:
+                _warned_walk_interpret = True
                 from ..utils import logger
 
                 logger.warning(
-                    "strategy='walk' supports EIF hyperplanes up to k=%d "
-                    "coordinates; this forest has k=%d — scoring with the "
-                    "dense strategy instead",
-                    pallas_walk._WALK_K_MAX,
-                    forest.indices.shape[2],
+                    "strategy='walk' requires a TPU backend (off-TPU it "
+                    "would run the Pallas kernel in interpret mode, minutes "
+                    "per batch); scoring with the gather strategy instead. "
+                    "Set ISOFOREST_TPU_INTERPRET=1 to force interpret mode."
                 )
-            strategy = "dense"
+            strategy = "gather"
+        else:
+            reason = pallas_walk.unsupported_reason(forest)
+            if reason is not None:
+                # wide-k EIF hyperplanes (the gather+fma chain stops
+                # paying) or node tables past the VMEM budget (Mosaic
+                # compilation would fail outright): dense keeps
+                # HIGHEST-precision semantics. Warn once so pinned
+                # measurements are never silently mislabeled (same contract
+                # as the pallas fence / native fallback below).
+                global _warned_walk_unsupported
+                if not _warned_walk_unsupported:
+                    _warned_walk_unsupported = True
+                    from ..utils import logger
+
+                    logger.warning(
+                        "strategy='walk' does not cover this forest (%s); "
+                        "scoring with the dense strategy instead",
+                        reason,
+                    )
+                strategy = "dense"
     if strategy == "pallas" and extended and _live_platform() == "tpu":
         # Precision fence (VERDICT r2 item 4 / ADVICE r2 medium): the EIF
         # Pallas kernels' hyperplane contractions run at the TPU's default
@@ -356,9 +490,11 @@ def score_matrix(
             return score_from_path_length(pl_len, num_samples)
 
     else:
+        if layout is None:
+            layout = get_layout(forest, num_features=int(X.shape[1]))
 
         def run_chunk(chunk):
-            return _score_chunk(forest, chunk, num_samples, strategy)
+            return _score_chunk(forest, layout, chunk, num_samples, strategy)
 
     if chunk_size is None:
         chunk_size = _default_chunk_size()

@@ -29,6 +29,28 @@ DATA_AXIS = "data"
 TREES_AXIS = "trees"
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across the supported jax range.
+
+    ``jax.shard_map`` is the stable entry point on current jax; older
+    releases in the CI matrix (and this image's 0.4.x) only ship
+    ``jax.experimental.shard_map.shard_map``, whose replication-check
+    kwarg is spelled ``check_rep`` instead of ``check_vma``. One resolver
+    so every shard_map program in the package works on both.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,

@@ -20,7 +20,7 @@ from ..ops.ext_growth import ExtendedForest, grow_extended_forest
 from ..ops.traversal import path_lengths
 from ..ops.tree_growth import StandardForest, grow_forest
 from ..utils.math import score_from_path_length
-from .mesh import DATA_AXIS, TREES_AXIS
+from .mesh import DATA_AXIS, TREES_AXIS, shard_map_compat
 
 
 _warned_ineligible_pin = False
@@ -112,7 +112,7 @@ def _grow_program(mesh, height: int, extension_level: int | None):
         )
         out_specs = ExtendedForest(tree_spec, tree_spec, tree_spec, tree_spec)
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             grow,
             mesh=mesh,
             in_specs=(tree_spec, P(), tree_spec, tree_spec),
@@ -193,15 +193,19 @@ def _score_2d_program(
     pl_fn = _path_lengths_fn(strategy)
 
     def score_local(forest_loc, x_local):
-        # the path-length fn returns the local-shard MEAN; scale back to a
-        # sum so the psum over tree shards (neutral pads contribute 0)
-        # recovers the global total, then normalise by the TRUE tree count
+        # the path-length fn packs its finalized scoring layout
+        # (ops.scoring_layout) from forest_loc INSIDE the shard_map body, so
+        # the packed node-record buffer is built per tree shard and stays
+        # sharded exactly like the forest — no replicated [T, M, R] buffer
+        # ever materialises. The local mean is scaled back to a sum so the
+        # psum over tree shards (neutral pads contribute 0) recovers the
+        # global total, then normalised by the TRUE tree count.
         pl_sum = pl_fn(forest_loc, x_local) * forest_loc.num_trees
         total = jax.lax.psum(pl_sum, TREES_AXIS)
         return score_from_path_length(total / num_trees, num_samples)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             score_local,
             mesh=mesh,
             in_specs=(forest_spec, P(DATA_AXIS, None)),
@@ -248,7 +252,7 @@ def _score_replicated_program(mesh, is_standard: bool, num_samples: int, strateg
         return score_from_path_length(pl_fn(forest_rep, x_local), num_samples)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             score_local,
             mesh=mesh,
             in_specs=(forest_spec, P((DATA_AXIS, TREES_AXIS), None)),

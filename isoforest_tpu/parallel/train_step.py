@@ -21,7 +21,7 @@ from ..ops.bagging import bagged_indices, feature_subsets, per_tree_keys
 from ..ops.ext_growth import ExtendedForest, grow_extended_forest
 from ..ops.tree_growth import StandardForest, grow_forest
 from ..utils.math import height_limit, score_from_path_length
-from .mesh import DATA_AXIS, TREES_AXIS
+from .mesh import DATA_AXIS, TREES_AXIS, shard_map_compat
 
 
 class TrainStepResult(NamedTuple):
@@ -85,7 +85,7 @@ def make_train_step(
         grow = functools.partial(grow_forest, height=h)
         forest_specs = StandardForest(tree_spec, tree_spec, tree_spec)
 
-    grow_sharded = jax.shard_map(
+    grow_sharded = shard_map_compat(
         grow,
         mesh=mesh,
         in_specs=(tree_spec, P(), tree_spec, tree_spec),
@@ -131,7 +131,7 @@ def make_train_step(
         )
         return score_from_path_length(total / num_trees, num_samples)
 
-    score_sharded = jax.shard_map(
+    score_sharded = shard_map_compat(
         score_local,
         mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P(), forest_specs), row_spec),

@@ -11,6 +11,11 @@ import os
 import pathlib
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The suite's kernel-equivalence tests deliberately run the Pallas kernels
+# in interpret mode on this CPU host; production score_matrix would instead
+# fall back walk->gather off-TPU (with a one-shot warning). The fallback
+# itself is tested with this variable removed (test_strategies.py).
+os.environ.setdefault("ISOFOREST_TPU_INTERPRET", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     _flags += " --xla_force_host_platform_device_count=8"

@@ -315,7 +315,7 @@ class TestWalkWideKFallback:
         ).fit(Xw)
         assert ext.forest.indices.shape[2] == _WALK_K_MAX + 4
         assert not supports(ext.forest)
-        monkeypatch.setattr(tv, "_warned_walk_wide_k", False)
+        monkeypatch.setattr(tv, "_warned_walk_unsupported", False)
         with caplog.at_level(logging.WARNING, logger="isoforest_tpu"):
             got = score_matrix(ext.forest, Xw, ext.num_samples, strategy="walk")
             again = score_matrix(ext.forest, Xw, ext.num_samples, strategy="walk")
@@ -324,6 +324,65 @@ class TestWalkWideKFallback:
         np.testing.assert_array_equal(again, base)
         warnings = [r for r in caplog.records if "walk" in r.getMessage()]
         assert len(warnings) == 1, "wide-k fallback must warn exactly once"
+
+
+class TestWalkVmemBound:
+    def test_oversized_tables_route_to_dense(self):
+        """supports() fences on table BYTES, not just k: a deep forest with
+        a wide-but-legal k overflows the per-step [8, L] VMEM planes
+        ((2 + 2k) * 8 * L * 4 B), which would fail Mosaic compilation
+        outright rather than degrade — such forests must report a reason
+        and score via dense."""
+        from isoforest_tpu.ops import pallas_walk as pw
+        from isoforest_tpu.ops.ext_growth import ExtendedForest
+
+        k, h = pw._WALK_K_MAX, 12  # max_samples 4096 -> L = 8960 lanes
+        m = (1 << (h + 1)) - 1
+        forest = ExtendedForest(
+            indices=np.full((1, m, k), -1, np.int32),
+            weights=np.zeros((1, m, k), np.float32),
+            offset=np.zeros((1, m), np.float32),
+            num_instances=np.full((1, m), 1, np.int32),
+        )
+        assert pw._table_bytes(forest) > pw._WALK_TABLE_BYTES_MAX
+        reason = pw.unsupported_reason(forest)
+        assert reason is not None and "VMEM" in reason
+        # standard forests at the same height stay within budget (3 planes)
+        from isoforest_tpu.ops.tree_growth import StandardForest
+
+        std_forest = StandardForest(
+            feature=np.full((1, m), -1, np.int32),
+            threshold=np.zeros((1, m), np.float32),
+            num_instances=np.full((1, m), 1, np.int32),
+        )
+        assert pw.unsupported_reason(std_forest) is None
+
+
+class TestWalkOffTpuFallback:
+    def test_walk_off_tpu_falls_back_to_gather(self, caplog, monkeypatch):
+        """Explicit strategy='walk' off-TPU must NOT silently run the Pallas
+        kernel in interpret mode (minutes per rep): one-shot warning, then
+        the portable gather path — the same contract as the native
+        fallback. The suite's conftest sets ISOFOREST_TPU_INTERPRET=1 to
+        keep exercising interpret-mode kernels; removing it here restores
+        production behaviour."""
+        import logging
+
+        import isoforest_tpu.ops.traversal as tv
+
+        rng = np.random.default_rng(4)
+        Xs = rng.normal(size=(600, 4)).astype(np.float32)
+        m = IsolationForest(num_estimators=4, max_samples=64.0, random_seed=1).fit(Xs)
+        monkeypatch.delenv("ISOFOREST_TPU_INTERPRET", raising=False)
+        monkeypatch.setattr(tv, "_warned_walk_interpret", False)
+        with caplog.at_level(logging.WARNING, logger="isoforest_tpu"):
+            got = score_matrix(m.forest, Xs, m.num_samples, strategy="walk")
+            score_matrix(m.forest, Xs, m.num_samples, strategy="walk")
+        base = score_matrix(m.forest, Xs, m.num_samples, strategy="gather")
+        np.testing.assert_array_equal(got, base)
+        msgs = [r for r in caplog.records if "interpret" in r.getMessage()]
+        assert len(msgs) == 1, "off-TPU walk fallback must warn exactly once"
+        assert tv._warned_walk_interpret
 
 
 class TestPallasExtendedDispatch:
@@ -399,8 +458,15 @@ class TestPallasMosaicMachineCompile:
     hardware twice (the stack+reshape interleave's unsupported shape cast,
     then the broadcast-table layout-inference abort) while lowering-only
     passed both times. Runs in a subprocess because a layout-inference
-    regression aborts the process (``Check failed`` → SIGABRT)."""
+    regression aborts the process (``Check failed`` → SIGABRT).
 
+    Marked ``slow``: a full worker pass machine-compiles 7 kernels
+    (~4-6 min when the chipless topology initialises). The quick tier-1
+    sweep (``-m 'not slow'``) keeps the fast lowering gate below; the full
+    suite (coverage gate / make check) and CI's dedicated
+    strict-no-skip worker step still run this one."""
+
+    @pytest.mark.slow
     def test_all_kernels_machine_compile(self):
         import pathlib
         import subprocess
@@ -427,7 +493,13 @@ class TestPallasMosaicMachineCompile:
             f"Mosaic machine compile failed (rc={out.returncode}):\n"
             f"{out.stdout[-500:]}\n{out.stderr[-2000:]}"
         )
-        assert out.stdout.count("machine compile ok") == 7
+        compiled = out.stdout.count("machine compile ok")
+        # the walk kernels may individually skip where the local jax/libtpu
+        # cannot lower tpu.dynamic_gather at all (toolchain gap, not a
+        # kernel regression); the dense-path kernels must always compile
+        skipped_walk = out.stdout.count("skipped (no dynamic_gather")
+        assert compiled + skipped_walk == 7, out.stdout[-500:]
+        assert compiled >= 4, out.stdout[-500:]
 
 
 class TestPallasTpuLowering:
@@ -456,8 +528,8 @@ class TestPallasTpuLowering:
 
         h = height_of(forest.max_nodes)
         m_pad = pt._pad_lanes(forest.max_nodes)
-        feat, thr, leaf = pt.standard_tables(forest, m_pad, h)
-        self._lower(lambda a, b, c, d: pt._standard_pallas(a, b, c, d, h, X.shape[1]), Xp, feat, thr, leaf)
+        feat, val = pt.standard_tables(forest, m_pad, h)
+        self._lower(lambda a, b, c: pt._standard_pallas(a, b, c, h, X.shape[1]), Xp, feat, val)
 
     def test_extended_kernel_lowers_for_tpu(self, models):
         import jax.numpy as jnp
@@ -472,16 +544,16 @@ class TestPallasTpuLowering:
 
         h = height_of(forest.max_nodes)
         m_pad = pt._pad_lanes(forest.max_nodes)
-        off, internal, leaf = pt.extended_common_tables(forest, m_pad, h)
+        val, internal = pt.extended_common_tables(forest, m_pad, h)
         # sparse-k kernel (production path for small extension levels)
         idx_p, w_p = pt.sparse_hyperplane_tables(forest, m_pad)
         self._lower(
-            lambda a, b, c, d, e, f: pt._extended_pallas_sparse(a, b, c, d, e, f, h),
-            Xp, idx_p, w_p, off, internal, leaf,
+            lambda a, b, c, d, e: pt._extended_pallas_sparse(a, b, c, d, e, h),
+            Xp, idx_p, w_p, val, internal,
         )
         # dense-table kernel (large-k dispatch)
         W = pt.dense_hyperplane_table(forest, m_pad, Xp.shape[1])
         self._lower(
-            lambda a, b, c, d, e: pt._extended_pallas_dense(a, b, c, d, e, h),
-            Xp, W, off, internal, leaf,
+            lambda a, b, c, d: pt._extended_pallas_dense(a, b, c, d, h),
+            Xp, W, val, internal,
         )

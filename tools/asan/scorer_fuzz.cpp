@@ -7,12 +7,11 @@
 #include <vector>
 
 extern "C" void if_score_standard(const float*, int64_t, int32_t,
-                                  const int32_t*, const float*, const float*,
-                                  int64_t, int64_t, int32_t, float*);
+                                  const int32_t*, const float*, int64_t,
+                                  int64_t, int32_t, float*);
 extern "C" void if_score_extended(const float*, int64_t, int32_t,
                                   const int32_t*, const float*, const float*,
-                                  const float*, int64_t, int64_t, int32_t,
-                                  int32_t, float*);
+                                  int64_t, int64_t, int32_t, int32_t, float*);
 
 int main() {
   std::mt19937 rng(7);
@@ -25,25 +24,26 @@ int main() {
     int F = ri(1, 9);
     int k = ri(2, 6);
     int64_t M = (int64_t(1) << (h + 1)) - 1;
-    std::vector<float> X(n * F), thr(T * M), leaf(T * M), w(T * M * k), off(T * M), out(n);
+    // merged value plane (ops/scoring_layout.py): threshold/offset at
+    // internal slots, leaf LUT at leaves
+    std::vector<float> X(n * F), val(T * M), w(T * M * k), vale(T * M), out(n);
     std::vector<int32_t> feat(T * M), idx(T * M * k);
     for (auto& v : X) v = nd(rng);
-    for (auto& v : thr) v = nd(rng);
-    for (auto& v : off) v = nd(rng);
     for (auto& v : w) v = nd(rng);
     for (int64_t i = 0; i < T * M; ++i) {
       bool is_leaf = (rng() % 10) < 4;
       feat[i] = is_leaf ? -1 : int32_t(rng() % F);
-      leaf[i] = is_leaf ? float(1 + rng() % 9) : 0.0f;
+      val[i] = is_leaf ? float(1 + rng() % 9) : nd(rng);
       idx[i * k] = is_leaf ? -1 : int32_t(rng() % F);
       for (int q = 1; q < k; ++q) idx[i * k + q] = int32_t(rng() % F);
+      vale[i] = is_leaf ? float(1 + rng() % 9) : nd(rng);
     }
     for (const char* threads : {"1", "3", "5"}) {
       setenv("ISOFOREST_NATIVE_THREADS", threads, 1);
       for (const char* simd : {"0", "1"}) {
         setenv("ISOFOREST_NATIVE_SIMD", simd, 1);
-        if_score_standard(X.data(), n, F, feat.data(), thr.data(), leaf.data(), T, M, h, out.data());
-        if_score_extended(X.data(), n, F, idx.data(), w.data(), off.data(), leaf.data(), T, M, k, h, out.data());
+        if_score_standard(X.data(), n, F, feat.data(), val.data(), T, M, h, out.data());
+        if_score_extended(X.data(), n, F, idx.data(), w.data(), vale.data(), T, M, k, h, out.data());
       }
     }
     if (it % 100 == 0) fprintf(stderr, "iter %d ok\n", it);

@@ -1,0 +1,151 @@
+"""CPU bench smoke: packed-layout gather vs the unpacked baseline it replaced.
+
+CI regression fence for the finalized scoring layout
+(isoforest_tpu/ops/scoring_layout.py): on a small synthetic dataset, the
+production gather strategy — packed node records, leaf path-length LUT,
+tree-block scan, early-exit while_loop — must not be slower than the
+pre-layout formulation (three separate node arrays, fixed ``height``-trip
+fori_loop, end-of-walk ``num_instances`` gather + ``avg_path_length``
+transcendental), which is kept HERE as the reference implementation.
+
+Timing asserts in shared CI runners are noisy, so the gate is best-of-N
+against a generous margin (default 1.25x), not an exact comparison; the
+JSON line it prints records both timings for trend tracking.
+
+Run: ``python tools/bench_smoke.py`` (exit 0 = pass).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+ROWS = 65_536
+FEATURES = 6
+TREES = 50
+REPS = 3
+MARGIN = 1.25
+
+
+def _unpacked_baseline():
+    """The pre-layout gather walk, verbatim semantics: per step gathers
+    feature + threshold from separate arrays, at exit gathers num_instances
+    and pays the transcendental per row."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from isoforest_tpu.utils.math import avg_path_length, height_of
+
+    @functools.partial(jax.jit, static_argnames=())
+    def path_lengths_unpacked(feature, threshold, num_instances, X):
+        h = height_of(feature.shape[1])
+        C = X.shape[0]
+
+        def one_tree(feat, thr, ni):
+            def step(_, carry):
+                node, depth = carry
+                f = feat[node]
+                leaf = f < 0
+                xv = jnp.take_along_axis(X, jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+                go_right = (xv >= thr[node]).astype(jnp.int32)
+                nxt = 2 * node + 1 + go_right
+                node = jnp.where(leaf, node, nxt)
+                depth = jnp.where(leaf, depth, depth + 1)
+                return node, depth
+
+            node0 = jnp.zeros((C,), jnp.int32)
+            depth0 = jnp.zeros((C,), jnp.int32)
+            node, depth = lax.fori_loop(0, h, step, (node0, depth0))
+            return depth.astype(jnp.float32) + avg_path_length(ni[node])
+
+        per_tree = jax.vmap(one_tree)(feature, threshold, num_instances)
+        return jnp.mean(per_tree, axis=0)
+
+    return path_lengths_unpacked
+
+
+def main() -> int:
+    import jax
+
+    from isoforest_tpu import IsolationForest
+    from isoforest_tpu.ops.traversal import score_matrix
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(ROWS, FEATURES)).astype(np.float32)
+    X[:500] += 4.0
+    model = IsolationForest(
+        num_estimators=TREES, max_samples=256.0, random_seed=1
+    ).fit(X)
+    forest = model.forest
+
+    unpacked = _unpacked_baseline()
+
+    def run_packed():
+        return score_matrix(forest, X, model.num_samples, strategy="gather")
+
+    def run_unpacked():
+        pl = unpacked(forest.feature, forest.threshold, forest.num_instances, X)
+        return np.asarray(pl)
+
+    packed_scores = run_packed()  # compile + build layout
+    run_unpacked()  # compile
+
+    def best_of(fn):
+        best = None
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            out = fn()
+            np.asarray(out)
+            dt = time.perf_counter() - t0
+            best = dt if best is None or dt < best else best
+        return best
+
+    t_packed = best_of(run_packed)
+    t_unpacked = best_of(run_unpacked)
+
+    # correctness guard alongside the timing gate: packed scores must match
+    # the unpacked baseline's scores to float32 tolerance
+    from isoforest_tpu.utils.math import avg_path_length
+
+    c = np.float32(avg_path_length(model.num_samples))
+    baseline_scores = np.exp2(-run_unpacked() / c).astype(np.float32)
+    max_dev = float(np.abs(packed_scores - baseline_scores).max())
+
+    ok = t_packed <= t_unpacked * MARGIN and max_dev <= 1e-6
+    print(
+        json.dumps(
+            {
+                "metric": "bench_smoke_packed_gather_vs_unpacked",
+                "rows": ROWS,
+                "trees": TREES,
+                "packed_s": round(t_packed, 4),
+                "unpacked_s": round(t_unpacked, 4),
+                "speedup": round(t_unpacked / t_packed, 3),
+                "max_score_dev": max_dev,
+                "margin": MARGIN,
+                "backend": jax.devices()[0].platform,
+                "pass": ok,
+            }
+        )
+    )
+    if not ok:
+        print(
+            f"bench smoke FAILED: packed {t_packed:.4f}s vs unpacked "
+            f"{t_unpacked:.4f}s (margin {MARGIN}x), max_dev {max_dev:g}",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,9 +62,11 @@ def _old_level_walk(B, is_internal, leaf_value, h):
 
 
 def _leaf_values(num_instances, h):
-    from isoforest_tpu.ops.dense_traversal import _leaf_values as _lv
+    # the shipped dense path now reads leaves from the merged value plane
+    # (ops.scoring_layout); this keeps the experiments' standalone [M] table
+    from isoforest_tpu.ops.scoring_layout import leaf_lut
 
-    return _lv(num_instances, h)
+    return leaf_lut(jnp.asarray(num_instances)[None, :], 2 ** (h + 1) - 1)[0]
 
 
 # ---------------------------------------------------------------- variant B

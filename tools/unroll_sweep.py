@@ -51,7 +51,7 @@ def main() -> None:
     for g in [int(s) for s in args.sweep.split(",")]:
         if g > args.trees:
             continue
-        dense_traversal._SCAN_UNROLL = g
+        dense_traversal._TREE_BLOCK = g
         try:
             # _score_chunk's jit cache keys on shapes/statics, not on the
             # module global — drop it so each G actually recompiles
