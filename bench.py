@@ -384,6 +384,11 @@ def main() -> None:
     except Exception as exc:  # sklearn missing/failed: report throughput only
         print(f"[bench] sklearn baseline unavailable: {exc}", file=sys.stderr)
         vs_baseline = 1.0
+    # the unified degradation ladder (docs/resilience.md): any fallback the
+    # run hit — e.g. native→gather on a toolchain-less host, the EIF pallas
+    # fence — is dumped so a benchmark number is never silently mislabeled
+    from isoforest_tpu.resilience import degradations
+
     print(
         json.dumps(
             {
@@ -403,6 +408,7 @@ def main() -> None:
                 "strategy_timings_s": {
                     k: round(v, 4) for k, v in strategy_timings.items()
                 },
+                "degradations": [e.as_dict() for e in degradations()],
             }
         )
     )
@@ -489,6 +495,8 @@ def full_sweep() -> None:
     timings = {
         k: round(v, 4) for k, v in _time_strategies(ext_model, Xb[: 1 << 13]).items()
     }
+    from isoforest_tpu.resilience import degradations
+
     print(
         json.dumps(
             {
@@ -498,6 +506,7 @@ def full_sweep() -> None:
                 "timings": timings,
                 "winner": min(timings, key=timings.get) if timings else None,
                 "backend": jax.devices()[0].platform,
+                "degradations": [e.as_dict() for e in degradations()],
             }
         )
     )

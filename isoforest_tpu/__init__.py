@@ -9,7 +9,7 @@ sharding over a `jax.sharding.Mesh`.
 
 __version__ = "0.6.0"
 
-from . import ops, parallel, utils  # noqa: F401
+from . import ops, parallel, resilience, utils  # noqa: F401
 from .models import (
     ExtendedIsolationForest,
     ExtendedIsolationForestModel,
@@ -20,6 +20,7 @@ from .models import (
 __all__ = [
     "ops",
     "parallel",
+    "resilience",
     "utils",
     "__version__",
     "ExtendedIsolationForest",

@@ -370,12 +370,13 @@ def write_container(
         flush(batch)
 
 
-def read_blocks(path: str) -> Tuple[Any, List[Tuple[int, bytes]]]:
-    """Read an Avro container -> (parsed schema, [(record_count, plaintext
-    block body)]). Codec (null/deflate/snappy) handled here; record decoding
-    is the caller's choice (generic :func:`decode_value`, or the native
-    columnar decoders in :mod:`isoforest_tpu.native`)."""
+def _read_container_header(path: str):
+    """Shared container-header parse -> (reader positioned at the first
+    block, full file bytes, schema, codec, sync marker)."""
     data = open(path, "rb").read()
+    from ..resilience import faults
+
+    data = faults.filter_read_bytes(path, data)  # fault-injection seam
     if data[:4] != MAGIC:
         raise ValueError(f"{path}: not an Avro object container file")
     reader = _Reader(data, 4)
@@ -393,34 +394,81 @@ def read_blocks(path: str) -> Tuple[Any, List[Tuple[int, bytes]]]:
     sync = reader.read_raw(SYNC_SIZE)
     schema = json.loads(meta["avro.schema"].decode())
     codec = meta.get("avro.codec", b"null").decode()
+    return reader, data, schema, codec, sync
 
+
+def _decode_block(path: str, data: bytes, reader: _Reader, codec: str):
+    """Read + decompress one block at the reader's position -> (count, body).
+    Raises on any corruption (bad codec stream, CRC, framing)."""
+    count = reader.read_long()
+    size = reader.read_long()
+    if size < 0 or size > len(data) - reader.pos:
+        raise ValueError(f"{path}: block size {size} exceeds remaining file")
+    block = reader.read_raw(size)
+    if codec == "deflate":
+        block = zlib.decompress(block, -15)
+    elif codec == "snappy":
+        payload = block[:-4]  # trailing 4-byte CRC32 (BE) of plaintext
+        decoded = None
+        try:  # native fast path (isoforest_tpu/native), pure-Python fallback
+            from .. import native as _native
+
+            decoded = _native.snappy_decompress(payload)
+        except ImportError:  # pragma: no cover
+            decoded = None
+        block = decoded if decoded is not None else snappy_decompress(payload)
+        crc = struct.unpack(">I", data[reader.pos - 4 : reader.pos])[0]
+        if zlib.crc32(block) & 0xFFFFFFFF != crc:
+            raise ValueError(f"{path}: snappy block CRC mismatch")
+    elif codec != "null":
+        raise ValueError(f"unsupported read codec {codec!r}")
+    return count, block
+
+
+def read_blocks(path: str) -> Tuple[Any, List[Tuple[int, bytes]]]:
+    """Read an Avro container -> (parsed schema, [(record_count, plaintext
+    block body)]). Codec (null/deflate/snappy) handled here; record decoding
+    is the caller's choice (generic :func:`decode_value`, or the native
+    columnar decoders in :mod:`isoforest_tpu.native`)."""
+    reader, data, schema, codec, sync = _read_container_header(path)
     blocks: List[Tuple[int, bytes]] = []
     n = len(data)
     while reader.pos < n:
-        count = reader.read_long()
-        size = reader.read_long()
-        block = reader.read_raw(size)
-        if codec == "deflate":
-            block = zlib.decompress(block, -15)
-        elif codec == "snappy":
-            payload = block[:-4]  # trailing 4-byte CRC32 (BE) of plaintext
-            decoded = None
-            try:  # native fast path (isoforest_tpu/native), pure-Python fallback
-                from .. import native as _native
-
-                decoded = _native.snappy_decompress(payload)
-            except ImportError:  # pragma: no cover
-                decoded = None
-            block = decoded if decoded is not None else snappy_decompress(payload)
-            crc = struct.unpack(">I", data[reader.pos - 4 : reader.pos])[0]
-            if zlib.crc32(block) & 0xFFFFFFFF != crc:
-                raise ValueError(f"{path}: snappy block CRC mismatch")
-        elif codec != "null":
-            raise ValueError(f"unsupported read codec {codec!r}")
-        blocks.append((count, block))
+        blocks.append(_decode_block(path, data, reader, codec))
         if reader.read_raw(SYNC_SIZE) != sync:
             raise ValueError(f"{path}: sync marker mismatch")
     return schema, blocks
+
+
+def read_blocks_tolerant(path: str):
+    """Best-effort variant of :func:`read_blocks` for degraded loads
+    (``on_corrupt="drop"``): a corrupt block is skipped and reported rather
+    than failing the file; a sync-marker mismatch after a bad block means
+    the framing can no longer be trusted, so reading stops there. Returns
+    ``(schema, blocks, issues)`` — callers decide what the lost blocks
+    mean."""
+    reader, data, schema, codec, sync = _read_container_header(path)
+    blocks: List[Tuple[int, bytes]] = []
+    issues: List[str] = []
+    n = len(data)
+    index = 0
+    while reader.pos < n:
+        try:
+            block = _decode_block(path, data, reader, codec)
+        except Exception as exc:
+            issues.append(f"{os.path.basename(path)} block {index}: {exc}")
+            break  # size/offset no longer trustworthy; later syncs are noise
+        marker = reader.read_raw(SYNC_SIZE)
+        if marker != sync:
+            issues.append(
+                f"{os.path.basename(path)} block {index}: sync marker "
+                "mismatch (truncated or shifted frame); discarding the "
+                "block and the remainder of the file"
+            )
+            break
+        blocks.append(block)
+        index += 1
+    return schema, blocks, issues
 
 
 def read_container(path: str) -> Tuple[Any, List[dict]]:

@@ -19,10 +19,27 @@ Spark-era golden fixtures (snappy codec, loaded via :mod:`.avro`).
 
 Legacy models without ``totalNumFeatures`` load with the ``-1`` sentinel and a
 warning (IsolationForestModelReadWrite.scala:298-306).
+
+Resilience additions (docs/resilience.md) on top of the reference layout:
+
+* **Atomic writes** — every save materialises the full directory under a
+  sibling temp name (``<path>.__tmp-<hex>``) and atomically renames it into
+  place, so no reader can ever observe a partial model at ``<path>``; a
+  killed writer leaves only the marked temp dir, which loads refuse and
+  ``overwrite=True`` saves clean up.
+* **Integrity manifest** — ``_MANIFEST.json`` (per-file size/CRC32/SHA-256,
+  :mod:`isoforest_tpu.resilience.manifest`) written before the rename and
+  verified on load; reference/Spark-written dirs without one load with a
+  legacy warning.
+* **Degraded loads** — ``on_corrupt="drop"`` salvages every intact tree
+  from a corrupt directory, rebuilds a valid smaller forest (path-length
+  normalisation rescales automatically) and reports exactly which trees
+  were lost (``model.load_report``).
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import shutil
@@ -34,6 +51,8 @@ import numpy as np
 
 from ..ops.ext_growth import ExtendedForest
 from ..ops.tree_growth import StandardForest
+from ..resilience import manifest as _manifest
+from ..resilience.degradation import LoadReport, degrade
 from ..utils import logger
 from ..utils.params import ExtendedIsolationForestParams, IsolationForestParams
 from ..utils.validation import UNKNOWN_TOTAL_NUM_FEATURES
@@ -357,14 +376,74 @@ def records_to_extended_forest(
 # --------------------------------------------------------------------------- #
 
 
-def _prepare_dir(path: str, overwrite: bool) -> None:
+# Temp-dir marker for atomic writes. A save builds the COMPLETE directory
+# (metadata, data, _SUCCESS markers, manifest) under <path>.__tmp-<hex> and
+# renames it into place in one os.rename — readers either see the old
+# model, no model, or the fully sealed new one, never a partial dir.
+_TMP_MARKER = ".__tmp-"
+
+
+def _is_partial_dir(path: str) -> bool:
+    return _TMP_MARKER in os.path.basename(os.path.normpath(path))
+
+
+def _clean_stale_partials(path: str) -> None:
+    """Remove leftover temp dirs of killed writers for ``path``."""
+    target = os.path.abspath(os.path.normpath(path))
+    parent, base = os.path.split(target)
+    if not os.path.isdir(parent):
+        return
+    for name in os.listdir(parent):
+        if name.startswith(base + _TMP_MARKER):
+            stale = os.path.join(parent, name)
+            logger.warning(
+                "removing stale partial write %s (left by an interrupted save)",
+                stale,
+            )
+            shutil.rmtree(stale, ignore_errors=True)
+
+
+def _begin_atomic_dir(path: str, overwrite: bool) -> str:
+    """Start an atomic directory write; returns the temp dir (with a
+    ``metadata/`` subdir ready). Same parent as ``path`` so the final
+    rename stays on one filesystem."""
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(
+            f"path {path} already exists; pass overwrite=True to replace"
+        )
+    if overwrite:
+        _clean_stale_partials(path)
+    tmp = f"{os.path.normpath(path)}{_TMP_MARKER}{uuid.uuid4().hex[:12]}"
+    os.makedirs(os.path.join(tmp, "metadata"))
+    return tmp
+
+
+def _commit_atomic_dir(tmp: str, path: str, overwrite: bool) -> None:
+    """Seal the temp dir (manifest last) and rename it into place."""
+    _manifest.write(tmp)
     if os.path.exists(path):
-        if not overwrite:
+        if not overwrite:  # re-check: another writer may have landed first
             raise FileExistsError(
                 f"path {path} already exists; pass overwrite=True to replace"
             )
         shutil.rmtree(path)
-    os.makedirs(os.path.join(path, "metadata"))
+    os.rename(tmp, path)
+
+
+@contextlib.contextmanager
+def _atomic_dir(path: str, overwrite: bool):
+    """``with _atomic_dir(path, overwrite) as tmp: <write into tmp>`` —
+    commits on success, removes the temp dir on any failure so an aborted
+    save leaves the target untouched and no litter behind (a hard kill can
+    still leave the marked temp dir; loads refuse it and the next
+    ``overwrite=True`` save sweeps it)."""
+    tmp = _begin_atomic_dir(path, overwrite)
+    try:
+        yield tmp
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    _commit_atomic_dir(tmp, path, overwrite)
 
 
 def _write_metadata(path: str, metadata: dict) -> None:
@@ -417,6 +496,250 @@ def _read_data(path: str) -> List[dict]:
     if not records:
         raise FileNotFoundError(f"no avro data files under {data_dir}")
     return records
+
+
+# --------------------------------------------------------------------------- #
+# load preconditions + degraded (tolerant) load path
+# --------------------------------------------------------------------------- #
+
+
+def _check_model_dir(path: str, require_success: bool, expect_data: bool = True) -> None:
+    """Refuse partial writes and unsealed directories before reading a byte."""
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"no model directory at {path}")
+    if _is_partial_dir(path):
+        raise ValueError(
+            f"{path} is a partial write left by an interrupted save (temp "
+            f"marker {_TMP_MARKER!r} in its name): the writer died before "
+            "the atomic rename, so its contents are not trustworthy. Delete "
+            "it and re-save; a save(..., overwrite=True) to the real path "
+            "cleans such leftovers automatically"
+        )
+    if require_success:
+        wanted = ["metadata/_SUCCESS"] + (["data/_SUCCESS"] if expect_data else [])
+        missing = [
+            m for m in wanted if not os.path.exists(os.path.join(path, *m.split("/")))
+        ]
+        if missing:
+            raise ValueError(
+                f"{path} is not a sealed model directory (missing "
+                f"{', '.join(missing)}): the writer never finished, or the "
+                "markers were stripped. Pass require_success=False to load "
+                "it anyway"
+            )
+
+
+def _verify_manifest(path: str, verify, on_corrupt: str) -> List[str]:
+    """Manifest gate for loads. Returns the data-file issues a
+    ``on_corrupt="drop"`` load may tolerate; everything else raises.
+
+    ``verify``: ``"auto"`` (default — verify when a manifest is present,
+    legacy warning when absent), ``True`` (require one), ``False`` (skip)."""
+    if verify is False:
+        return []
+    if verify not in ("auto", True):
+        raise ValueError(f"verify must be 'auto', True or False, got {verify!r}")
+    if not _manifest.present(path):
+        if verify is True:
+            raise ValueError(
+                f"{path} has no {_manifest.MANIFEST_NAME} but verify=True "
+                "was requested; re-save with this library or pass "
+                "verify='auto' to accept legacy/Spark-written layouts"
+            )
+        logger.warning(
+            "model directory %s has no %s (legacy/Spark-written layout); "
+            "integrity verification skipped",
+            path,
+            _manifest.MANIFEST_NAME,
+        )
+        return []
+    issues = _manifest.verify(path)
+    if not issues:
+        return []
+    data_issues = [i for i in issues if i.startswith("data/")]
+    fatal = [i for i in issues if not i.startswith("data/")]
+    if fatal or on_corrupt != "drop":
+        raise ValueError(
+            f"model directory {path} failed manifest verification: "
+            + "; ".join(issues)
+            + ". The directory is corrupt; restore it from source, or pass "
+            "on_corrupt='drop' to salvage the intact trees (data files only)"
+        )
+    return data_issues
+
+
+def _read_data_tolerant(path: str):
+    """Best-effort record read for degraded loads: per-file, per-block,
+    per-record error containment. Returns ``(records, issues)``."""
+    data_dir = os.path.join(path, "data")
+    records: List[dict] = []
+    issues: List[str] = []
+    fnames = (
+        sorted(f for f in os.listdir(data_dir) if f.endswith(".avro"))
+        if os.path.isdir(data_dir)
+        else []
+    )
+    if not fnames:
+        issues.append("data/: no avro part files")
+        return records, issues
+    for fname in fnames:
+        fpath = os.path.join(data_dir, fname)
+        try:
+            schema, blocks, file_issues = avro.read_blocks_tolerant(fpath)
+        except Exception as exc:
+            issues.append(f"{fname}: unreadable container ({exc})")
+            continue
+        issues.extend(file_issues)
+        for bi, (count, body) in enumerate(blocks):
+            # each record costs >= 2 bytes (treeID varint + union index), so
+            # a count beyond the body length is corruption, not data — and
+            # bounding it here keeps a flipped varint from driving a huge
+            # decode loop
+            if count <= 0 or count > len(body):
+                issues.append(
+                    f"{fname} block {bi}: implausible record count {count}"
+                )
+                continue
+            reader = avro._Reader(body)
+            block_records: List[dict] = []
+            try:
+                for _ in range(count):
+                    block_records.append(avro.decode_value(schema, reader))
+                if reader.pos != len(body):
+                    raise ValueError(
+                        f"{len(body) - reader.pos} undecoded trailing bytes"
+                    )
+            except Exception as exc:
+                issues.append(f"{fname} block {bi}: corrupt records ({exc})")
+                continue
+            records.extend(block_records)
+    return records, issues
+
+
+_I32_MIN, _I32_MAX = -(2**31), 2**31 - 1
+
+
+def _tree_records_sane(records: List[dict], kind: str, max_k) -> None:
+    """Value-level sanity for one salvaged tree: every field must fit the
+    forest tensors (int32 ids/instances, finite floats, bounded hyperplane
+    width) — a tree that parses as Avro can still carry poisoned values."""
+    for r in records:
+        for field in ("id", "leftChild", "rightChild"):
+            v = r[field]
+            if not isinstance(v, int) or not _I32_MIN <= v <= _I32_MAX:
+                raise ValueError(f"{field}={v!r} out of range")
+        if not _I32_MIN <= r["numInstances"] <= _I32_MAX:
+            raise ValueError(f"numInstances={r['numInstances']!r} out of range")
+        internal = r["leftChild"] >= 0
+        if kind == "standard":
+            if internal and not np.isfinite(r["splitValue"]):
+                raise ValueError(f"non-finite splitValue {r['splitValue']!r}")
+            if internal and not 0 <= r["splitAttribute"] <= _I32_MAX:
+                raise ValueError(f"splitAttribute={r['splitAttribute']!r} invalid")
+        else:
+            if not np.isfinite(r["offset"]):
+                raise ValueError(f"non-finite offset {r['offset']!r}")
+            idx, w = r["indices"], r["weights"]
+            if len(idx) != len(w):
+                raise ValueError("indices/weights length mismatch")
+            if max_k is not None and len(idx) > max_k:
+                raise ValueError(
+                    f"hyperplane width {len(idx)} exceeds the model's "
+                    f"feature count {max_k}"
+                )
+            if internal and (
+                any(not 0 <= i <= _I32_MAX for i in idx)
+                or any(not np.isfinite(v) for v in w)
+            ):
+                raise ValueError("corrupt hyperplane coordinates")
+
+
+def _salvage_trees(records: List[dict], payload_field: str, kind: str, expected, max_k):
+    """Group records by treeID, keeping only trees that fully validate
+    (contiguous pre-order ids, bounded depth, sane values). Returns
+    ``({tree_id: sorted records}, issues)``."""
+    trees: dict = {}
+    malformed = 0
+    for rec in records:
+        try:
+            tid = rec["treeID"]
+            payload = rec[payload_field]
+            if payload is None:
+                raise ValueError("null node payload")
+            if not isinstance(tid, int) or tid < 0 or tid > _I32_MAX:
+                raise ValueError(f"bad treeID {tid!r}")
+            if expected is not None and tid >= expected:
+                raise ValueError(f"phantom treeID {tid} >= numEstimators")
+            trees.setdefault(tid, []).append(payload)
+        except Exception:
+            malformed += 1
+    issues = [f"{malformed} malformed node records discarded"] if malformed else []
+    good: dict = {}
+    for tid in sorted(trees):
+        recs = sorted(trees[tid], key=lambda r: r.get("id", -1))
+        try:
+            _tree_records_sane(recs, kind, max_k)
+            _assign_heap_slots(recs)
+        except Exception as exc:
+            # repr, not str: a KeyError('999') from a dangling child pointer
+            # stringifies to just "999"
+            issues.append(f"tree {tid}: {exc!r}")
+            continue
+        good[tid] = recs
+    return good, issues
+
+
+def _load_forest_tolerant(
+    path: str, payload_field: str, kind: str, to_forest, expected, max_k, pre_issues
+):
+    """The ``on_corrupt="drop"`` load path: salvage intact trees, rebuild a
+    valid smaller forest, and report exactly what was lost.
+
+    The rebuilt forest's scoring normalisation rescales automatically —
+    path lengths average over ``forest.num_trees`` (the survivors), so the
+    score ``2^(-E[h]/c(n))`` stays well-formed at the reduced ensemble size
+    (the bounded-quality-impact degraded mode of FastForest, arxiv
+    2004.02423).
+    """
+    records, issues = _read_data_tolerant(path)
+    issues = list(pre_issues) + issues
+    good, tree_issues = _salvage_trees(records, payload_field, kind, expected, max_k)
+    issues += tree_issues
+    kept_ids = sorted(good)
+    if not kept_ids:
+        raise ValueError(
+            f"no usable tree data under {path} even with on_corrupt='drop': "
+            + "; ".join(issues[:10])
+        )
+    if expected is not None:
+        dropped = tuple(sorted(set(range(expected)) - set(kept_ids)))
+    else:
+        dropped = ()
+    forest = to_forest([good[t] for t in kept_ids])
+    if dropped or issues:
+        degrade(
+            "dropped_trees",
+            f"{expected if expected is not None else '?'}-tree forest",
+            f"{len(kept_ids)}-tree forest",
+            detail=(
+                f"loaded {path} in degraded mode: kept {len(kept_ids)} trees"
+                + (
+                    f", dropped tree ids {list(dropped)}"
+                    if dropped
+                    else ""
+                )
+                + (f"; issues: {'; '.join(issues[:5])}" if issues else "")
+                + " — scoring normalisation rescales to the surviving trees"
+            ),
+        )
+    report = LoadReport(
+        path=path,
+        expected_trees=expected,
+        kept_trees=len(kept_ids),
+        dropped_tree_ids=dropped,
+        issues=tuple(issues),
+    )
+    return forest, report
 
 
 # --------------------------------------------------------------------------- #
@@ -654,26 +977,28 @@ def _fast_standard_body(forest):
 
 
 def save_standard_model(model, path: str, overwrite: bool = False) -> None:
-    _prepare_dir(path, overwrite)
-    _write_metadata(path, _model_metadata(model, STANDARD_MODEL_CLASS))
-    fast = _fast_standard_body(model.forest)
-    if fast is not None:
-        _write_data_raw(path, STANDARD_SCHEMA, *fast)
-        logger.info(
-            "saved IsolationForestModel (%d trees) to %s (native encoder)",
-            model.forest.num_trees,
-            path,
-        )
-        return
-    feature = np.asarray(model.forest.feature)
-    threshold = np.asarray(model.forest.threshold)
-    num_instances = np.asarray(model.forest.num_instances)
-    records = []
-    for t in range(model.forest.num_trees):
-        for node in standard_tree_to_records(feature[t], threshold[t], num_instances[t]):
-            records.append({"treeID": t, "nodeData": node})
-    _write_data(path, STANDARD_SCHEMA, records)
-    logger.info("saved IsolationForestModel (%d trees) to %s", len(feature), path)
+    with _atomic_dir(path, overwrite) as tmp:
+        _write_metadata(tmp, _model_metadata(model, STANDARD_MODEL_CLASS))
+        fast = _fast_standard_body(model.forest)
+        if fast is not None:
+            _write_data_raw(tmp, STANDARD_SCHEMA, *fast)
+        else:
+            feature = np.asarray(model.forest.feature)
+            threshold = np.asarray(model.forest.threshold)
+            num_instances = np.asarray(model.forest.num_instances)
+            records = []
+            for t in range(model.forest.num_trees):
+                for node in standard_tree_to_records(
+                    feature[t], threshold[t], num_instances[t]
+                ):
+                    records.append({"treeID": t, "nodeData": node})
+            _write_data(tmp, STANDARD_SCHEMA, records)
+    logger.info(
+        "saved IsolationForestModel (%d trees) to %s%s",
+        model.forest.num_trees,
+        path,
+        " (native encoder)" if fast is not None else "",
+    )
 
 
 def _fast_extended_body(forest):
@@ -707,36 +1032,48 @@ def _fast_extended_body(forest):
 
 
 def save_extended_model(model, path: str, overwrite: bool = False) -> None:
-    _prepare_dir(path, overwrite)
-    meta = _model_metadata(model, EXTENDED_MODEL_CLASS)
-    # resolved extensionLevel always persists on the model (even when the
-    # estimator left it unset — ExtendedIsolationForest.scala:102)
-    meta["paramMap"]["extensionLevel"] = int(model.extension_level)
-    _write_metadata(path, meta)
-    fast = _fast_extended_body(model.forest)
-    if fast is not None:
-        _write_data_raw(path, EXTENDED_SCHEMA, *fast)
-        logger.info(
-            "saved ExtendedIsolationForestModel (%d trees) to %s (native encoder)",
-            model.forest.num_trees,
-            path,
+    with _atomic_dir(path, overwrite) as tmp:
+        meta = _model_metadata(model, EXTENDED_MODEL_CLASS)
+        # resolved extensionLevel always persists on the model (even when the
+        # estimator left it unset — ExtendedIsolationForest.scala:102)
+        meta["paramMap"]["extensionLevel"] = int(model.extension_level)
+        _write_metadata(tmp, meta)
+        fast = _fast_extended_body(model.forest)
+        if fast is not None:
+            _write_data_raw(tmp, EXTENDED_SCHEMA, *fast)
+        else:
+            indices = np.asarray(model.forest.indices)
+            weights = np.asarray(model.forest.weights)
+            offset = np.asarray(model.forest.offset)
+            num_instances = np.asarray(model.forest.num_instances)
+            records = []
+            for t in range(model.forest.num_trees):
+                for node in extended_tree_to_records(
+                    indices[t], weights[t], offset[t], num_instances[t]
+                ):
+                    records.append({"treeID": t, "extendedNodeData": node})
+            _write_data(tmp, EXTENDED_SCHEMA, records)
+    logger.info(
+        "saved ExtendedIsolationForestModel (%d trees) to %s%s",
+        model.forest.num_trees,
+        path,
+        " (native encoder)" if fast is not None else "",
+    )
+
+
+def _load_common(
+    path: str,
+    expected_class: str,
+    verify="auto",
+    on_corrupt: str = "raise",
+    require_success: bool = True,
+):
+    if on_corrupt not in ("raise", "drop"):
+        raise ValueError(
+            f"on_corrupt must be 'raise' or 'drop', got {on_corrupt!r}"
         )
-        return
-    indices = np.asarray(model.forest.indices)
-    weights = np.asarray(model.forest.weights)
-    offset = np.asarray(model.forest.offset)
-    num_instances = np.asarray(model.forest.num_instances)
-    records = []
-    for t in range(model.forest.num_trees):
-        for node in extended_tree_to_records(
-            indices[t], weights[t], offset[t], num_instances[t]
-        ):
-            records.append({"treeID": t, "extendedNodeData": node})
-    _write_data(path, EXTENDED_SCHEMA, records)
-    logger.info("saved ExtendedIsolationForestModel (%d trees) to %s", len(indices), path)
-
-
-def _load_common(path: str, expected_class: str):
+    _check_model_dir(path, require_success)
+    data_issues = _verify_manifest(path, verify, on_corrupt)
     metadata = _read_metadata(path)
     _check_class(metadata, expected_class)
     if "totalNumFeatures" in metadata:
@@ -748,23 +1085,56 @@ def _load_common(path: str, expected_class: str):
             "validation disabled (sentinel -1)"
         )
         total_num_features = UNKNOWN_TOTAL_NUM_FEATURES
-    return metadata, total_num_features
+    return metadata, total_num_features, data_issues
 
 
-def load_standard_model(path: str):
+def _expected_trees(metadata: dict):
+    try:
+        n = int(metadata["paramMap"]["numEstimators"])
+        return n if n > 0 else None
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def load_standard_model(
+    path: str,
+    verify="auto",
+    on_corrupt: str = "raise",
+    require_success: bool = True,
+):
+    """Load a standard model. ``verify``/``on_corrupt``/``require_success``
+    are the resilience knobs (docs/resilience.md): manifest verification
+    mode, corrupt-tree policy (``"raise"`` | ``"drop"``), and the
+    ``_SUCCESS``-marker gate."""
     from ..models.isolation_forest import IsolationForestModel
 
-    metadata, total_num_features = _load_common(path, STANDARD_MODEL_CLASS)
+    metadata, total_num_features, data_issues = _load_common(
+        path, STANDARD_MODEL_CLASS, verify, on_corrupt, require_success
+    )
     params = IsolationForestParams.from_param_map(metadata["paramMap"])
-    try:  # native columnar fast path (~5x on 1000-tree models)
-        cols = _native_node_columns(path, "standard")
-    except (ImportError, OSError):
-        cols = None
-    if cols is not None:
-        forest = columns_to_standard_forest(cols)
+    load_report = None
+    if on_corrupt == "drop":
+        # tolerant pure-Python path: per-block + per-tree error containment
+        # (the native columnar decoder is all-or-nothing by design)
+        forest, load_report = _load_forest_tolerant(
+            path,
+            "nodeData",
+            "standard",
+            records_to_standard_forest,
+            _expected_trees(metadata),
+            None,
+            data_issues,
+        )
     else:
-        trees = _group_trees(_read_data(path), "nodeData")
-        forest = records_to_standard_forest(trees)
+        try:  # native columnar fast path (~5x on 1000-tree models)
+            cols = _native_node_columns(path, "standard")
+        except (ImportError, OSError):
+            cols = None
+        if cols is not None:
+            forest = columns_to_standard_forest(cols)
+        else:
+            trees = _group_trees(_read_data(path), "nodeData")
+            forest = records_to_standard_forest(trees)
     model = IsolationForestModel(
         forest=forest,
         params=params,
@@ -773,26 +1143,49 @@ def load_standard_model(path: str):
         total_num_features=total_num_features,
         uid=metadata.get("uid"),
     )
+    model.load_report = load_report
     threshold = float(metadata.get("outlierScoreThreshold", -1.0))
     if threshold >= 0:
         model.set_outlier_score_threshold(threshold)
     return model
 
 
-def load_extended_model(path: str):
+def load_extended_model(
+    path: str,
+    verify="auto",
+    on_corrupt: str = "raise",
+    require_success: bool = True,
+):
     from ..models.extended import ExtendedIsolationForestModel
 
-    metadata, total_num_features = _load_common(path, EXTENDED_MODEL_CLASS)
+    metadata, total_num_features, data_issues = _load_common(
+        path, EXTENDED_MODEL_CLASS, verify, on_corrupt, require_success
+    )
     params = ExtendedIsolationForestParams.from_param_map(metadata["paramMap"])
-    try:
-        cols = _native_node_columns(path, "extended")
-    except (ImportError, OSError):
-        cols = None
-    if cols is not None:
-        forest = columns_to_extended_forest(cols)
+    load_report = None
+    if on_corrupt == "drop":
+        # bound salvaged hyperplane width by the recorded feature count so a
+        # corrupt array length cannot force a huge [T, M, k] allocation
+        max_k = int(metadata.get("numFeatures", 0)) or None
+        forest, load_report = _load_forest_tolerant(
+            path,
+            "extendedNodeData",
+            "extended",
+            records_to_extended_forest,
+            _expected_trees(metadata),
+            max_k,
+            data_issues,
+        )
     else:
-        trees = _group_trees(_read_data(path), "extendedNodeData")
-        forest = records_to_extended_forest(trees)
+        try:
+            cols = _native_node_columns(path, "extended")
+        except (ImportError, OSError):
+            cols = None
+        if cols is not None:
+            forest = columns_to_extended_forest(cols)
+        else:
+            trees = _group_trees(_read_data(path), "extendedNodeData")
+            forest = records_to_extended_forest(trees)
     model = ExtendedIsolationForestModel(
         forest=forest,
         params=params,
@@ -804,6 +1197,7 @@ def load_extended_model(path: str):
         total_num_features=total_num_features,
         uid=metadata.get("uid"),
     )
+    model.load_report = load_report
     threshold = float(metadata.get("outlierScoreThreshold", -1.0))
     if threshold >= 0:
         model.set_outlier_score_threshold(threshold)
@@ -816,18 +1210,22 @@ def load_extended_model(path: str):
 
 
 def save_estimator(estimator, path: str, class_name: str, overwrite: bool = False) -> None:
-    _prepare_dir(path, overwrite)
-    metadata = {
-        "class": class_name,
-        "timestamp": int(time.time() * 1000),
-        "sparkVersion": SPARK_VERSION_STRING,
-        "uid": estimator.uid,
-        "paramMap": estimator.params.to_param_map(),
-    }
-    _write_metadata(path, metadata)
+    with _atomic_dir(path, overwrite) as tmp:
+        metadata = {
+            "class": class_name,
+            "timestamp": int(time.time() * 1000),
+            "sparkVersion": SPARK_VERSION_STRING,
+            "uid": estimator.uid,
+            "paramMap": estimator.params.to_param_map(),
+        }
+        _write_metadata(tmp, metadata)
 
 
-def load_estimator(path: str, params_cls, expected_class: str):
+def load_estimator(
+    path: str, params_cls, expected_class: str, require_success: bool = True
+):
+    _check_model_dir(path, require_success, expect_data=False)
+    _verify_manifest(path, "auto", "raise")
     metadata = _read_metadata(path)
     _check_class(metadata, expected_class)
     params = params_cls.from_param_map(metadata["paramMap"])

@@ -60,9 +60,11 @@ class ExtendedIsolationForest(_ParamSetters):
     def set_extension_level(self, v: int):
         return self._set(extension_level=v)
 
-    def fit(self, data, mesh=None) -> "ExtendedIsolationForestModel":
+    def fit(
+        self, data, mesh=None, nonfinite: str = "warn"
+    ) -> "ExtendedIsolationForestModel":
         p = self.params
-        X, _ = extract_features(data, p.features_col)
+        X, _ = extract_features(data, p.features_col, nonfinite=nonfinite)
         total_rows, total_feats = int(X.shape[0]), int(X.shape[1])
         resolved = resolve_params(p, total_feats, total_rows)
         ext_level = resolve_extension_level(p.extension_level, resolved.num_features)
@@ -173,7 +175,20 @@ class ExtendedIsolationForestModel(IsolationForestModel):
         save_extended_model(self, path, overwrite=overwrite)
 
     @classmethod
-    def load(cls, path: str) -> "ExtendedIsolationForestModel":
+    def load(
+        cls,
+        path: str,
+        verify="auto",
+        on_corrupt: str = "raise",
+        require_success: bool = True,
+    ) -> "ExtendedIsolationForestModel":
+        """Load with integrity verification; same resilience knobs as
+        :meth:`IsolationForestModel.load` (docs/resilience.md)."""
         from ..io.persistence import load_extended_model
 
-        return load_extended_model(path)
+        return load_extended_model(
+            path,
+            verify=verify,
+            on_corrupt=on_corrupt,
+            require_success=require_success,
+        )

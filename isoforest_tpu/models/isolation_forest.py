@@ -26,6 +26,7 @@ from ..ops.tree_growth import StandardForest, grow_forest_fused
 from ..utils import (
     IsolationForestParams,
     UNKNOWN_TOTAL_NUM_FEATURES,
+    check_non_finite,
     extract_features,
     height_limit,
     logger,
@@ -90,12 +91,17 @@ class IsolationForest(_ParamSetters):
         self.params = params if params is not None else IsolationForestParams(**kw)
         self.uid = uid or _new_uid("isolation-forest")
 
-    def fit(self, data, mesh=None) -> "IsolationForestModel":
+    def fit(self, data, mesh=None, nonfinite: str = "warn") -> "IsolationForestModel":
         """Train. With ``mesh`` (a `jax.sharding.Mesh` with a ``'trees'`` axis),
         tree growth is sharded across devices (SURVEY.md §2.4 tree parallelism);
-        otherwise a single-device vmap over the tree axis."""
+        otherwise a single-device vmap over the tree axis.
+
+        ``nonfinite`` is the NaN/inf input policy: ``"warn"`` (default,
+        matching historical behaviour), ``"raise"``, or ``"allow"`` —
+        non-finite features poison per-node min/max statistics during
+        growth, so strict pipelines should pick ``"raise"``."""
         p = self.params
-        X, _ = extract_features(data, p.features_col)
+        X, _ = extract_features(data, p.features_col, nonfinite=nonfinite)
         total_rows, total_feats = int(X.shape[0]), int(X.shape[1])
         resolved = resolve_params(p, total_feats, total_rows)
         logger.info(
@@ -178,7 +184,8 @@ def _compute_and_set_threshold(model, Xd, mesh=None) -> None:
     if p.contamination == 0.0:
         return
     with phase("isolation_forest.fit.threshold"):
-        scores = model.score(np.asarray(Xd), mesh=mesh)
+        # nonfinite policy already applied at fit's extract_features
+        scores = model.score(np.asarray(Xd), mesh=mesh, nonfinite="allow")
         thr = contamination_threshold(scores, p.contamination, p.contamination_error)
         model.set_outlier_score_threshold(thr)
         observed = observed_contamination(scores, thr)
@@ -224,6 +231,9 @@ class IsolationForestModel:
         self.total_num_features = int(total_num_features)
         self.outlier_score_threshold = float(outlier_score_threshold)
         self.uid = uid or _new_uid("isolation-forest")
+        # set by degraded (on_corrupt="drop") loads: which trees were lost
+        # (resilience.LoadReport); None for fits and clean loads
+        self.load_report = None
         # packed scoring layout (ops.scoring_layout): built eagerly by
         # fit()/finalize_scoring(), lazily on first score for persisted
         # models — the on-disk format stays the reference Avro node arrays
@@ -258,9 +268,18 @@ class IsolationForestModel:
         self._scoring_layout = get_layout(self.forest, num_features=width)
         return self
 
-    def score(self, X, mesh=None) -> np.ndarray:
-        """Outlier scores ``2^(-E[h(x)]/c(n))`` for an ``[N, F]`` matrix."""
+    def score(
+        self, X, mesh=None, strict: bool = False, nonfinite: str = "warn"
+    ) -> np.ndarray:
+        """Outlier scores ``2^(-E[h(x)]/c(n))`` for an ``[N, F]`` matrix.
+
+        ``strict=True`` raises
+        :class:`~isoforest_tpu.resilience.DegradationError` instead of
+        silently falling back when the resolved scoring strategy cannot run
+        (docs/resilience.md). ``nonfinite``: NaN/inf policy
+        (``"warn"``/``"raise"``/``"allow"``)."""
         X = np.asarray(X, np.float32)
+        check_non_finite(X, nonfinite)
         validate_feature_vector_size(X.shape[1], self.total_num_features)
         if mesh is not None:
             from ..parallel.sharded import sharded_score
@@ -268,9 +287,28 @@ class IsolationForestModel:
             return sharded_score(mesh, self.forest, X, self.num_samples)
         if self._scoring_layout is None:
             self.finalize_scoring()
-        return score_matrix(
-            self.forest, X, self.num_samples, layout=self._scoring_layout
+        expected = (
+            self.total_num_features
+            if self.total_num_features != UNKNOWN_TOTAL_NUM_FEATURES
+            else None
         )
+        return score_matrix(
+            self.forest,
+            X,
+            self.num_samples,
+            layout=self._scoring_layout,
+            strict=strict,
+            expected_features=expected,
+        )
+
+    def degradations(self):
+        """Structured degradation events recorded in this process (the
+        unified ladder, docs/resilience.md): every scoring fallback plus any
+        dropped-tree load. Model-specific load details live in
+        ``self.load_report``."""
+        from ..resilience import degradations as _degradations
+
+        return _degradations()
 
     def warmup(
         self,
@@ -326,7 +364,7 @@ class IsolationForestModel:
             return (scores >= self.outlier_score_threshold).astype(np.float64)
         return np.zeros_like(scores, dtype=np.float64)
 
-    def transform(self, data, mesh=None):
+    def transform(self, data, mesh=None, nonfinite: str = "warn"):
         """Append score + label columns (IsolationForestModel.scala:116-151).
 
         DataFrame in -> DataFrame out (with ``scoreCol``/``predictionCol``
@@ -334,9 +372,12 @@ class IsolationForestModel:
         """
         p = self.params
         X, frame = extract_features(
-            data, p.features_col, output_cols=(p.score_col, p.prediction_col)
+            data,
+            p.features_col,
+            output_cols=(p.score_col, p.prediction_col),
+            nonfinite=nonfinite,
         )
-        scores = self.score(X, mesh=mesh)
+        scores = self.score(X, mesh=mesh, nonfinite="allow")  # checked above
         labels = self.predict(scores)
         if frame is not None:
             out = frame.copy()
@@ -355,7 +396,23 @@ class IsolationForestModel:
         save_standard_model(self, path, overwrite=overwrite)
 
     @classmethod
-    def load(cls, path: str) -> "IsolationForestModel":
+    def load(
+        cls,
+        path: str,
+        verify="auto",
+        on_corrupt: str = "raise",
+        require_success: bool = True,
+    ) -> "IsolationForestModel":
+        """Load with integrity verification (docs/resilience.md): ``verify``
+        the ``_MANIFEST.json`` checksums (``"auto"``/``True``/``False``),
+        ``on_corrupt`` in ``{"raise", "drop"}`` (drop salvages intact trees
+        into a valid smaller forest and records ``model.load_report``), and
+        ``require_success`` gates on the ``_SUCCESS`` seal markers."""
         from ..io.persistence import load_standard_model
 
-        return load_standard_model(path)
+        return load_standard_model(
+            path,
+            verify=verify,
+            on_corrupt=on_corrupt,
+            require_success=require_success,
+        )

@@ -124,6 +124,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
 def get_library() -> Optional[ctypes.CDLL]:
     """The bound native library, building it if needed; None if unavailable."""
     global _lib, _build_failed
+    from ..resilience import faults
+
+    if faults.native_hidden():
+        # fault-injection seam (resilience/faults.py): report unavailable
+        # WITHOUT touching the build/bind cache, so behaviour is restored
+        # the moment the fault is disarmed
+        return None
     if _lib is not None:
         return _lib
     if _build_failed or os.environ.get("ISOFOREST_TPU_NO_NATIVE"):

@@ -33,7 +33,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..resilience import faults
+from ..resilience.degradation import degrade
 from ..utils.math import avg_path_length, height_of as _height_of, score_from_path_length
+from ..utils.validation import validate_feature_vector_size
 from .ext_growth import ExtendedForest
 from .scoring_layout import (
     PackedStandardLayout,
@@ -153,8 +156,19 @@ def _walk_one_extended(packed: jax.Array, X: jax.Array, h: int, k: int) -> jax.A
     return out
 
 
+def _validate_width_host(forest, X, expected: int | None) -> None:
+    """Width check for the path-length entry points: only when the input is
+    a host array (inside jit/shard_map traces X is a tracer and the check
+    already ran — or could not run — at the score_matrix boundary)."""
+    if isinstance(X, np.ndarray):
+        _validate_width(forest, int(X.shape[1]), expected)
+
+
 def standard_path_lengths(
-    forest: StandardForest, X: jax.Array, layout: PackedStandardLayout | None = None
+    forest: StandardForest,
+    X: jax.Array,
+    layout: PackedStandardLayout | None = None,
+    expected_features: int | None = None,
 ) -> jax.Array:
     """Per-row mean path length over the forest; ``f32[C]`` for ``X: f32[C, F]``.
 
@@ -162,6 +176,7 @@ def standard_path_lengths(
     jnp, so this stays legal — and the packed buffer stays sharded — inside
     ``jit``/``shard_map`` regions).
     """
+    _validate_width_host(forest, X, expected_features)
     if layout is None:
         layout = pack_forest(forest)
     h = _height_of(forest.max_nodes)
@@ -174,10 +189,11 @@ def standard_path_lengths(
 
 
 def extended_path_lengths(
-    forest: ExtendedForest, X: jax.Array, layout=None
+    forest: ExtendedForest, X: jax.Array, layout=None, expected_features: int | None = None
 ) -> jax.Array:
     """EIF variant: hyperplane test ``dot(x, w) < offset`` -> left
     (ExtendedIsolationTree.scala:333-355, float32 dot per ExtendedUtils.scala:46-55)."""
+    _validate_width_host(forest, X, expected_features)
     if layout is None:
         layout = pack_forest(forest)
     h = _height_of(forest.max_nodes)
@@ -190,10 +206,12 @@ def extended_path_lengths(
     )
 
 
-def path_lengths(forest, X: jax.Array, layout=None) -> jax.Array:
+def path_lengths(
+    forest, X: jax.Array, layout=None, expected_features: int | None = None
+) -> jax.Array:
     if isinstance(forest, StandardForest):
-        return standard_path_lengths(forest, X, layout)
-    return extended_path_lengths(forest, X, layout)
+        return standard_path_lengths(forest, X, layout, expected_features)
+    return extended_path_lengths(forest, X, layout, expected_features)
 
 
 # Per-backend winners for strategy="auto", both MEASURED. CPU: the
@@ -224,10 +242,42 @@ PALLAS_MAX_ROWS = 1 << 18
 
 STRATEGIES = ("gather", "dense", "pallas", "walk", "native")
 
-_warned_native_fallback = False
-_warned_eif_pallas_fence = False
-_warned_walk_unsupported = False
-_warned_walk_interpret = False
+# Forest -> minimum input width (1 + max referenced feature id), cached by
+# array identity: serving loops score small batches in a tight loop and the
+# [T, M] reduction (plus a device->host copy for jax-resident forests) must
+# not re-run per call. Bounded FIFO, same policy as the native prep cache.
+_MIN_FEATURES_CACHE: dict = {}
+_MIN_FEATURES_CACHE_MAX = 16
+
+
+def forest_min_features(forest) -> int:
+    """Smallest feature-vector width the forest can traverse without an
+    out-of-range gather: ``1 + max(feature id)`` (0 for all-leaf forests)."""
+    ids = forest.feature if isinstance(forest, StandardForest) else forest.indices
+    key = id(ids)
+    hit = _MIN_FEATURES_CACHE.get(key)
+    if hit is not None and hit[0] is ids:
+        return hit[1]
+    width = int(np.max(np.asarray(ids))) + 1 if np.asarray(ids).size else 0
+    width = max(width, 0)  # all-leaf forests hold only -1 sentinels
+    if len(_MIN_FEATURES_CACHE) >= _MIN_FEATURES_CACHE_MAX:
+        _MIN_FEATURES_CACHE.pop(next(iter(_MIN_FEATURES_CACHE)))
+    _MIN_FEATURES_CACHE[key] = (ids, width)
+    return width
+
+
+def _validate_width(forest, num_features: int, expected: int | None) -> None:
+    """Wrong-width X must raise a clear host-side error before dispatch, not
+    an XLA shape error (or a silently clamped gather) deep in a kernel."""
+    if expected is not None:
+        validate_feature_vector_size(num_features, expected)
+    floor = forest_min_features(forest)
+    if num_features < floor:
+        raise ValueError(
+            f"feature vector has {num_features} features, but the forest "
+            f"splits on feature index {floor - 1} — the model was trained on "
+            f">= {floor} features"
+        )
 
 
 def _live_platform() -> str:
@@ -327,6 +377,8 @@ def score_matrix(
     chunk_size: int | None = None,
     strategy: str = "auto",
     layout=None,
+    strict: bool = False,
+    expected_features: int | None = None,
 ) -> np.ndarray:
     """Score a full ``[N, F]`` matrix, chunked along rows.
 
@@ -365,25 +417,36 @@ def score_matrix(
     (:func:`~isoforest_tpu.ops.scoring_layout.pack_forest`); ``None``
     resolves the per-forest cache (:func:`.scoring_layout.get_layout`).
     The full strategy-selection table lives in docs/scoring_layout.md.
+
+    ``strict=True`` raises :class:`~isoforest_tpu.resilience.DegradationError`
+    wherever the resolved strategy would otherwise fall back to a different
+    one (the degradation ladder, docs/resilience.md) — for serving stacks
+    whose latency SLO depends on the pinned kernel actually running.
+    ``expected_features`` (the fitted model's recorded width) turns a
+    wrong-width ``X`` into an immediate ValueError; independent of it, a
+    matrix narrower than the forest's highest split feature is always
+    refused before dispatch.
     """
     if not isinstance(X, (np.ndarray, jax.Array)):
         X = np.asarray(X, np.float32)
     n = X.shape[0]
+    _validate_width(forest, int(X.shape[1]), expected_features)
     extended = not isinstance(forest, StandardForest)
     if strategy == "auto":
         strategy = os.environ.get("ISOFOREST_TPU_STRATEGY") or default_strategy(
             num_rows=n, extended=extended
         )
         if strategy not in STRATEGIES:
-            from ..utils import logger
-
-            logger.warning(
-                "ISOFOREST_TPU_STRATEGY=%r is not one of %s; using %s",
-                strategy,
-                "/".join(STRATEGIES),
+            strategy = degrade(
+                "env_strategy_unknown",
+                repr(strategy),
                 default_strategy(num_rows=n, extended=extended),
+                detail=(
+                    f"ISOFOREST_TPU_STRATEGY={strategy!r} is not one of "
+                    f"{'/'.join(STRATEGIES)}; using the per-backend default"
+                ),
+                strict=strict,
             )
-            strategy = default_strategy(num_rows=n, extended=extended)
     if strategy not in STRATEGIES:
         raise ValueError(
             f"unknown scoring strategy {strategy!r}; expected one of "
@@ -397,43 +460,39 @@ def score_matrix(
         ):
             # Off-TPU the walk kernel can only run in Pallas interpret mode
             # — minutes per rep, never what an operator pinning
-            # ISOFOREST_TPU_STRATEGY=walk on a CPU host meant. Warn once
-            # and take the portable gather path, mirroring the
-            # native-unavailable fallback below. CI's kernel-equivalence
-            # tests opt back into interpret mode via
+            # ISOFOREST_TPU_STRATEGY=walk on a CPU host meant. Take the
+            # portable gather path through the ladder. CI's
+            # kernel-equivalence tests opt back into interpret mode via
             # ISOFOREST_TPU_INTERPRET=1 (tests/conftest.py).
-            global _warned_walk_interpret
-            if not _warned_walk_interpret:
-                _warned_walk_interpret = True
-                from ..utils import logger
-
-                logger.warning(
+            strategy = degrade(
+                "walk_off_tpu",
+                "walk",
+                "gather",
+                detail=(
                     "strategy='walk' requires a TPU backend (off-TPU it "
                     "would run the Pallas kernel in interpret mode, minutes "
                     "per batch); scoring with the gather strategy instead. "
                     "Set ISOFOREST_TPU_INTERPRET=1 to force interpret mode."
-                )
-            strategy = "gather"
+                ),
+                strict=strict,
+            )
         else:
             reason = pallas_walk.unsupported_reason(forest)
             if reason is not None:
                 # wide-k EIF hyperplanes (the gather+fma chain stops
                 # paying) or node tables past the VMEM budget (Mosaic
                 # compilation would fail outright): dense keeps
-                # HIGHEST-precision semantics. Warn once so pinned
-                # measurements are never silently mislabeled (same contract
-                # as the pallas fence / native fallback below).
-                global _warned_walk_unsupported
-                if not _warned_walk_unsupported:
-                    _warned_walk_unsupported = True
-                    from ..utils import logger
-
-                    logger.warning(
-                        "strategy='walk' does not cover this forest (%s); "
-                        "scoring with the dense strategy instead",
-                        reason,
-                    )
-                strategy = "dense"
+                # HIGHEST-precision semantics.
+                strategy = degrade(
+                    "walk_unsupported",
+                    "walk",
+                    "dense",
+                    detail=(
+                        f"strategy='walk' does not cover this forest "
+                        f"({reason}); scoring with the dense strategy instead"
+                    ),
+                    strict=strict,
+                )
     if strategy == "pallas" and extended and _live_platform() == "tpu":
         # Precision fence (VERDICT r2 item 4 / ADVICE r2 medium): the EIF
         # Pallas kernels' hyperplane contractions run at the TPU's default
@@ -444,33 +503,35 @@ def score_matrix(
         # on the dense path before its r2 fix. CI's interpret-mode (CPU)
         # equivalence runs are exact f32 and cannot catch it, so real-TPU
         # extended scoring routes to the dense HIGHEST-precision path.
-        global _warned_eif_pallas_fence
-        if not _warned_eif_pallas_fence:
-            _warned_eif_pallas_fence = True
-            from ..utils import logger
-
-            logger.warning(
+        strategy = degrade(
+            "eif_pallas_fence",
+            "pallas",
+            "dense",
+            detail=(
                 "strategy='pallas' for extended forests is fenced on TPU: "
                 "the kernel's hyperplane matmul runs at bf16-mantissa "
                 "precision on the current toolchain (measured error class: "
                 "up to 0.24 path-length deviation); scoring with the dense "
                 "HIGHEST-precision path instead"
-            )
-        strategy = "dense"
+            ),
+            strict=strict,
+        )
     if strategy == "native":
+        faults.check_strategy("native")
         out = _score_native(forest, X, num_samples)
         if out is not None:
             return out
-        global _warned_native_fallback
-        if not _warned_native_fallback:  # once, not per serving-loop call
-            _warned_native_fallback = True
-            from ..utils import logger
-
-            logger.warning(
+        strategy = degrade(
+            "native_unavailable",
+            "native",
+            "gather",
+            detail=(
                 "native scoring strategy unavailable (no C++ toolchain?); "
                 "falling back to the ~4x-slower gather kernel"
-            )
-        strategy = "gather"
+            ),
+            strict=strict,
+        )
+    faults.check_strategy(strategy)
     if strategy == "pallas":
         from .pallas_traversal import path_lengths_pallas
 

@@ -19,11 +19,9 @@ from jax.sharding import PartitionSpec as P
 from ..ops.ext_growth import ExtendedForest, grow_extended_forest
 from ..ops.traversal import path_lengths
 from ..ops.tree_growth import StandardForest, grow_forest
+from ..resilience.degradation import degrade
 from ..utils.math import score_from_path_length
 from .mesh import DATA_AXIS, TREES_AXIS, shard_map_compat
-
-
-_warned_ineligible_pin = False
 
 
 def resolve_jittable_strategy(mesh, score_strategy: str = "auto"):
@@ -49,20 +47,22 @@ def resolve_jittable_strategy(mesh, score_strategy: str = "auto"):
         if pinned in ("gather", "dense"):
             score_strategy = pinned
         else:
-            if pinned:
-                global _warned_ineligible_pin
-                if not _warned_ineligible_pin:
-                    _warned_ineligible_pin = True
-                    from ..utils import logger
-
-                    logger.warning(
-                        "ISOFOREST_TPU_STRATEGY=%r is not eligible inside "
-                        "shard_map programs (gather/dense only); sharded "
-                        "scoring resolves its own per-platform default",
-                        pinned,
-                    )
             platform = next(iter(mesh.devices.flat)).platform
-            score_strategy = "dense" if platform == "tpu" else "gather"
+            default = "dense" if platform == "tpu" else "gather"
+            if pinned:
+                # ineligible pin: warned once + recorded through the ladder,
+                # so a pinned measurement is never silently mislabeled
+                degrade(
+                    "shard_pin_ineligible",
+                    repr(pinned),
+                    default,
+                    detail=(
+                        f"ISOFOREST_TPU_STRATEGY={pinned!r} is not eligible "
+                        "inside shard_map programs (gather/dense only); "
+                        "sharded scoring resolves its own per-platform default"
+                    ),
+                )
+            score_strategy = default
     if score_strategy not in ("gather", "dense"):
         raise ValueError(
             f"score_strategy must be 'auto', 'gather' or 'dense' (jittable "

@@ -1,0 +1,51 @@
+"""Resilience layer: degradation ladder, fault injection, model integrity.
+
+The ROADMAP north star is serving heavy traffic; at that scale a torn write,
+a corrupt Avro block, a missing native ``.so`` or a fenced kernel must degrade
+predictably and observably — not crash or silently change behaviour. Three
+coordinated pieces:
+
+* :mod:`.degradation` — the unified degradation ladder. Every runtime
+  fallback (native→gather, walk→gather/dense, the EIF Pallas precision
+  fence, shard_map-ineligible strategy pins, dropped-tree loads) routes
+  through one :func:`degrade` call that logs once, records a structured
+  event, and raises :class:`DegradationError` under ``strict=True``.
+* :mod:`.manifest` — ``_MANIFEST.json`` written atomically with every model
+  directory: per-file size + CRC32 + SHA-256 so loads verify integrity
+  before parsing a byte of Avro.
+* :mod:`.faults` — fault-injection harness (context manager +
+  ``ISOFOREST_TPU_FAULTS`` env hook) that can corrupt Avro bytes on read,
+  truncate data part files, hide the native extension, and force a named
+  scoring strategy to raise — used by ``tests/test_resilience.py`` to prove
+  every failure path lands on its documented rung.
+
+The ladder itself (every rung, trigger, and parity guarantee) is documented
+in ``docs/resilience.md``.
+"""
+
+from . import faults, manifest
+from .degradation import (
+    LADDER,
+    DegradationError,
+    DegradationEvent,
+    DegradationReport,
+    LoadReport,
+    degradation_report,
+    degradations,
+    degrade,
+    reset_degradations,
+)
+
+__all__ = [
+    "faults",
+    "manifest",
+    "LADDER",
+    "DegradationError",
+    "DegradationEvent",
+    "DegradationReport",
+    "LoadReport",
+    "degradation_report",
+    "degradations",
+    "degrade",
+    "reset_degradations",
+]

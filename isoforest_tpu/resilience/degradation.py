@@ -1,0 +1,218 @@
+"""Unified degradation ladder.
+
+Before this module every fallback in the scoring stack kept its own
+module-level ``_warned_*`` boolean: four in ``ops/traversal.py``, one in
+``parallel/sharded.py`` — five ad-hoc once-flags, none queryable, none
+visible to ``bench.py`` or a serving operator. The reference library at
+least funnels its partial/legacy tolerance through explicit log lines
+(IsolationForestModelReadWrite.scala:298-306); at serving scale that is the
+minimum bar: a fallback must be *observable*, not just survivable.
+
+Here every fallback goes through :func:`degrade`:
+
+* the event is recorded in a process-wide :class:`DegradationReport`
+  (queryable via :func:`degradations` / ``model.degradations()``, dumped by
+  ``bench.py``), with a per-reason occurrence count;
+* the warning is logged exactly once per reason (until
+  :func:`reset_degradations`), preserving the old once-flag contract;
+* under ``strict=True`` (``score_matrix(strict=True)``) the fallback
+  RAISES :class:`DegradationError` instead — serving stacks that pin a
+  strategy for latency SLOs must fail loudly, never silently run a
+  different kernel.
+
+Each rung's trigger and parity guarantee is documented in :data:`LADDER`
+and prose-form in ``docs/resilience.md``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.logging import logger
+
+# The documented ladder: reason -> (parity guarantee) — one row per rung.
+# degrade() refuses unknown reasons so a typo cannot create an untracked,
+# undocumented rung. Keep this table in sync with docs/resilience.md.
+LADDER: Dict[str, str] = {
+    # scoring-strategy rungs (ops/traversal.py)
+    "native_unavailable": (
+        "native -> gather: scores agree to f32 tolerance; EIF exact ties may "
+        "route differently (PARITY.md bounded deviation class)"
+    ),
+    "walk_off_tpu": (
+        "walk -> gather off-TPU: bit-identical to an explicit gather run "
+        "(the gather kernel IS what executes)"
+    ),
+    "walk_unsupported": (
+        "walk -> dense (wide-k hyperplanes or VMEM-oversized tables): "
+        "bit-identical to an explicit dense run"
+    ),
+    "eif_pallas_fence": (
+        "pallas -> dense for extended forests on real TPU: dense keeps "
+        "HIGHEST-precision hyperplane contractions; the fenced kernel would "
+        "run bf16-mantissa matmuls (measured up to 0.24 path-length error)"
+    ),
+    "env_strategy_unknown": (
+        "unrecognised ISOFOREST_TPU_STRATEGY pin -> per-backend default: "
+        "scores are the default strategy's, within cross-strategy f32 "
+        "tolerance of any valid pin"
+    ),
+    # shard_map rung (parallel/sharded.py)
+    "shard_pin_ineligible": (
+        "ineligible ISOFOREST_TPU_STRATEGY pin inside shard_map -> "
+        "per-platform jittable default (gather/dense): scores within "
+        "cross-strategy f32 tolerance"
+    ),
+    # load-time rung (io/persistence.py, on_corrupt='drop')
+    "dropped_trees": (
+        "corrupt trees dropped at load -> valid smaller forest: path-length "
+        "normalisation rescales to the surviving tree count automatically "
+        "(score = 2^(-mean_h/c(n)) over kept trees); ensemble quality "
+        "degrades gracefully with lost trees (FastForest, arxiv 2004.02423)"
+    ),
+}
+
+
+class DegradationError(RuntimeError):
+    """A fallback was required but ``strict=True`` forbids silent fallback."""
+
+
+@dataclasses.dataclass
+class DegradationEvent:
+    """One recorded fallback: which rung, what it replaced, how often."""
+
+    reason: str
+    from_: str
+    to: str
+    detail: str
+    count: int = 1
+    first_unix_s: float = 0.0
+    last_unix_s: float = 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "reason": self.reason,
+            "from": self.from_,
+            "to": self.to,
+            "detail": self.detail,
+            "count": self.count,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadReport:
+    """Outcome of an ``on_corrupt="drop"`` model load: exactly which trees
+    were lost and why. Attached to the loaded model as ``model.load_report``
+    (None for clean strict loads)."""
+
+    path: str
+    expected_trees: Optional[int]
+    kept_trees: int
+    dropped_tree_ids: Tuple[int, ...]
+    issues: Tuple[str, ...]
+
+    def as_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "expected_trees": self.expected_trees,
+            "kept_trees": self.kept_trees,
+            "dropped_tree_ids": list(self.dropped_tree_ids),
+            "issues": list(self.issues),
+        }
+
+
+class DegradationReport:
+    """Registry of degradation events; one process-wide instance backs
+    :func:`degrade`. Thread-safe (serving stacks score from worker pools)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._events: Dict[str, DegradationEvent] = {}
+
+    def record(self, reason: str, from_: str, to: str, detail: str) -> bool:
+        """Record one occurrence; returns True when this is the first
+        occurrence since the last reset (i.e. the warning should log)."""
+        now = time.time()
+        with self._lock:
+            ev = self._events.get(reason)
+            if ev is None:
+                self._events[reason] = DegradationEvent(
+                    reason, from_, to, detail, 1, now, now
+                )
+                return True
+            ev.count += 1
+            ev.last_unix_s = now
+            ev.detail = detail
+            return False
+
+    def events(self) -> List[DegradationEvent]:
+        with self._lock:
+            return [dataclasses.replace(ev) for ev in self._events.values()]
+
+    def count(self, reason: str) -> int:
+        with self._lock:
+            ev = self._events.get(reason)
+            return ev.count if ev else 0
+
+    def reset(self, reason: Optional[str] = None) -> None:
+        with self._lock:
+            if reason is None:
+                self._events.clear()
+            else:
+                self._events.pop(reason, None)
+
+
+_REPORT = DegradationReport()
+
+
+def degradation_report() -> DegradationReport:
+    """The process-wide registry instance."""
+    return _REPORT
+
+
+def degradations() -> List[DegradationEvent]:
+    """Snapshot of every degradation recorded since process start / reset."""
+    return _REPORT.events()
+
+
+def reset_degradations(reason: Optional[str] = None) -> None:
+    """Clear recorded events (all, or one reason) — re-arms the log-once
+    warning for the cleared rungs. Intended for tests and long-lived
+    operators that sample-and-clear."""
+    _REPORT.reset(reason)
+
+
+def degrade(
+    reason: str,
+    from_: str,
+    to: str,
+    detail: str = "",
+    strict: bool = False,
+) -> str:
+    """Take one rung down the ladder; returns ``to`` for assignment style
+    ``strategy = degrade(...)``.
+
+    Logs the detail once per ``reason`` (until reset), records a structured
+    event every time, and raises :class:`DegradationError` instead when
+    ``strict`` — the caller must not fall back in that case.
+    """
+    if reason not in LADDER:
+        raise ValueError(
+            f"unknown degradation reason {reason!r}; known rungs: "
+            f"{', '.join(sorted(LADDER))} (add new rungs to "
+            "resilience.degradation.LADDER and docs/resilience.md)"
+        )
+    if strict:
+        raise DegradationError(
+            f"strict mode forbids the {reason!r} fallback ({from_} -> {to}): "
+            f"{detail or LADDER[reason]}"
+        )
+    first = _REPORT.record(reason, from_, to, detail)
+    if first:
+        logger.warning(
+            "degraded [%s] %s -> %s: %s", reason, from_, to, detail or LADDER[reason]
+        )
+    return to

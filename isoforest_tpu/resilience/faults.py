@@ -1,0 +1,173 @@
+"""Fault-injection harness.
+
+Production code consults this module at four narrow seams (each a no-op
+single dict lookup when no fault is armed):
+
+* ``io.avro.read_blocks`` -> :func:`filter_read_bytes` — ``corrupt_avro``
+  (flip a byte of a data part file on read) and ``truncate_data`` (read a
+  truncated prefix, the torn-download case);
+* ``native.get_library`` -> :func:`native_hidden` — ``hide_native`` makes
+  the C++ extension report unavailable (missing ``.so`` / no toolchain);
+* ``ops.traversal.score_matrix`` -> :func:`check_strategy` —
+  ``raise_strategy=<name>`` makes the named strategy raise
+  :class:`FaultInjectedError` at dispatch, proving kernel failures
+  propagate loudly instead of silently hopping rungs.
+
+Faults arm two ways: the :func:`inject` context manager (scoped, stackable,
+test-friendly) or the ``ISOFOREST_TPU_FAULTS`` environment variable
+(comma-separated ``name`` or ``name=value`` items, e.g.
+``ISOFOREST_TPU_FAULTS="corrupt_avro=200,hide_native"``) so subprocesses —
+CI's ASan sweep, ``tools/asan/corrupt_models.py`` — can arm faults without
+code changes.
+
+:func:`corrupt_file_on_disk` / :func:`truncate_file_on_disk` are the
+*persistent* variants (mutate the file once) used to exercise the manifest
+CRC layer, which by design cannot see read-time corruption.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Dict, List, Optional, Union
+
+FAULTS_ENV = "ISOFOREST_TPU_FAULTS"
+
+KNOWN_FAULTS = frozenset(
+    {"corrupt_avro", "truncate_data", "hide_native", "raise_strategy"}
+)
+
+FaultValue = Union[bool, int, str]
+
+
+class FaultInjectedError(RuntimeError):
+    """Raised by an armed ``raise_strategy`` fault at strategy dispatch."""
+
+
+_STACK: List[Dict[str, FaultValue]] = []
+
+
+def _parse_env() -> Dict[str, FaultValue]:
+    spec = os.environ.get(FAULTS_ENV, "")
+    out: Dict[str, FaultValue] = {}
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, _, value = item.partition("=")
+        out[name.strip()] = value.strip() if value else True
+    return out
+
+
+@contextlib.contextmanager
+def inject(**faults: FaultValue):
+    """Arm the given faults for the dynamic extent of the block::
+
+        with faults.inject(corrupt_avro=True, hide_native=True):
+            model = IsolationForestModel.load(path)   # sees the faults
+    """
+    unknown = set(faults) - KNOWN_FAULTS
+    if unknown:
+        raise ValueError(
+            f"unknown fault(s) {sorted(unknown)}; known: {sorted(KNOWN_FAULTS)}"
+        )
+    _STACK.append(dict(faults))
+    try:
+        yield
+    finally:
+        _STACK.pop()
+
+
+def get(name: str) -> Optional[FaultValue]:
+    """Active value for a fault: innermost :func:`inject` frame wins, then
+    the ``ISOFOREST_TPU_FAULTS`` environment; None when unarmed."""
+    for frame in reversed(_STACK):
+        if name in frame:
+            return frame[name]
+    return _parse_env().get(name)
+
+
+def active(name: str) -> bool:
+    value = get(name)
+    return value is not None and value is not False
+
+
+# --------------------------------------------------------------------------- #
+# seams consulted by production code
+# --------------------------------------------------------------------------- #
+
+
+def _flip_at(data: bytes, offset: int) -> bytes:
+    offset = max(0, min(offset, len(data) - 1))
+    out = bytearray(data)
+    out[offset] ^= 0x5A  # nonzero, so the byte always changes
+    return bytes(out)
+
+
+def filter_read_bytes(path: str, data: bytes) -> bytes:
+    """Apply read-time data-file faults to freshly read container bytes.
+    Targets ``.avro`` part files only — metadata corruption is a different
+    failure class with its own (always-fatal) handling."""
+    if not _STACK and FAULTS_ENV not in os.environ:
+        return data  # fast path: nothing armed anywhere
+    if not os.path.basename(path).endswith(".avro") or not data:
+        return data
+    corrupt = get("corrupt_avro")
+    if corrupt is not None and corrupt is not False:
+        # default lands ~3/4 in, well past the container header and inside
+        # the (usually single) record block
+        offset = int(corrupt) if str(corrupt).isdigit() else (len(data) * 3) // 4
+        data = _flip_at(data, offset)
+    truncate = get("truncate_data")
+    if truncate is not None and truncate is not False:
+        keep = int(truncate) if str(truncate).isdigit() else len(data) // 2
+        data = data[: max(1, min(keep, len(data)))]
+    return data
+
+
+def native_hidden() -> bool:
+    """True when the ``hide_native`` fault is armed — the native extension
+    must report unavailable without touching its build/bind cache."""
+    return active("hide_native")
+
+
+def check_strategy(strategy: str) -> None:
+    """Raise :class:`FaultInjectedError` when ``raise_strategy`` names the
+    strategy about to execute."""
+    target = get("raise_strategy")
+    if target is not None and str(target) == strategy:
+        raise FaultInjectedError(
+            f"injected fault: scoring strategy {strategy!r} forced to raise "
+            f"(raise_strategy={target!r})"
+        )
+
+
+# --------------------------------------------------------------------------- #
+# on-disk mutation helpers (tests / corrupt-corpus generation)
+# --------------------------------------------------------------------------- #
+
+
+def corrupt_file_on_disk(path: str, offset: Optional[int] = None) -> int:
+    """Flip one byte of ``path`` in place; returns the offset flipped.
+    Unlike the read-time fault this survives the process — it is what the
+    manifest CRC layer exists to catch."""
+    data = open(path, "rb").read()
+    if not data:
+        raise ValueError(f"cannot corrupt empty file {path}")
+    if offset is None:
+        offset = (len(data) * 3) // 4
+    mutated = _flip_at(data, offset)
+    with open(path, "wb") as fh:
+        fh.write(mutated)
+    return max(0, min(offset, len(data) - 1))
+
+
+def truncate_file_on_disk(path: str, keep: Optional[int] = None) -> int:
+    """Truncate ``path`` in place (default: half); returns the kept size."""
+    size = os.path.getsize(path)
+    if keep is None:
+        keep = size // 2
+    keep = max(1, min(keep, size))
+    with open(path, "rb+") as fh:
+        fh.truncate(keep)
+    return keep

@@ -49,6 +49,7 @@ class TpuIsolationForest(BaseEstimator, OutlierMixin):
         bootstrap: bool = False,
         random_state: int = 1,
         extension_level: Optional[int] = None,
+        nonfinite: str = "warn",
     ):
         self.n_estimators = n_estimators
         self.max_samples = max_samples
@@ -58,6 +59,9 @@ class TpuIsolationForest(BaseEstimator, OutlierMixin):
         self.bootstrap = bootstrap
         self.random_state = random_state
         self.extension_level = extension_level
+        # NaN/inf input policy ("warn"/"raise"/"allow"), threaded to
+        # fit/score (utils.validation.check_non_finite)
+        self.nonfinite = nonfinite
 
     # ------------------------------------------------------------------ #
 
@@ -81,7 +85,9 @@ class TpuIsolationForest(BaseEstimator, OutlierMixin):
 
     def fit(self, X, y=None, mesh=None):
         X = np.asarray(X, np.float32)
-        self.model_ = self._build_estimator().fit(X, mesh=mesh)
+        self.model_ = self._build_estimator().fit(
+            X, mesh=mesh, nonfinite=self.nonfinite
+        )
         thr = self.model_.outlier_score_threshold
         # decision_function offset: sklearn flags decision_function < 0
         self.offset_ = -thr if thr > 0 else -0.5
@@ -91,7 +97,9 @@ class TpuIsolationForest(BaseEstimator, OutlierMixin):
     def score_samples(self, X) -> np.ndarray:
         """Negated anomaly score (sklearn convention: higher = more normal)."""
         self._check_fitted()
-        return -self.model_.score(np.asarray(X, np.float32))
+        return -self.model_.score(
+            np.asarray(X, np.float32), nonfinite=self.nonfinite
+        )
 
     def decision_function(self, X) -> np.ndarray:
         return self.score_samples(X) - self.offset_
@@ -106,7 +114,9 @@ class TpuIsolationForest(BaseEstimator, OutlierMixin):
     def anomaly_score(self, X) -> np.ndarray:
         """The reference's raw outlier score in [0, 1] (not negated)."""
         self._check_fitted()
-        return self.model_.score(np.asarray(X, np.float32))
+        return self.model_.score(
+            np.asarray(X, np.float32), nonfinite=self.nonfinite
+        )
 
     def _check_fitted(self):
         if not hasattr(self, "model_"):

@@ -13,7 +13,9 @@ from .params import (
     resolve_params,
 )
 from .validation import (
+    NONFINITE_POLICIES,
     UNKNOWN_TOTAL_NUM_FEATURES,
+    check_non_finite,
     extract_features,
     validate_feature_vector_size,
 )
@@ -30,7 +32,9 @@ __all__ = [
     "ResolvedParams",
     "resolve_extension_level",
     "resolve_params",
+    "NONFINITE_POLICIES",
     "UNKNOWN_TOTAL_NUM_FEATURES",
+    "check_non_finite",
     "extract_features",
     "validate_feature_vector_size",
     "logger",

@@ -20,10 +20,14 @@ import numpy as np
 UNKNOWN_TOTAL_NUM_FEATURES = -1
 
 
+NONFINITE_POLICIES = ("warn", "raise", "allow")
+
+
 def extract_features(
     data,
     features_col: str = "features",
     output_cols: Tuple[str, ...] = (),
+    nonfinite: str = "warn",
 ) -> Tuple[np.ndarray, Optional[object]]:
     """Normalise user input to a float32 ``[N, F]`` matrix.
 
@@ -36,6 +40,9 @@ def extract_features(
     Returns ``(X, frame_or_None)`` where the frame is passed back so
     ``transform`` can append score/label columns to it. Raises if any
     ``output_cols`` already exist on the frame (Utils.scala:47-58).
+
+    ``nonfinite`` is the NaN/inf policy (:func:`check_non_finite`):
+    ``"warn"`` (default), ``"raise"``, or ``"allow"``.
     """
     try:
         import pandas as pd
@@ -63,32 +70,45 @@ def extract_features(
             np.stack(data[features_col].to_numpy()) if len(data) else np.zeros((0, 0)),
             dtype=np.float32,
         )
-        _warn_non_finite(X)
+        check_non_finite(X, nonfinite)
         return X, data
 
     X = np.asarray(data, dtype=np.float32)
     if X.ndim != 2:
         raise ValueError(f"expected a 2-D [num_rows, num_features] matrix, got shape {X.shape}")
-    _warn_non_finite(X)
+    check_non_finite(X, nonfinite)
     return X, None
 
 
-def _warn_non_finite(X: np.ndarray) -> None:
-    """Non-finite features silently poison per-node min/max statistics during
-    growth (NaN comparisons are all-false, like the JVM's) — surface it once
-    per call instead of producing quietly degraded trees."""
-    if not X.size:
+def check_non_finite(X: np.ndarray, policy: str = "warn") -> None:
+    """NaN/inf input policy knob. Non-finite features silently poison
+    per-node min/max statistics during growth (NaN comparisons are
+    all-false, like the JVM's), so:
+
+    * ``"warn"`` — log once per call (the historical default);
+    * ``"raise"`` — ValueError, for pipelines that must not train/score on
+      degraded inputs;
+    * ``"allow"`` — silent, for callers that checked upstream.
+    """
+    if policy not in NONFINITE_POLICIES:
+        raise ValueError(
+            f"nonfinite policy must be one of {NONFINITE_POLICIES}, got {policy!r}"
+        )
+    if policy == "allow" or not X.size:
         return
     finite = np.isfinite(X)
-    if not finite.all():
-        from .logging import logger
+    if finite.all():
+        return
+    bad = int(X.size - finite.sum())
+    msg = (
+        f"input contains {bad} non-finite feature values (nan/inf); isolation "
+        "trees treat them as incomparable and scores may be degraded"
+    )
+    if policy == "raise":
+        raise ValueError(msg + " (nonfinite='raise')")
+    from .logging import logger
 
-        bad = int(X.size - finite.sum())
-        logger.warning(
-            "input contains %d non-finite feature values (nan/inf); isolation "
-            "trees treat them as incomparable and scores may be degraded",
-            bad,
-        )
+    logger.warning("%s", msg)
 
 
 def validate_feature_vector_size(num_features: int, expected: int) -> None:

@@ -146,8 +146,10 @@ class TestFusedTrainStep:
 
         import isoforest_tpu.parallel.sharded as sh
 
+        from isoforest_tpu.resilience import reset_degradations
+
         monkeypatch.setenv("ISOFOREST_TPU_STRATEGY", "walk")
-        monkeypatch.setattr(sh, "_warned_ineligible_pin", False)
+        reset_degradations("shard_pin_ineligible")
         with caplog.at_level(logging.WARNING, logger="isoforest_tpu"):
             name1, fn1 = sh.resolve_jittable_strategy(mesh)
             name2, _ = sh.resolve_jittable_strategy(mesh)

@@ -259,17 +259,24 @@ class TestModelRoundTrip:
         self, std_model, small_data, tmp_path
     ):
         # strip totalNumFeatures from metadata and reload (the reference's
-        # legacy test, WriteReadTest.scala + ReadWrite.scala:298-306)
+        # legacy test, WriteReadTest.scala + ReadWrite.scala:298-306); a
+        # true legacy (Spark-written) dir has no manifest, so remove it —
+        # otherwise the edit correctly trips checksum verification
         path = tmp_path / "m"
         std_model.save(str(path))
         meta_file = path / "metadata" / "part-00000"
         meta = json.loads(meta_file.read_text())
         del meta["totalNumFeatures"]
         meta_file.write_text(json.dumps(meta))
+        (path / "_MANIFEST.json").unlink()
         back = IsolationForestModel.load(str(path))
         assert back.total_num_features == -1
-        # width validation disabled for legacy models: narrower input scores
-        back.score(small_data[:10, :3])
+        # metadata width validation disabled for legacy models: wider input
+        # scores; but the forest-derived floor still refuses inputs too
+        # narrow to traverse (resilience width check)
+        back.score(np.concatenate([small_data[:10], small_data[:10, :1]], axis=1))
+        with pytest.raises(ValueError, match="features"):
+            back.score(small_data[:10, :1])
 
     def test_class_mismatch_rejected(self, std_model, ext_model, tmp_path):
         std_model.save(str(tmp_path / "s"))

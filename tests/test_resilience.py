@@ -1,0 +1,530 @@
+"""Resilience-layer matrix: every injected fault must land on its documented
+rung (docs/resilience.md) — with bit-identical scores where the fallback
+claims parity, a rescaled smaller forest where trees are dropped, and loud
+errors where nothing can be salvaged. Faults exercised: corrupt Avro block,
+truncated part file, missing ``_SUCCESS``, killed-writer partial dir, missing
+native ``.so``, forced strategy raise, and dropped-tree loads."""
+
+import glob
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from isoforest_tpu import (
+    ExtendedIsolationForest,
+    ExtendedIsolationForestModel,
+    IsolationForest,
+    IsolationForestModel,
+)
+from isoforest_tpu.io import avro, persistence as pers
+from isoforest_tpu.ops.traversal import forest_min_features, score_matrix
+from isoforest_tpu.ops.tree_growth import StandardForest
+from isoforest_tpu.resilience import (
+    DegradationError,
+    LADDER,
+    degradation_report,
+    degradations,
+    faults,
+    manifest,
+    reset_degradations,
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(800, 4)).astype(np.float32)
+    X[:20] += 5.0
+    return X
+
+
+@pytest.fixture(scope="module")
+def std_model(data):
+    return IsolationForest(num_estimators=8, max_samples=64.0, random_seed=3).fit(data)
+
+
+@pytest.fixture(scope="module")
+def ext_model(data):
+    return ExtendedIsolationForest(
+        num_estimators=6, max_samples=64.0, extension_level=2, random_seed=3
+    ).fit(data)
+
+
+def _data_part(path):
+    [part] = glob.glob(os.path.join(path, "data", "*.avro"))
+    return part
+
+
+# --------------------------------------------------------------------------- #
+# atomic, checksummed persistence
+# --------------------------------------------------------------------------- #
+
+
+class TestAtomicSave:
+    def test_round_trip_with_manifest_verification(self, std_model, data, tmp_path):
+        path = str(tmp_path / "m")
+        std_model.save(path)
+        assert manifest.present(path)
+        assert manifest.verify(path) == []
+        # manifest covers every content file the loader consumes
+        listed = set(json.load(open(os.path.join(path, "_MANIFEST.json")))["files"])
+        assert "metadata/part-00000" in listed
+        assert any(f.startswith("data/part-") for f in listed)
+        back = IsolationForestModel.load(path, verify=True)
+        np.testing.assert_allclose(back.score(data), std_model.score(data), rtol=1e-6)
+        assert back.load_report is None
+
+    def test_extended_round_trip_with_manifest(self, ext_model, data, tmp_path):
+        path = str(tmp_path / "m")
+        ext_model.save(path)
+        assert manifest.verify(path) == []
+        back = ExtendedIsolationForestModel.load(path, verify=True)
+        np.testing.assert_allclose(back.score(data), ext_model.score(data), rtol=1e-6)
+
+    def test_failed_save_leaves_no_trace(self, std_model, tmp_path, monkeypatch):
+        """An aborted save must leave the target absent and clean up its
+        temp dir — no observable partial directory at any point."""
+        path = str(tmp_path / "m")
+
+        def boom(*a, **k):
+            raise RuntimeError("disk full")
+
+        monkeypatch.setattr(pers.avro, "write_container_raw", boom)
+        monkeypatch.setattr(pers.avro, "write_container", boom)
+        with pytest.raises(RuntimeError, match="disk full"):
+            std_model.save(path)
+        assert not os.path.exists(path)
+        assert os.listdir(str(tmp_path)) == []  # no temp-dir litter either
+
+    def test_failed_overwrite_keeps_old_model(self, std_model, data, tmp_path, monkeypatch):
+        path = str(tmp_path / "m")
+        std_model.save(path)
+        want = std_model.score(data[:32])
+        monkeypatch.setattr(
+            pers, "_fast_standard_body", lambda f: (_ for _ in ()).throw(OSError("io"))
+        )
+        with pytest.raises(OSError):
+            std_model.save(path, overwrite=True)
+        # the old sealed model is untouched and still verifies
+        assert manifest.verify(path) == []
+        np.testing.assert_allclose(
+            IsolationForestModel.load(path).score(data[:32]), want, rtol=1e-6
+        )
+
+    def test_killed_writer_partial_refused_and_cleaned(self, std_model, tmp_path):
+        """A hard-killed writer leaves ``<path>.__tmp-<hex>`` and no
+        ``_SUCCESS``: loads must refuse it with an actionable message, and
+        ``overwrite=True`` must sweep it."""
+        path = str(tmp_path / "m")
+        std_model.save(path)
+        partial = path + ".__tmp-deadbeef1234"
+        shutil.copytree(path, partial)
+        os.remove(os.path.join(partial, "data", "_SUCCESS"))
+        with pytest.raises(ValueError, match="interrupted save"):
+            pers.load_standard_model(partial)
+        # non-overwrite saves to the same target do not silently reap it...
+        with pytest.raises(FileExistsError):
+            std_model.save(path)
+        assert os.path.isdir(partial)
+        # ...but overwrite=True cleans the leftover up
+        std_model.save(path, overwrite=True)
+        assert not os.path.exists(partial)
+        assert manifest.verify(path) == []
+
+    def test_missing_success_refused_with_opt_out(self, std_model, tmp_path):
+        path = str(tmp_path / "m")
+        std_model.save(path)
+        os.remove(os.path.join(path, "data", "_SUCCESS"))
+        with pytest.raises(ValueError, match="_SUCCESS"):
+            IsolationForestModel.load(path)
+        # opt-out flag loads anyway; content checksums still verify
+        back = IsolationForestModel.load(path, require_success=False)
+        assert back.forest.num_trees == std_model.forest.num_trees
+
+    def test_verify_true_requires_manifest(self, std_model, tmp_path):
+        path = str(tmp_path / "m")
+        std_model.save(path)
+        os.remove(os.path.join(path, "_MANIFEST.json"))
+        with pytest.raises(ValueError, match="_MANIFEST"):
+            IsolationForestModel.load(path, verify=True)
+        # auto mode tolerates legacy (manifest-less) layouts
+        IsolationForestModel.load(path)
+
+    def test_estimator_save_is_atomic_and_sealed(self, tmp_path):
+        est = IsolationForest(num_estimators=5)
+        path = str(tmp_path / "e")
+        est.save(path)
+        assert manifest.verify(path) == []
+        back = IsolationForest.load(path)
+        assert back.params == est.params
+        os.remove(os.path.join(path, "metadata", "_SUCCESS"))
+        with pytest.raises(ValueError, match="_SUCCESS"):
+            IsolationForest.load(path)
+
+
+class TestManifestCorruption:
+    def test_on_disk_byte_flip_caught_by_checksum(self, std_model, tmp_path):
+        """Persistent (on-disk) corruption is the manifest layer's job: the
+        load fails naming the file, before any Avro parsing."""
+        path = str(tmp_path / "m")
+        std_model.save(path)
+        part = _data_part(path)
+        faults.corrupt_file_on_disk(part)
+        with pytest.raises(ValueError, match="manifest verification"):
+            IsolationForestModel.load(path)
+
+    def test_metadata_tamper_always_fatal(self, std_model, tmp_path):
+        path = str(tmp_path / "m")
+        std_model.save(path)
+        faults.corrupt_file_on_disk(os.path.join(path, "metadata", "part-00000"))
+        # even in drop mode: metadata corruption cannot be salvaged
+        with pytest.raises(ValueError, match="manifest verification"):
+            IsolationForestModel.load(path, on_corrupt="drop")
+
+    def test_extra_unmanifested_part_file_detected(self, std_model, tmp_path):
+        path = str(tmp_path / "m")
+        std_model.save(path)
+        with open(os.path.join(path, "data", "part-99999-x-c000.avro"), "wb") as fh:
+            fh.write(b"Obj\x01junk")
+        with pytest.raises(ValueError, match="not in manifest"):
+            IsolationForestModel.load(path)
+
+
+# --------------------------------------------------------------------------- #
+# injected read faults (corrupt Avro block / truncated part file)
+# --------------------------------------------------------------------------- #
+
+
+class TestReadFaults:
+    def test_corrupt_avro_block_raises_by_default(self, std_model, tmp_path):
+        path = str(tmp_path / "m")
+        std_model.save(path)
+        with faults.inject(corrupt_avro=True):
+            with pytest.raises(ValueError):
+                IsolationForestModel.load(path)
+        # fault disarmed -> the very same dir loads cleanly
+        IsolationForestModel.load(path)
+
+    def test_truncated_part_file_raises_by_default(self, std_model, tmp_path):
+        path = str(tmp_path / "m")
+        std_model.save(path)
+        with faults.inject(truncate_data=True):
+            with pytest.raises(ValueError):
+                IsolationForestModel.load(path)
+        IsolationForestModel.load(path)
+
+    def test_total_block_loss_is_loud_even_in_drop_mode(self, std_model, tmp_path):
+        """Small models are a single Avro block: corrupting it loses every
+        tree, and drop mode must then refuse — a model with zero trees is
+        not a degraded model, it is no model."""
+        path = str(tmp_path / "m")
+        std_model.save(path)
+        with faults.inject(truncate_data=True):
+            with pytest.raises(ValueError, match="no usable tree data"):
+                IsolationForestModel.load(path, on_corrupt="drop")
+
+    def test_env_hook_arms_faults(self, std_model, tmp_path, monkeypatch):
+        """ISOFOREST_TPU_FAULTS arms the same faults without code access —
+        the hook CI's subprocess sweeps use."""
+        path = str(tmp_path / "m")
+        std_model.save(path)
+        # no '=offset' value: the default flip lands ~3/4 in, inside the
+        # record block (an explicit offset could land in the header's
+        # embedded schema JSON, which the columnar decoder ignores)
+        monkeypatch.setenv("ISOFOREST_TPU_FAULTS", "corrupt_avro")
+        assert faults.active("corrupt_avro")
+        assert faults.get("corrupt_avro") is True
+        with pytest.raises(ValueError):
+            IsolationForestModel.load(path)
+        monkeypatch.delenv("ISOFOREST_TPU_FAULTS")
+        IsolationForestModel.load(path)
+
+
+# --------------------------------------------------------------------------- #
+# dropped-tree loads (on_corrupt="drop")
+# --------------------------------------------------------------------------- #
+
+
+class TestDroppedTreeLoad:
+    @pytest.fixture()
+    def tampered(self, std_model, tmp_path):
+        """A valid Avro container whose trees 2 and 5 are semantically
+        corrupt (missing node / dangling child pointer) — the per-tree
+        salvage case, as opposed to whole-block loss."""
+        path = str(tmp_path / "m")
+        std_model.save(path)
+        part = _data_part(path)
+        schema, records = avro.read_container(part)
+        tampered = []
+        for r in records:
+            if r["treeID"] == 2 and r["nodeData"]["id"] == 1:
+                continue  # tree 2: ids no longer contiguous
+            if r["treeID"] == 5 and r["nodeData"]["id"] == 0:
+                r = dict(r)
+                node = dict(r["nodeData"])
+                node["leftChild"] = 10_000  # tree 5: dangling pointer
+                r["nodeData"] = node
+            tampered.append(r)
+        avro.write_container(part, schema, tampered)
+        manifest.write(path)  # re-seal: only tree-level damage remains
+        return path
+
+    def test_default_load_raises(self, tampered):
+        with pytest.raises(ValueError):
+            IsolationForestModel.load(tampered)
+
+    def test_drop_rebuilds_smaller_forest_with_exact_report(
+        self, tampered, std_model, data
+    ):
+        reset_degradations("dropped_trees")
+        back = IsolationForestModel.load(tampered, on_corrupt="drop")
+        assert back.forest.num_trees == 6
+        report = back.load_report
+        assert report.expected_trees == 8
+        assert report.kept_trees == 6
+        assert list(report.dropped_tree_ids) == [2, 5]
+        assert degradation_report().count("dropped_trees") == 1
+        # rung parity: scores equal a forest hand-built from the surviving
+        # trees — i.e. the num_trees normalisation rescaled to 6
+        keep = [t for t in range(8) if t not in (2, 5)]
+        f = std_model.forest
+        sub = StandardForest(
+            feature=np.asarray(f.feature)[keep],
+            threshold=np.asarray(f.threshold)[keep],
+            num_instances=np.asarray(f.num_instances)[keep],
+        )
+        np.testing.assert_allclose(
+            back.score(data),
+            score_matrix(sub, data, std_model.num_samples),
+            atol=3e-6,
+        )
+        # and differ from the full forest (the drop is visible, not masked)
+        assert np.abs(back.score(data) - std_model.score(data)).max() > 1e-4
+
+    def test_drop_on_clean_dir_is_lossless(self, std_model, data, tmp_path):
+        path = str(tmp_path / "m")
+        std_model.save(path)
+        back = IsolationForestModel.load(path, on_corrupt="drop")
+        assert back.forest.num_trees == 8
+        assert back.load_report.dropped_tree_ids == ()
+        np.testing.assert_allclose(back.score(data), std_model.score(data), atol=3e-6)
+
+    def test_extended_drop_load(self, ext_model, data, tmp_path):
+        path = str(tmp_path / "m")
+        ext_model.save(path)
+        part = _data_part(path)
+        schema, records = avro.read_container(part)
+        tampered = [
+            r
+            for r in records
+            if not (r["treeID"] == 1 and r["extendedNodeData"]["id"] == 2)
+        ]
+        avro.write_container(part, schema, tampered)
+        manifest.write(path)
+        back = ExtendedIsolationForestModel.load(path, on_corrupt="drop")
+        assert back.forest.num_trees == 5
+        assert list(back.load_report.dropped_tree_ids) == [1]
+        assert back.score(data).shape == (len(data),)
+
+
+# --------------------------------------------------------------------------- #
+# degradation ladder (scoring fallbacks)
+# --------------------------------------------------------------------------- #
+
+
+class TestNativeHidden:
+    def test_native_degrades_to_gather_with_parity(self, std_model, data):
+        """Missing native .so -> gather rung: bit-identical to an explicit
+        gather run, recorded + warned once."""
+        reset_degradations("native_unavailable")
+        base = score_matrix(std_model.forest, data, std_model.num_samples, strategy="gather")
+        with faults.inject(hide_native=True):
+            import isoforest_tpu.native as native
+
+            assert not native.available()
+            got = score_matrix(
+                std_model.forest, data, std_model.num_samples, strategy="native"
+            )
+            score_matrix(
+                std_model.forest, data, std_model.num_samples, strategy="native"
+            )
+        np.testing.assert_array_equal(got, base)
+        assert degradation_report().count("native_unavailable") == 2
+        [event] = [e for e in degradations() if e.reason == "native_unavailable"]
+        assert (event.from_, event.to) == ("native", "gather")
+
+    def test_strict_mode_raises_instead(self, std_model, data):
+        with faults.inject(hide_native=True):
+            with pytest.raises(DegradationError, match="native_unavailable"):
+                score_matrix(
+                    std_model.forest,
+                    data,
+                    std_model.num_samples,
+                    strategy="native",
+                    strict=True,
+                )
+
+
+class TestForcedStrategyRaise:
+    def test_forced_raise_propagates_loudly(self, std_model, data):
+        """A kernel failure must surface, not silently hop to another rung."""
+        reset_degradations()
+        with faults.inject(raise_strategy="dense"):
+            with pytest.raises(faults.FaultInjectedError, match="dense"):
+                score_matrix(
+                    std_model.forest, data, std_model.num_samples, strategy="dense"
+                )
+        # no degradation was recorded: this is a failure, not a fallback
+        assert degradation_report().count("native_unavailable") == 0
+        assert all(e.reason != "dense" for e in degradations())
+
+    def test_forced_raise_hits_resolved_strategy(self, std_model, data, monkeypatch):
+        """The fault fires on the strategy that actually runs: pinning
+        'walk' off-TPU resolves to gather, so arming gather catches it."""
+        monkeypatch.delenv("ISOFOREST_TPU_INTERPRET", raising=False)
+        reset_degradations("walk_off_tpu")
+        with faults.inject(raise_strategy="gather"):
+            with pytest.raises(faults.FaultInjectedError, match="gather"):
+                score_matrix(
+                    std_model.forest, data, std_model.num_samples, strategy="walk"
+                )
+
+
+class TestStrictMode:
+    def test_walk_off_tpu_strict(self, std_model, data, monkeypatch):
+        monkeypatch.delenv("ISOFOREST_TPU_INTERPRET", raising=False)
+        with pytest.raises(DegradationError, match="walk_off_tpu"):
+            score_matrix(
+                std_model.forest,
+                data,
+                std_model.num_samples,
+                strategy="walk",
+                strict=True,
+            )
+
+    def test_model_score_threads_strict(self, std_model, data, monkeypatch):
+        monkeypatch.setenv("ISOFOREST_TPU_STRATEGY", "native")
+        with faults.inject(hide_native=True):
+            with pytest.raises(DegradationError):
+                std_model.score(data, strict=True)
+        monkeypatch.delenv("ISOFOREST_TPU_STRATEGY")
+
+    def test_strict_clean_path_unchanged(self, std_model, data):
+        got = score_matrix(
+            std_model.forest, data, std_model.num_samples, strategy="gather", strict=True
+        )
+        base = score_matrix(
+            std_model.forest, data, std_model.num_samples, strategy="gather"
+        )
+        np.testing.assert_array_equal(got, base)
+
+
+class TestDegradationRegistry:
+    def test_every_rung_documented(self):
+        """Each ladder rung carries a parity statement; degrade() refuses
+        reasons outside the table (no undocumented rungs can appear)."""
+        from isoforest_tpu.resilience.degradation import degrade
+
+        for reason, parity in LADDER.items():
+            assert parity and isinstance(parity, str)
+        with pytest.raises(ValueError, match="unknown degradation reason"):
+            degrade("made_up_rung", "a", "b")
+
+    def test_warn_once_count_many(self, std_model, data, caplog):
+        import logging
+
+        reset_degradations("native_unavailable")
+        with caplog.at_level(logging.WARNING, logger="isoforest_tpu"):
+            with faults.inject(hide_native=True):
+                for _ in range(3):
+                    score_matrix(
+                        std_model.forest,
+                        data[:64],
+                        std_model.num_samples,
+                        strategy="native",
+                    )
+        msgs = [r for r in caplog.records if "native" in r.getMessage()]
+        assert len(msgs) == 1
+        assert degradation_report().count("native_unavailable") == 3
+
+    def test_model_degradations_queryable(self, std_model, data):
+        reset_degradations()
+        with faults.inject(hide_native=True):
+            score_matrix(
+                std_model.forest, data[:64], std_model.num_samples, strategy="native"
+            )
+        reasons = {e.reason for e in std_model.degradations()}
+        assert "native_unavailable" in reasons
+
+
+# --------------------------------------------------------------------------- #
+# satellite guards: width validation + nonfinite policy
+# --------------------------------------------------------------------------- #
+
+
+class TestWidthValidation:
+    def test_score_matrix_floor_check(self, std_model, data):
+        floor = forest_min_features(std_model.forest)
+        assert floor == 4
+        with pytest.raises(ValueError, match="trained on >= 4"):
+            score_matrix(std_model.forest, data[:, :2], std_model.num_samples)
+
+    def test_expected_features_check(self, std_model, data):
+        wide = np.concatenate([data, data[:, :1]], axis=1)
+        with pytest.raises(ValueError, match="trained on 4"):
+            score_matrix(
+                std_model.forest, wide, std_model.num_samples, expected_features=4
+            )
+
+    def test_model_score_rejects_wrong_width(self, std_model, data):
+        with pytest.raises(ValueError, match="features"):
+            std_model.score(data[:, :3])
+
+    def test_path_lengths_host_check(self, std_model, data):
+        from isoforest_tpu.ops.traversal import path_lengths
+
+        with pytest.raises(ValueError, match="features"):
+            path_lengths(std_model.forest, data[:8, :2])
+
+
+class TestNonfinitePolicy:
+    def test_fit_raise_policy(self, data):
+        X = data.copy()
+        X[0, 0] = np.nan
+        with pytest.raises(ValueError, match="non-finite"):
+            IsolationForest(num_estimators=2).fit(X, nonfinite="raise")
+
+    def test_score_policies(self, std_model, data, caplog):
+        import logging
+
+        X = data[:32].copy()
+        X[0, 0] = np.inf
+        with pytest.raises(ValueError, match="non-finite"):
+            std_model.score(X, nonfinite="raise")
+        with caplog.at_level(logging.WARNING, logger="isoforest_tpu"):
+            std_model.score(X)  # default: warn
+        assert any("non-finite" in r.getMessage() for r in caplog.records)
+        caplog.clear()
+        with caplog.at_level(logging.WARNING, logger="isoforest_tpu"):
+            std_model.score(X, nonfinite="allow")
+        assert not any("non-finite" in r.getMessage() for r in caplog.records)
+
+    def test_invalid_policy_rejected(self, std_model, data):
+        with pytest.raises(ValueError, match="nonfinite"):
+            std_model.score(data[:4], nonfinite="explode")
+
+    def test_sklearn_adapter_threads_policy(self, data):
+        from isoforest_tpu.sklearn import TpuIsolationForest
+
+        X = data.copy()
+        X[0, 0] = np.nan
+        clf = TpuIsolationForest(n_estimators=2, nonfinite="raise")
+        with pytest.raises(ValueError, match="non-finite"):
+            clf.fit(X)
+        clf2 = TpuIsolationForest(n_estimators=2, nonfinite="allow").fit(data)
+        with pytest.raises(ValueError, match="non-finite"):
+            TpuIsolationForest(n_estimators=2, nonfinite="raise").fit(data).predict(X)
+        assert clf2.predict(data[:8]).shape == (8,)

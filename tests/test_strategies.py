@@ -177,12 +177,15 @@ class TestAutoStrategy:
         class _Dev:
             platform = "tpu"
 
+        from isoforest_tpu.resilience import degradation_report, reset_degradations
+
         monkeypatch.setattr(tv.jax, "devices", lambda: [_Dev()])
-        monkeypatch.setattr(tv, "_warned_eif_pallas_fence", False)
+        reset_degradations("eif_pallas_fence")
         got = tv.score_matrix(ext.forest, X[:512], ext.num_samples, strategy="pallas")
         base = tv.score_matrix(ext.forest, X[:512], ext.num_samples, strategy="dense")
         np.testing.assert_array_equal(got, base)
-        assert tv._warned_eif_pallas_fence  # the loud warning fired
+        # the loud (once) warning fired through the degradation ladder
+        assert degradation_report().count("eif_pallas_fence") == 1
 
     def test_select_crossover_single_source(self):
         # ADVICE r2 low: the select/matmul feature crossover must be one
@@ -305,7 +308,6 @@ class TestWalkWideKFallback:
         mislabeled (same contract as the pallas fence)."""
         import logging
 
-        import isoforest_tpu.ops.traversal as tv
         from isoforest_tpu.ops.pallas_walk import _WALK_K_MAX, supports
 
         rng = np.random.default_rng(2)
@@ -313,9 +315,11 @@ class TestWalkWideKFallback:
         ext = ExtendedIsolationForest(
             num_estimators=6, max_samples=64.0, random_seed=1
         ).fit(Xw)
+        from isoforest_tpu.resilience import reset_degradations
+
         assert ext.forest.indices.shape[2] == _WALK_K_MAX + 4
         assert not supports(ext.forest)
-        monkeypatch.setattr(tv, "_warned_walk_unsupported", False)
+        reset_degradations("walk_unsupported")
         with caplog.at_level(logging.WARNING, logger="isoforest_tpu"):
             got = score_matrix(ext.forest, Xw, ext.num_samples, strategy="walk")
             again = score_matrix(ext.forest, Xw, ext.num_samples, strategy="walk")
@@ -368,13 +372,13 @@ class TestWalkOffTpuFallback:
         production behaviour."""
         import logging
 
-        import isoforest_tpu.ops.traversal as tv
-
         rng = np.random.default_rng(4)
         Xs = rng.normal(size=(600, 4)).astype(np.float32)
         m = IsolationForest(num_estimators=4, max_samples=64.0, random_seed=1).fit(Xs)
+        from isoforest_tpu.resilience import degradation_report, reset_degradations
+
         monkeypatch.delenv("ISOFOREST_TPU_INTERPRET", raising=False)
-        monkeypatch.setattr(tv, "_warned_walk_interpret", False)
+        reset_degradations("walk_off_tpu")
         with caplog.at_level(logging.WARNING, logger="isoforest_tpu"):
             got = score_matrix(m.forest, Xs, m.num_samples, strategy="walk")
             score_matrix(m.forest, Xs, m.num_samples, strategy="walk")
@@ -382,7 +386,8 @@ class TestWalkOffTpuFallback:
         np.testing.assert_array_equal(got, base)
         msgs = [r for r in caplog.records if "interpret" in r.getMessage()]
         assert len(msgs) == 1, "off-TPU walk fallback must warn exactly once"
-        assert tv._warned_walk_interpret
+        # both calls recorded; only the first logged
+        assert degradation_report().count("walk_off_tpu") == 2
 
 
 class TestPallasExtendedDispatch:

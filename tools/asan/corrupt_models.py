@@ -1,0 +1,108 @@
+"""Generate a corrupted-model Avro corpus for the ASan native-decoder sweep.
+
+Trains tiny standard + extended models, saves them, then uses the
+resilience fault harness's on-disk mutators to produce a matrix of
+corrupted copies of their Avro part files: byte flips at spread offsets
+(header, schema JSON, block framing, record payload, sync marker) and
+truncations at several lengths. The raw files land flat in OUTDIR so
+``tools/asan/run.sh OUTDIR`` can feed each one through the
+AddressSanitizer-instrumented snappy + columnar record decoders — the
+hostile-input gate for the model load path.
+
+As a bonus sanity pass, every corrupted *directory* is also loaded through
+the Python API with both ``on_corrupt`` policies, asserting the interpreter
+survives (clean error or degraded model, never a crash).
+
+Usage: python tools/asan/corrupt_models.py OUTDIR
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))  # repo root
+
+import numpy as np  # noqa: E402
+
+from isoforest_tpu import (  # noqa: E402
+    ExtendedIsolationForest,
+    ExtendedIsolationForestModel,
+    IsolationForest,
+    IsolationForestModel,
+)
+from isoforest_tpu.resilience import faults  # noqa: E402
+
+# flip offsets as fractions of file size: container magic/header, schema
+# JSON, early block framing, mid-record payload, trailing sync region
+FLIP_FRACTIONS = (0.0, 0.05, 0.3, 0.5, 0.75, 0.98)
+TRUNCATE_FRACTIONS = (0.1, 0.5, 0.9)
+
+
+def _save_models(root: str):
+    rng = np.random.default_rng(17)
+    X = rng.normal(size=(700, 5)).astype(np.float32)
+    std = IsolationForest(num_estimators=6, max_samples=64.0, random_seed=2).fit(X)
+    ext = ExtendedIsolationForest(
+        num_estimators=5, max_samples=64.0, extension_level=2, random_seed=2
+    ).fit(X)
+    std_dir = os.path.join(root, "std_ok")
+    ext_dir = os.path.join(root, "ext_ok")
+    std.save(std_dir, overwrite=True)
+    ext.save(ext_dir, overwrite=True)
+    return [(std_dir, IsolationForestModel), (ext_dir, ExtendedIsolationForestModel)]
+
+
+def _part_file(model_dir: str) -> str:
+    [part] = glob.glob(os.path.join(model_dir, "data", "*.avro"))
+    return part
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    out = sys.argv[1]
+    os.makedirs(out, exist_ok=True)
+    corpus = 0
+    dirs = 0
+    for model_dir, loader in _save_models(out):
+        kind = os.path.basename(model_dir).split("_")[0]
+        part = _part_file(model_dir)
+        size = os.path.getsize(part)
+        pristine = os.path.join(out, f"{kind}_pristine.avro")
+        shutil.copyfile(part, pristine)
+        corpus += 1
+        mutations = [
+            (f"flip{int(f * 100):02d}", lambda p, f=f: faults.corrupt_file_on_disk(p, int(size * f)))
+            for f in FLIP_FRACTIONS
+        ] + [
+            (f"trunc{int(f * 100):02d}", lambda p, f=f: faults.truncate_file_on_disk(p, max(1, int(size * f))))
+            for f in TRUNCATE_FRACTIONS
+        ]
+        for name, mutate in mutations:
+            bad_dir = os.path.join(out, f"{kind}_{name}")
+            shutil.rmtree(bad_dir, ignore_errors=True)
+            shutil.copytree(model_dir, bad_dir)
+            bad_part = _part_file(bad_dir)
+            mutate(bad_part)
+            shutil.copyfile(bad_part, os.path.join(out, f"{kind}_{name}.avro"))
+            corpus += 1
+            dirs += 1
+            # Python-API sanity: corrupted dirs must fail cleanly or load
+            # degraded — never take the interpreter down
+            for policy in ("raise", "drop"):
+                try:
+                    model = loader.load(bad_dir, on_corrupt=policy)
+                    assert model.forest.num_trees >= 1
+                except (ValueError, FileNotFoundError, KeyError):
+                    pass
+    print(f"wrote {corpus} corpus files ({dirs} corrupted model dirs) to {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

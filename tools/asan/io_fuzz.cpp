@@ -1,4 +1,8 @@
 // ASan fuzz of the native snappy + Avro decoders on random/mutated bytes.
+// With file arguments (the fault-harness corpus from
+// tools/asan/corrupt_models.py), each file's raw bytes additionally sweep
+// through every decoder at several claimed record counts — the
+// manifest-corrupted-model hostile-input gate.
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -14,27 +18,51 @@ extern "C" int64_t if_decode_extended(const uint8_t*, int64_t, int64_t, int32_t*
                                       int32_t*, int32_t*, int32_t*, double*,
                                       int64_t*, int32_t*, int32_t*, float*, int64_t);
 
-int main() {
+static void sweep(const uint8_t* data, int64_t len, int64_t count) {
+  std::vector<uint8_t> out(4 * size_t(len) + 1024);
+  if_snappy_uncompressed_len(data, len);
+  if_snappy_decompress(data, len, out.data(), out.size());
+  std::vector<int32_t> a(count), b_(count), c(count), d(count), e(count), hl(count);
+  std::vector<double> sv(count), off(count);
+  std::vector<int64_t> ni(count);
+  int64_t flat_cap = len + 16;
+  std::vector<int32_t> fi(flat_cap);
+  std::vector<float> fw(flat_cap);
+  if_decode_standard(data, len, count, a.data(), b_.data(), c.data(),
+                     d.data(), e.data(), sv.data(), ni.data());
+  if_decode_extended(data, len, count, a.data(), b_.data(), c.data(),
+                     d.data(), off.data(), ni.data(), hl.data(), fi.data(),
+                     fw.data(), flat_cap);
+}
+
+int main(int argc, char** argv) {
   std::mt19937 rng(11);
   for (int it = 0; it < 20000; ++it) {
     int64_t len = 1 + rng() % 512;
     std::vector<uint8_t> buf(len);
     for (auto& b : buf) b = uint8_t(rng());
-    std::vector<uint8_t> out(1024);
-    if_snappy_uncompressed_len(buf.data(), len);
-    if_snappy_decompress(buf.data(), len, out.data(), out.size());
-    int64_t count = 1 + rng() % 64;
-    std::vector<int32_t> a(count), b_(count), c(count), d(count), e(count), hl(count);
-    std::vector<double> sv(count), off(count);
-    std::vector<int64_t> ni(count);
-    std::vector<int32_t> fi(256);
-    std::vector<float> fw(256);
-    if_decode_standard(buf.data(), len, count, a.data(), b_.data(), c.data(),
-                       d.data(), e.data(), sv.data(), ni.data());
-    if_decode_extended(buf.data(), len, count, a.data(), b_.data(), c.data(),
-                       d.data(), off.data(), ni.data(), hl.data(), fi.data(),
-                       fw.data(), 256);
+    sweep(buf.data(), len, 1 + rng() % 64);
   }
-  fprintf(stderr, "IO FUZZ ALL OK\n");
+  int files = 0;
+  for (int i = 1; i < argc; ++i) {
+    FILE* fh = fopen(argv[i], "rb");
+    if (!fh) {
+      fprintf(stderr, "io_fuzz: cannot open %s\n", argv[i]);
+      return 1;
+    }
+    fseek(fh, 0, SEEK_END);
+    long len = ftell(fh);
+    fseek(fh, 0, SEEK_SET);
+    std::vector<uint8_t> buf(len > 0 ? len : 1);
+    if (len > 0 && fread(buf.data(), 1, len, fh) != size_t(len)) {
+      fclose(fh);
+      fprintf(stderr, "io_fuzz: short read on %s\n", argv[i]);
+      return 1;
+    }
+    fclose(fh);
+    for (int64_t count : {1, 64, 4096}) sweep(buf.data(), len, count);
+    ++files;
+  }
+  fprintf(stderr, "IO FUZZ ALL OK (%d corpus files)\n", files);
   return 0;
 }

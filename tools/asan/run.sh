@@ -10,12 +10,21 @@
 # Both were clean on 2026-07-30 (used to rule the native layer out as the
 # source of the XLA:CPU compile segfaults — README "Known environment
 # issue").
+#
+# Optional argument: a directory of .avro corpus files (typically the
+# fault-harness-corrupted model parts from tools/asan/corrupt_models.py);
+# each file additionally sweeps through the instrumented decoders.
 set -e
+CORPUS_DIR="$1"
 cd "$(dirname "$0")/../.."
 g++ -O1 -g -fsanitize=address -ffp-contract=off -pthread -std=c++17 \
     tools/asan/scorer_fuzz.cpp isoforest_tpu/native/scorer.cpp -o /tmp/if_asan_scorer
 g++ -O1 -g -fsanitize=address -std=c++17 \
     tools/asan/io_fuzz.cpp isoforest_tpu/native/isoforest_io.cpp -o /tmp/if_asan_io
 ASAN_OPTIONS=detect_leaks=0 /tmp/if_asan_scorer
-ASAN_OPTIONS=detect_leaks=0 /tmp/if_asan_io
+if [ -n "$CORPUS_DIR" ]; then
+  ASAN_OPTIONS=detect_leaks=0 /tmp/if_asan_io "$CORPUS_DIR"/*.avro
+else
+  ASAN_OPTIONS=detect_leaks=0 /tmp/if_asan_io
+fi
 echo "asan fuzz: all clean"
