@@ -404,9 +404,11 @@ def _clean_stale_partials(path: str) -> None:
 
 
 def _begin_atomic_dir(path: str, overwrite: bool) -> str:
-    """Start an atomic directory write; returns the temp dir (with a
-    ``metadata/`` subdir ready). Same parent as ``path`` so the final
-    rename stays on one filesystem."""
+    """Start an atomic directory write; returns the empty temp dir. Same
+    parent as ``path`` so the final rename stays on one filesystem. Also
+    the primitive under fit-checkpoint block seals
+    (:mod:`isoforest_tpu.resilience.checkpoint`), so it creates no
+    model-layout subdirs itself — writers lay out their own content."""
     if os.path.exists(path) and not overwrite:
         raise FileExistsError(
             f"path {path} already exists; pass overwrite=True to replace"
@@ -414,7 +416,7 @@ def _begin_atomic_dir(path: str, overwrite: bool) -> str:
     if overwrite:
         _clean_stale_partials(path)
     tmp = f"{os.path.normpath(path)}{_TMP_MARKER}{uuid.uuid4().hex[:12]}"
-    os.makedirs(os.path.join(tmp, "metadata"))
+    os.makedirs(tmp)
     return tmp
 
 
@@ -447,6 +449,7 @@ def _atomic_dir(path: str, overwrite: bool):
 
 
 def _write_metadata(path: str, metadata: dict) -> None:
+    os.makedirs(os.path.join(path, "metadata"), exist_ok=True)
     with open(os.path.join(path, "metadata", "part-00000"), "w") as fh:
         fh.write(json.dumps(metadata, separators=(",", ":")))
         fh.write("\n")

@@ -31,6 +31,7 @@ from ..utils import (
 from .isolation_forest import (
     IsolationForestModel,
     _ParamSetters,
+    _blockwise_grow,
     _compute_and_set_threshold,
     _new_uid,
 )
@@ -61,8 +62,17 @@ class ExtendedIsolationForest(_ParamSetters):
         return self._set(extension_level=v)
 
     def fit(
-        self, data, mesh=None, nonfinite: str = "warn"
+        self,
+        data,
+        mesh=None,
+        nonfinite: str = "warn",
+        checkpoint_dir=None,
+        checkpoint_every=None,
+        resume: bool = False,
     ) -> "ExtendedIsolationForestModel":
+        """Train; same knobs as :meth:`IsolationForest.fit`, including the
+        preemption-safe ``checkpoint_dir``/``checkpoint_every``/``resume``
+        block-wise growth (docs/resilience.md §5)."""
         p = self.params
         X, _ = extract_features(data, p.features_col, nonfinite=nonfinite)
         total_rows, total_feats = int(X.shape[0]), int(X.shape[1])
@@ -77,8 +87,36 @@ class ExtendedIsolationForest(_ParamSetters):
         key = jax.random.PRNGKey(np.uint32(p.random_seed & 0xFFFFFFFF))
 
         Xd = jnp.asarray(X, jnp.float32)
+        fit_checkpoint = None
         with phase("extended_isolation_forest.fit.grow"):
-            if mesh is not None:
+            if checkpoint_dir is not None:
+                from ..ops.ext_growth import grow_extended_forest_block
+
+                if mesh is not None:
+                    from ..parallel.sharded import sharded_grow_extended_forest
+
+                    grow_block = lambda tk, bg, fx: sharded_grow_extended_forest(
+                        mesh, tk, Xd, bg, fx, h, ext_level
+                    )
+                else:
+                    grow_block = lambda tk, bg, fx: grow_extended_forest_block(
+                        tk, Xd, bg, fx, height=h, extension_level=ext_level
+                    )
+                forest, fit_checkpoint = _blockwise_grow(
+                    checkpoint_dir,
+                    resume,
+                    checkpoint_every,
+                    key,
+                    Xd,
+                    kind="extended",
+                    forest_cls=ExtendedForest,
+                    grow_block=grow_block,
+                    params=p,
+                    resolved=resolved,
+                    height=h,
+                    extension_level=ext_level,
+                )
+            elif mesh is not None:
                 from ..parallel.sharded import sharded_grow_extended_forest
 
                 k_bag, k_feat, k_grow = jax.random.split(key, 3)
@@ -118,6 +156,7 @@ class ExtendedIsolationForest(_ParamSetters):
             extension_level=ext_level,
             total_num_features=total_feats,
         )
+        model.fit_checkpoint = fit_checkpoint
         # finalize the packed scoring layout (offset + leaf LUT merged into
         # the value plane, hyperplanes inlined in the record) before the
         # threshold pass — same contract as the standard estimator

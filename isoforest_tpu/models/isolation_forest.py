@@ -43,6 +43,106 @@ def _new_uid(prefix: str) -> str:
     return f"{prefix}_{uuid.uuid4().hex[:12]}"
 
 
+def _blockwise_grow(
+    checkpoint_dir: str,
+    resume: bool,
+    checkpoint_every,
+    key,
+    Xd,
+    *,
+    kind: str,
+    forest_cls,
+    grow_block,
+    params,
+    resolved,
+    height: int,
+    extension_level=None,
+):
+    """Preemption-safe growth shared by both estimators: grow the forest in
+    checkpointed blocks of trees (docs/resilience.md §5).
+
+    Bitwise identity with the uninterrupted fused fit rests on two
+    invariants: (1) the key-split order ``(k_bag, k_feat, k_grow)`` matches
+    :func:`~isoforest_tpu.ops.tree_growth.grow_forest_fused` exactly, and
+    (2) the FULL-ensemble bag/feature/key tensors are derived once and
+    *sliced* per block — the samplers' internal dispatch depends on the
+    total tree count, so per-block re-derivation would change the bags.
+    Per-tree growth streams are already block-partition-invariant
+    (``fold_in(k_grow, tree_id)``; verified bitwise in
+    tests/test_checkpoint.py).
+    """
+    from ..resilience import checkpoint as ckpt
+    from ..resilience import faults
+
+    num_trees = params.num_estimators
+    block_trees = ckpt.resolve_block_size(checkpoint_every, num_trees)
+    X_host = np.asarray(Xd)
+    fingerprint = ckpt.fit_fingerprint(
+        kind=kind,
+        random_seed=params.random_seed,
+        num_estimators=num_trees,
+        bootstrap=params.bootstrap,
+        num_samples=resolved.num_samples,
+        num_features=resolved.num_features,
+        height=height,
+        total_rows=int(X_host.shape[0]),
+        total_features=int(X_host.shape[1]),
+        block_trees=block_trees,
+        data_sha256=ckpt.data_fingerprint(X_host),
+        extension_level=extension_level,
+    )
+    state = ckpt.FitCheckpoint(checkpoint_dir, fingerprint)
+    state.begin(resume=resume)
+
+    k_bag, k_feat, k_grow = jax.random.split(key, 3)
+    bag = bagged_indices(
+        k_bag,
+        int(X_host.shape[0]),
+        resolved.num_samples,
+        num_trees,
+        params.bootstrap,
+    )
+    fidx = feature_subsets(
+        k_feat, int(X_host.shape[1]), resolved.num_features, num_trees
+    )
+    tree_keys = per_tree_keys(k_grow, num_trees)
+
+    parts = []
+    for index, start, stop in ckpt.block_ranges(num_trees, block_trees):
+        arrays = state.load_block(index, start, stop)
+        if arrays is None:
+            block = grow_block(
+                tree_keys[start:stop], bag[start:stop], fidx[start:stop]
+            )
+            block = jax.tree_util.tree_map(jax.block_until_ready, block)
+            arrays = {
+                field: np.asarray(getattr(block, field))
+                for field in forest_cls._fields
+            }
+            state.seal_block(index, start, stop, arrays)
+            # preemption seam: fires AFTER the seal, like a real kill
+            # landing between blocks (tests/test_checkpoint.py)
+            faults.check_fit_block(index)
+        parts.append(arrays)
+    logger.info(
+        "checkpointed fit: %d/%d block(s) grown this session, %d resumed "
+        "from %s",
+        state.blocks_written,
+        len(parts),
+        state.blocks_loaded,
+        checkpoint_dir,
+    )
+    forest = forest_cls(
+        **{
+            field: jnp.asarray(
+                np.concatenate([part[field] for part in parts])
+            )
+            for field in forest_cls._fields
+        }
+    )
+    return forest, state
+
+
 class _ParamSetters:
     """Fluent setters mirroring the reference's Params traits
     (IsolationForestParamsBase.scala:8-110)."""
@@ -91,7 +191,15 @@ class IsolationForest(_ParamSetters):
         self.params = params if params is not None else IsolationForestParams(**kw)
         self.uid = uid or _new_uid("isolation-forest")
 
-    def fit(self, data, mesh=None, nonfinite: str = "warn") -> "IsolationForestModel":
+    def fit(
+        self,
+        data,
+        mesh=None,
+        nonfinite: str = "warn",
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: Optional[int] = None,
+        resume: bool = False,
+    ) -> "IsolationForestModel":
         """Train. With ``mesh`` (a `jax.sharding.Mesh` with a ``'trees'`` axis),
         tree growth is sharded across devices (SURVEY.md §2.4 tree parallelism);
         otherwise a single-device vmap over the tree axis.
@@ -99,7 +207,16 @@ class IsolationForest(_ParamSetters):
         ``nonfinite`` is the NaN/inf input policy: ``"warn"`` (default,
         matching historical behaviour), ``"raise"``, or ``"allow"`` —
         non-finite features poison per-node min/max statistics during
-        growth, so strict pipelines should pick ``"raise"``."""
+        growth, so strict pipelines should pick ``"raise"``.
+
+        ``checkpoint_dir`` turns on preemption-safe block-wise growth
+        (docs/resilience.md §5): every ``checkpoint_every`` trees (default
+        32) the completed block is sealed atomically under
+        ``checkpoint_dir``, and a killed fit re-run with ``resume=True``
+        continues from the last sealed block — producing a forest, scores
+        and threshold **bitwise identical** to an uninterrupted fit. A
+        config/data mismatch on resume raises
+        :class:`~isoforest_tpu.resilience.CheckpointMismatchError`."""
         p = self.params
         X, _ = extract_features(data, p.features_col, nonfinite=nonfinite)
         total_rows, total_feats = int(X.shape[0]), int(X.shape[1])
@@ -113,8 +230,35 @@ class IsolationForest(_ParamSetters):
         key = jax.random.PRNGKey(np.uint32(p.random_seed & 0xFFFFFFFF))
 
         Xd = jnp.asarray(X, jnp.float32)
+        fit_checkpoint = None
         with phase("isolation_forest.fit.grow"):
-            if mesh is not None:
+            if checkpoint_dir is not None:
+                from ..ops.tree_growth import grow_forest_block
+
+                if mesh is not None:
+                    from ..parallel.sharded import sharded_grow_forest
+
+                    grow_block = lambda tk, bg, fx: sharded_grow_forest(
+                        mesh, tk, Xd, bg, fx, h
+                    )
+                else:
+                    grow_block = lambda tk, bg, fx: grow_forest_block(
+                        tk, Xd, bg, fx, height=h
+                    )
+                forest, fit_checkpoint = _blockwise_grow(
+                    checkpoint_dir,
+                    resume,
+                    checkpoint_every,
+                    key,
+                    Xd,
+                    kind="standard",
+                    forest_cls=StandardForest,
+                    grow_block=grow_block,
+                    params=p,
+                    resolved=resolved,
+                    height=h,
+                )
+            elif mesh is not None:
                 from ..parallel.sharded import sharded_grow_forest
 
                 k_bag, k_feat, k_grow = jax.random.split(key, 3)
@@ -153,6 +297,7 @@ class IsolationForest(_ParamSetters):
             num_features=resolved.num_features,
             total_num_features=total_feats,
         )
+        model.fit_checkpoint = fit_checkpoint
         # finalize the packed scoring layout eagerly: the contamination
         # threshold pass below (and every later score) consumes it
         model.finalize_scoring()
@@ -234,6 +379,10 @@ class IsolationForestModel:
         # set by degraded (on_corrupt="drop") loads: which trees were lost
         # (resilience.LoadReport); None for fits and clean loads
         self.load_report = None
+        # set by checkpointed fits (fit(checkpoint_dir=...)): the
+        # resilience.FitCheckpoint with blocks_written/blocks_loaded;
+        # None for plain fits and loads
+        self.fit_checkpoint = None
         # packed scoring layout (ops.scoring_layout): built eagerly by
         # fit()/finalize_scoring(), lazily on first score for persisted
         # models — the on-disk format stays the reference Avro node arrays
@@ -269,7 +418,12 @@ class IsolationForestModel:
         return self
 
     def score(
-        self, X, mesh=None, strict: bool = False, nonfinite: str = "warn"
+        self,
+        X,
+        mesh=None,
+        strict: bool = False,
+        nonfinite: str = "warn",
+        timeout_s: Optional[float] = None,
     ) -> np.ndarray:
         """Outlier scores ``2^(-E[h(x)]/c(n))`` for an ``[N, F]`` matrix.
 
@@ -277,7 +431,12 @@ class IsolationForestModel:
         :class:`~isoforest_tpu.resilience.DegradationError` instead of
         silently falling back when the resolved scoring strategy cannot run
         (docs/resilience.md). ``nonfinite``: NaN/inf policy
-        (``"warn"``/``"raise"``/``"allow"``)."""
+        (``"warn"``/``"raise"``/``"allow"``). ``timeout_s`` arms the scoring
+        watchdog (docs/resilience.md §6): a strategy that stalls past the
+        deadline is abandoned and retried once on the portable gather
+        kernel (rung ``scoring_timeout``; under ``strict=True`` the timeout
+        raises instead). Local-strategy path only — mesh scoring runs the
+        fused sharded program without a watchdog."""
         X = np.asarray(X, np.float32)
         check_non_finite(X, nonfinite)
         validate_feature_vector_size(X.shape[1], self.total_num_features)
@@ -299,6 +458,7 @@ class IsolationForestModel:
             layout=self._scoring_layout,
             strict=strict,
             expected_features=expected,
+            timeout_s=timeout_s,
         )
 
     def degradations(self):

@@ -221,6 +221,14 @@ def grow_extended_forest(
     )
 
 
+# jitted entry for block-wise checkpointed growth (models _blockwise_grow):
+# same trace as `grow_extended_forest`, compiled once per block shape — call
+# with height/extension_level as keywords
+grow_extended_forest_block = functools.partial(
+    jax.jit, static_argnames=("height", "extension_level")
+)(grow_extended_forest)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(

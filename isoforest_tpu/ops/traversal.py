@@ -379,6 +379,7 @@ def score_matrix(
     layout=None,
     strict: bool = False,
     expected_features: int | None = None,
+    timeout_s: float | None = None,
 ) -> np.ndarray:
     """Score a full ``[N, F]`` matrix, chunked along rows.
 
@@ -426,6 +427,15 @@ def score_matrix(
     wrong-width ``X`` into an immediate ValueError; independent of it, a
     matrix narrower than the forest's highest split feature is always
     refused before dispatch.
+
+    ``timeout_s`` arms the scoring watchdog
+    (:mod:`~isoforest_tpu.resilience.watchdog`): the resolved strategy's
+    whole execution runs under a hard wall-clock deadline, and a stall
+    (wedged native walker, hung Pallas compile) is abandoned and retried
+    once on the portable gather kernel via the ``scoring_timeout`` rung —
+    ``strict=True`` raises at the timeout instead. A gather run that
+    itself times out raises
+    :class:`~isoforest_tpu.resilience.WatchdogTimeout`.
     """
     if not isinstance(X, (np.ndarray, jax.Array)):
         X = np.asarray(X, np.float32)
@@ -518,19 +528,52 @@ def score_matrix(
         )
     if strategy == "native":
         faults.check_strategy("native")
-        out = _score_native(forest, X, num_samples)
+        timed_out = False
+        if timeout_s is None:
+            out = _score_native(forest, X, num_samples)
+        else:
+            # the native walker is the canonical wedge-not-raise strategy
+            # (a pathological input loops in C++ with the GIL released), so
+            # it runs under the same watchdog deadline as the jax kernels
+            from ..resilience import watchdog as _watchdog
+
+            def _native_run():
+                # hung-walker fault seam — docs/resilience.md §3
+                faults.maybe_slow_collective("native")
+                return _score_native(forest, X, num_samples)
+
+            try:
+                out = _watchdog.run_with_deadline(
+                    _native_run, timeout_s, describe="scoring strategy 'native'"
+                )
+            except _watchdog.WatchdogTimeout:
+                timed_out = True
+                out = None
         if out is not None:
             return out
-        strategy = degrade(
-            "native_unavailable",
-            "native",
-            "gather",
-            detail=(
-                "native scoring strategy unavailable (no C++ toolchain?); "
-                "falling back to the ~4x-slower gather kernel"
-            ),
-            strict=strict,
-        )
+        if timed_out:
+            strategy = degrade(
+                "scoring_timeout",
+                "native",
+                "gather",
+                detail=(
+                    f"scoring strategy 'native' missed its {timeout_s:g}s "
+                    "watchdog deadline (stalled walker abandoned); retrying "
+                    "the batch once on the portable gather kernel"
+                ),
+                strict=strict,
+            )
+        else:
+            strategy = degrade(
+                "native_unavailable",
+                "native",
+                "gather",
+                detail=(
+                    "native scoring strategy unavailable (no C++ toolchain?); "
+                    "falling back to the ~4x-slower gather kernel"
+                ),
+                strict=strict,
+            )
     faults.check_strategy(strategy)
     if strategy == "pallas":
         from .pallas_traversal import path_lengths_pallas
@@ -561,31 +604,75 @@ def score_matrix(
         chunk_size = _default_chunk_size()
     if n == 0:
         return np.zeros((0,), np.float32)
-    if n <= chunk_size:
-        X = jnp.asarray(X, jnp.float32)
-        bucket = max(1024, 1 << int(np.ceil(np.log2(n))))
-        pad = bucket - n
-        if pad:
-            X = jnp.pad(X, ((0, pad), (0, 0)))
-        return np.asarray(run_chunk(X)[:n])
 
-    # Multi-chunk: (a) host-resident inputs are uploaded PER CHUNK inside
-    # the loop — async dispatch overlaps chunk k+1's host->device transfer
-    # with chunk k's compute (measured 26% faster than one upfront transfer
-    # at 2M rows on a live v5e; the upfront copy serialises ~120 MB through
-    # the tunnel before any compute starts at 10M rows); (b) every chunk is
-    # dispatched before any result is pulled back, so device compute also
-    # overlaps the device->host score transfers.
-    streaming = not isinstance(X, jax.Array)
-    Xd = X if streaming else jnp.asarray(X, jnp.float32)
-    outs = []
-    for start in range(0, n, chunk_size):
-        chunk = Xd[start : start + chunk_size]
-        if streaming:
-            chunk = jnp.asarray(chunk, jnp.float32)
-        pad = chunk_size - chunk.shape[0]
-        if pad:
-            chunk = jnp.pad(chunk, ((0, pad), (0, 0)))
-        scores = run_chunk(chunk)
-        outs.append(scores[: chunk_size - pad] if pad else scores)
-    return np.concatenate([np.asarray(o) for o in outs])
+    def _execute() -> np.ndarray:
+        # hung-kernel fault seam: stalls here (inside the watchdog scope)
+        # while slow_collective is armed — docs/resilience.md §3
+        faults.maybe_slow_collective(strategy)
+        if n <= chunk_size:
+            Xc = jnp.asarray(X, jnp.float32)
+            bucket = max(1024, 1 << int(np.ceil(np.log2(n))))
+            pad = bucket - n
+            if pad:
+                Xc = jnp.pad(Xc, ((0, pad), (0, 0)))
+            return np.asarray(run_chunk(Xc)[:n])
+
+        # Multi-chunk: (a) host-resident inputs are uploaded PER CHUNK inside
+        # the loop — async dispatch overlaps chunk k+1's host->device transfer
+        # with chunk k's compute (measured 26% faster than one upfront transfer
+        # at 2M rows on a live v5e; the upfront copy serialises ~120 MB through
+        # the tunnel before any compute starts at 10M rows); (b) every chunk is
+        # dispatched before any result is pulled back, so device compute also
+        # overlaps the device->host score transfers.
+        streaming = not isinstance(X, jax.Array)
+        Xd = X if streaming else jnp.asarray(X, jnp.float32)
+        outs = []
+        for start in range(0, n, chunk_size):
+            chunk = Xd[start : start + chunk_size]
+            if streaming:
+                chunk = jnp.asarray(chunk, jnp.float32)
+            pad = chunk_size - chunk.shape[0]
+            if pad:
+                chunk = jnp.pad(chunk, ((0, pad), (0, 0)))
+            scores = run_chunk(chunk)
+            outs.append(scores[: chunk_size - pad] if pad else scores)
+        return np.concatenate([np.asarray(o) for o in outs])
+
+    if timeout_s is None:
+        return _execute()
+
+    # scoring watchdog (docs/resilience.md §6): bound the strategy's
+    # wall-clock — a wedged native walker or a stalled Pallas compile is
+    # abandoned to its daemon thread and the batch retried ONCE on the
+    # portable gather kernel through the ladder. A gather run that itself
+    # times out raises: there is no lower rung to stand on.
+    from ..resilience import watchdog as _watchdog
+
+    try:
+        return _watchdog.run_with_deadline(
+            _execute, timeout_s, describe=f"scoring strategy {strategy!r}"
+        )
+    except _watchdog.WatchdogTimeout:
+        if strategy == "gather":
+            raise
+        degrade(
+            "scoring_timeout",
+            strategy,
+            "gather",
+            detail=(
+                f"scoring strategy {strategy!r} missed its {timeout_s:g}s "
+                "watchdog deadline (stalled kernel/compile abandoned); "
+                "retrying the batch once on the portable gather kernel"
+            ),
+            strict=strict,
+        )
+        return score_matrix(
+            forest,
+            X,
+            num_samples,
+            chunk_size=chunk_size,
+            strategy="gather",
+            strict=strict,
+            expected_features=expected_features,
+            timeout_s=timeout_s,
+        )

@@ -217,6 +217,15 @@ def grow_forest(
     )
 
 
+# jitted entry for block-wise checkpointed growth (models _blockwise_grow):
+# the same trace as `grow_forest`, but compiled once per block shape instead
+# of re-dispatching op-by-op on every block of every fit — call with
+# height as a keyword
+grow_forest_block = functools.partial(jax.jit, static_argnames=("height",))(
+    grow_forest
+)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_samples", "num_trees", "bootstrap", "num_features", "height"),

@@ -19,7 +19,9 @@ scales from 1 chip to a pod slice.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import inspect
+import time
+from typing import Callable, Optional, Sequence
 
 import jax
 import numpy as np
@@ -55,17 +57,88 @@ def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    *,
+    timeout_s: Optional[float] = None,
+    retry_policy=None,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
 ) -> None:
     """Bring up the multi-host runtime (``jax.distributed.initialize``) — the
     TPU analogue of the reference's implicit SparkSession bring-up
-    (SURVEY.md §3.5). No-op in single-process runs."""
+    (SURVEY.md §3.5). No-op in single-process runs.
+
+    Unlike the bare jax call, bring-up failures are retried with capped
+    exponential backoff + jitter (coordinator not up yet, port races,
+    transient DNS — Spark's task-retry analogue for the DCN layer), and
+    ``timeout_s`` bounds the WHOLE bring-up: jax's own per-attempt
+    ``initialization_timeout`` is clamped to the remaining budget where the
+    installed jax supports it, and exhaustion raises a typed
+    :class:`~isoforest_tpu.resilience.DistributedTimeoutError` carrying the
+    attempt/elapsed diagnostics instead of hanging or dying on the bare
+    last error. ``retry_policy`` (a
+    :class:`~isoforest_tpu.resilience.RetryPolicy`) overrides the default
+    3-attempt schedule; ``clock``/``sleep`` are injectable so the whole
+    recovery path is provable with a fake clock (tests/test_resilience.py).
+    """
     if num_processes is None or num_processes <= 1:
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
+    import dataclasses
+
+    from ..resilience import faults
+    from ..resilience.retry import (
+        DistributedTimeoutError,
+        RetryError,
+        RetryPolicy,
+        retry_call,
     )
+
+    policy = retry_policy or RetryPolicy(
+        max_attempts=3, base_delay_s=1.0, max_delay_s=30.0
+    )
+    if timeout_s is not None and policy.deadline_s is None:
+        policy = dataclasses.replace(policy, deadline_s=float(timeout_s))
+    supports_init_timeout = (
+        "initialization_timeout"
+        in inspect.signature(jax.distributed.initialize).parameters
+    )
+    start = clock()
+
+    def attempt() -> None:
+        faults.take_distributed_init_failure()
+        kwargs = {}
+        if timeout_s is not None and supports_init_timeout:
+            remaining = max(1, int(float(timeout_s) - (clock() - start)))
+            kwargs["initialization_timeout"] = remaining
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+
+    try:
+        retry_call(
+            attempt,
+            policy=policy,
+            describe=(
+                f"distributed bring-up (coordinator {coordinator_address}, "
+                f"process {process_id}/{num_processes})"
+            ),
+            clock=clock,
+            sleep=sleep,
+        )
+    except RetryError as exc:
+        raise DistributedTimeoutError(
+            f"multi-host runtime never came up: {exc}",
+            elapsed_s=exc.elapsed_s,
+            deadline_s=policy.deadline_s,
+            diagnostics=(
+                f"coordinator={coordinator_address}",
+                f"process_id={process_id}",
+                f"num_processes={num_processes}",
+                f"attempts={exc.attempts}",
+            ),
+        ) from exc
 
 
 def create_mesh(

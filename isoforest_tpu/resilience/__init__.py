@@ -15,15 +15,28 @@ coordinated pieces:
   before parsing a byte of Avro.
 * :mod:`.faults` — fault-injection harness (context manager +
   ``ISOFOREST_TPU_FAULTS`` env hook) that can corrupt Avro bytes on read,
-  truncate data part files, hide the native extension, and force a named
-  scoring strategy to raise — used by ``tests/test_resilience.py`` to prove
-  every failure path lands on its documented rung.
+  truncate data part files, hide the native extension, force a named
+  scoring strategy to raise, kill a checkpointed fit after a chosen block,
+  fail the first N distributed bring-up attempts, and stall a
+  kernel/collective — used by ``tests/test_resilience.py`` /
+  ``tests/test_checkpoint.py`` to prove every failure path lands on its
+  documented rung.
+* :mod:`.checkpoint` — block-wise fit checkpointing: a killed fit resumes
+  from the last atomically sealed tree block and yields a bitwise-identical
+  forest (``fit(checkpoint_dir=..., resume=True)``).
+* :mod:`.retry` — capped exponential backoff with deterministic jitter,
+  injectable clock/sleep and a hard deadline; typed
+  :class:`DistributedTimeoutError` for the multihost path.
+* :mod:`.watchdog` — deadline watchdogs for code that hangs rather than
+  raises (stalled kernels, dead-peer collectives), plus the peer-heartbeat
+  files multihost timeout diagnostics read.
 
 The ladder itself (every rung, trigger, and parity guarantee) is documented
 in ``docs/resilience.md``.
 """
 
-from . import faults, manifest
+from . import checkpoint, faults, manifest, retry, watchdog
+from .checkpoint import CheckpointMismatchError, FitCheckpoint
 from .degradation import (
     LADDER,
     DegradationError,
@@ -35,17 +48,29 @@ from .degradation import (
     degrade,
     reset_degradations,
 )
+from .retry import DistributedTimeoutError, RetryError, RetryPolicy, retry_call
+from .watchdog import WatchdogTimeout
 
 __all__ = [
+    "checkpoint",
     "faults",
     "manifest",
+    "retry",
+    "watchdog",
     "LADDER",
+    "CheckpointMismatchError",
     "DegradationError",
     "DegradationEvent",
     "DegradationReport",
+    "DistributedTimeoutError",
+    "FitCheckpoint",
     "LoadReport",
+    "RetryError",
+    "RetryPolicy",
+    "WatchdogTimeout",
     "degradation_report",
     "degradations",
     "degrade",
     "reset_degradations",
+    "retry_call",
 ]

@@ -66,6 +66,13 @@ LADDER: Dict[str, str] = {
         "per-platform jittable default (gather/dense): scores within "
         "cross-strategy f32 tolerance"
     ),
+    # watchdog rung (ops/traversal.py, score_matrix(timeout_s=...))
+    "scoring_timeout": (
+        "strategy missed its watchdog deadline -> one retry on the portable "
+        "gather kernel (the stalled program is abandoned to its daemon "
+        "thread): scores are gather's, within cross-strategy f32 tolerance; "
+        "a gather run that itself times out raises WatchdogTimeout"
+    ),
     # load-time rung (io/persistence.py, on_corrupt='drop')
     "dropped_trees": (
         "corrupt trees dropped at load -> valid smaller forest: path-length "

@@ -11,7 +11,25 @@ single dict lookup when no fault is armed):
 * ``ops.traversal.score_matrix`` -> :func:`check_strategy` —
   ``raise_strategy=<name>`` makes the named strategy raise
   :class:`FaultInjectedError` at dispatch, proving kernel failures
-  propagate loudly instead of silently hopping rungs.
+  propagate loudly instead of silently hopping rungs;
+* checkpointed fit (``models/*.fit(checkpoint_dir=...)``) ->
+  :func:`check_fit_block` — ``kill_fit_after_block=<k>`` aborts the fit
+  immediately after block ``k`` seals (the preemption-mid-fit case the
+  resume path exists for);
+* ``parallel.mesh.initialize_distributed`` ->
+  :func:`take_distributed_init_failure` — ``fail_distributed_init=<n>``
+  makes the first ``n`` bring-up attempts raise (coordinator not up yet /
+  port race), proving the retry/backoff schedule end to end;
+* scoring execution (``ops.traversal.score_matrix``) and the multihost
+  worker body -> :func:`maybe_slow_collective` — ``slow_collective`` (all
+  strategies), ``slow_collective=<seconds>`` (stall cap) or
+  ``slow_collective=<strategy>`` (stall only that strategy) simulates a
+  hung kernel/collective; the stall polls its own arming so exiting
+  :func:`inject` releases any abandoned watchdog thread promptly.
+
+:class:`FakeClock` is the injectable time source the retry/watchdog tests
+drive: deterministic ``now``/``sleep`` so every backoff schedule and
+deadline is provable with zero real sleeps in tier-1.
 
 Faults arm two ways: the :func:`inject` context manager (scoped, stackable,
 test-friendly) or the ``ISOFOREST_TPU_FAULTS`` environment variable
@@ -29,12 +47,21 @@ from __future__ import annotations
 
 import contextlib
 import os
-from typing import Dict, List, Optional, Union
+import time
+from typing import Callable, Dict, List, Optional, Union
 
 FAULTS_ENV = "ISOFOREST_TPU_FAULTS"
 
 KNOWN_FAULTS = frozenset(
-    {"corrupt_avro", "truncate_data", "hide_native", "raise_strategy"}
+    {
+        "corrupt_avro",
+        "truncate_data",
+        "hide_native",
+        "raise_strategy",
+        "kill_fit_after_block",
+        "fail_distributed_init",
+        "slow_collective",
+    }
 )
 
 FaultValue = Union[bool, int, str]
@@ -140,6 +167,108 @@ def check_strategy(strategy: str) -> None:
             f"injected fault: scoring strategy {strategy!r} forced to raise "
             f"(raise_strategy={target!r})"
         )
+
+
+def check_fit_block(block_index: int) -> None:
+    """Raise :class:`FaultInjectedError` when ``kill_fit_after_block`` names
+    the block that just SEALED — the checkpointed-fit preemption seam. The
+    block's checkpoint is already durable when this fires, exactly like a
+    real preemption landing between seal and the next block's growth."""
+    value = get("kill_fit_after_block")
+    if value is None or value is False:
+        return
+    if int(value) == int(block_index):
+        raise FaultInjectedError(
+            f"injected fault: fit killed after sealing block {block_index} "
+            f"(kill_fit_after_block={value!r}) — resume with "
+            "fit(..., resume=True)"
+        )
+
+
+# env-armed fail_distributed_init consumes across calls within the process
+# (subprocess workers re-read the env fresh, matching a real flaky bring-up)
+_ENV_DIST_INIT_CONSUMED = 0
+
+
+def take_distributed_init_failure() -> None:
+    """Consume one ``fail_distributed_init`` token; raises
+    :class:`FaultInjectedError` while tokens remain (the first-N-attempts
+    bring-up failure), then becomes a no-op. Frame-armed values decrement in
+    place so nested :func:`inject` scopes stay independent."""
+    for frame in reversed(_STACK):
+        if "fail_distributed_init" in frame:
+            value = frame["fail_distributed_init"]
+            if value is False:
+                return
+            remaining = int(value)
+            if remaining > 0:
+                frame["fail_distributed_init"] = remaining - 1
+                raise FaultInjectedError(
+                    "injected fault: distributed bring-up attempt failed "
+                    f"({remaining - 1} injected failure(s) remaining)"
+                )
+            return
+    value = _parse_env().get("fail_distributed_init")
+    if value is None or value is False:
+        return
+    total = int(value) if str(value).isdigit() else 1
+    global _ENV_DIST_INIT_CONSUMED
+    if _ENV_DIST_INIT_CONSUMED < total:
+        _ENV_DIST_INIT_CONSUMED += 1
+        raise FaultInjectedError(
+            "injected fault: distributed bring-up attempt failed "
+            f"({total - _ENV_DIST_INIT_CONSUMED} injected failure(s) remaining)"
+        )
+
+
+def maybe_slow_collective(
+    strategy: Optional[str] = None,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+) -> None:
+    """Stall while ``slow_collective`` is armed — the hung-kernel /
+    hung-DCN-collective simulation the watchdogs exist to bound.
+
+    Value forms: ``True`` (stall any caller, 30 s cap), a number (stall any
+    caller, that many seconds), or a strategy name (stall only when
+    ``strategy`` matches, 30 s cap). The stall re-checks its own arming
+    every 10 ms, so a test exiting :func:`inject` releases the abandoned
+    watchdog thread promptly instead of leaking a sleeping thread for the
+    full cap."""
+    value = get("slow_collective")
+    if value is None or value is False:
+        return
+    limit = 30.0
+    if not isinstance(value, bool):
+        try:
+            limit = float(value)
+        except (TypeError, ValueError):
+            # strategy-named stall: only the matching caller stalls
+            if strategy is None or str(value) != strategy:
+                return
+    start = clock()
+    while active("slow_collective") and clock() - start < limit:
+        sleep(0.01)
+
+
+class FakeClock:
+    """Deterministic injectable clock: ``now``/``sleep`` advance virtual
+    time only, and every requested sleep is recorded — the retry/watchdog
+    schedules are proven against it with zero real sleeps in tier-1."""
+
+    def __init__(self, start: float = 0.0) -> None:
+        self._now = float(start)
+        self.sleeps: List[float] = []
+
+    def now(self) -> float:
+        return self._now
+
+    def sleep(self, seconds: float) -> None:
+        self.sleeps.append(float(seconds))
+        self._now += float(seconds)
+
+    def advance(self, seconds: float) -> None:
+        self._now += float(seconds)
 
 
 # --------------------------------------------------------------------------- #

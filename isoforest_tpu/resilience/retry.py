@@ -1,0 +1,190 @@
+"""Retry with exponential backoff, bounded jitter and a hard deadline.
+
+The reference gets transient-failure tolerance for free: Spark re-runs a
+lost partition's task (one tree per partition, ``SharedTrainLogic.scala``)
+under its task-retry machinery. The JAX runtime has no such layer — a
+failed ``jax.distributed.initialize`` (coordinator not up yet, port race,
+transient DNS) or a flaky DCN bring-up simply raises, and at pod scale the
+first attempt failing is the *common* case, not the exception. This module
+is the missing retry layer, built for provability:
+
+* **deterministic jitter** — delays come from a seeded ``random.Random``,
+  so a test (or an incident postmortem) can reproduce the exact schedule;
+* **injectable clock/sleep** — every time source is a parameter, so the
+  whole schedule (backoff growth, jitter bounds, deadline exhaustion) is
+  provable with :class:`~isoforest_tpu.resilience.faults.FakeClock` and
+  zero real sleeps in tier-1;
+* **typed exhaustion** — callers get :class:`RetryError` (attempts,
+  elapsed, last exception) rather than the bare final error, and the
+  distributed wrappers re-type that as :class:`DistributedTimeoutError`
+  with peer diagnostics (``parallel/mesh.py``, ``tests/multihost_worker.py``).
+
+Backoff is the standard capped exponential: attempt ``a`` sleeps
+``min(max_delay_s, base_delay_s * multiplier**a) * (1 + jitter*(2u-1))``
+with ``u ~ U[0,1)``, i.e. the jittered delay stays within ``±jitter`` of
+the deterministic curve. ``deadline_s`` bounds the *whole* operation: a
+retry that could not complete its sleep before the deadline is not
+attempted at all — the caller learns about exhaustion ``delay`` seconds
+sooner and with the budget honestly reported.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, List, Optional, Tuple
+
+from ..utils.logging import logger
+
+
+class RetryError(RuntimeError):
+    """An operation failed through every allowed attempt (or its deadline).
+
+    Carries the schedule's outcome for diagnostics: ``attempts`` made,
+    ``elapsed_s`` since the first attempt started, and ``last_exception``.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        attempts: int = 0,
+        elapsed_s: float = 0.0,
+        last_exception: Optional[BaseException] = None,
+    ) -> None:
+        super().__init__(message)
+        self.attempts = attempts
+        self.elapsed_s = elapsed_s
+        self.last_exception = last_exception
+
+
+class DistributedTimeoutError(RuntimeError):
+    """A distributed peer or collective missed its deadline.
+
+    The typed replacement for the two silent failure modes of the multihost
+    path: an indefinite hang inside ``jax.distributed.initialize`` / a DCN
+    collective (a dead peer never answers), and a bring-up that fails every
+    retry. ``diagnostics`` carries whatever the detecting layer knows —
+    per-peer heartbeat ages, attempt counts, the coordinator address — so
+    the operator learns *which* peer died, not just that something did.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        elapsed_s: Optional[float] = None,
+        deadline_s: Optional[float] = None,
+        diagnostics: Tuple[str, ...] = (),
+    ) -> None:
+        if diagnostics:
+            message = message + " [" + "; ".join(diagnostics) + "]"
+        super().__init__(message)
+        self.elapsed_s = elapsed_s
+        self.deadline_s = deadline_s
+        self.diagnostics = tuple(diagnostics)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Capped exponential backoff schedule.
+
+    ``jitter`` is a fraction: each delay is scaled by ``1 + jitter*(2u-1)``
+    (``u ~ U[0,1)``), keeping it within ``±jitter`` of the deterministic
+    curve — enough to de-synchronise a pod's workers hammering one
+    coordinator, small enough to keep the schedule predictable.
+    ``deadline_s`` bounds the whole operation (None = attempts-only).
+    """
+
+    max_attempts: int = 5
+    base_delay_s: float = 0.5
+    multiplier: float = 2.0
+    max_delay_s: float = 30.0
+    jitter: float = 0.1
+    deadline_s: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        if self.max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {self.max_attempts}")
+        if self.base_delay_s < 0 or self.max_delay_s < 0:
+            raise ValueError("delays must be non-negative")
+        if not 0.0 <= self.jitter < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {self.jitter}")
+
+    def delay(self, attempt: int, u: float = 0.5) -> float:
+        """Jittered sleep after failed attempt ``attempt`` (0-based).
+        ``u in [0, 1)``; the default midpoint gives the deterministic curve."""
+        base = min(self.max_delay_s, self.base_delay_s * self.multiplier**attempt)
+        return base * (1.0 + self.jitter * (2.0 * u - 1.0))
+
+
+def backoff_schedule(
+    policy: RetryPolicy, attempts: Optional[int] = None, seed: int = 0
+) -> List[float]:
+    """The exact delays :func:`retry_call` would sleep for this policy and
+    seed — a reproducible preview for tests and capacity planning."""
+    rng = random.Random(seed)
+    n = (policy.max_attempts - 1) if attempts is None else attempts
+    return [policy.delay(a, rng.random()) for a in range(n)]
+
+
+def retry_call(
+    fn: Callable[[], object],
+    *,
+    policy: Optional[RetryPolicy] = None,
+    retry_on: tuple = (Exception,),
+    describe: str = "operation",
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+    seed: int = 0,
+):
+    """Call ``fn`` under ``policy``; returns its result or raises
+    :class:`RetryError`.
+
+    Only ``retry_on`` exceptions are retried — everything else (including
+    ``KeyboardInterrupt``/``SystemExit``, which are not ``Exception``
+    subclasses) propagates immediately. ``clock``/``sleep`` are injectable
+    so schedules are provable without real time passing; ``seed`` fixes the
+    jitter stream (:func:`backoff_schedule` with the same seed previews it).
+    """
+    policy = policy or RetryPolicy()
+    rng = random.Random(seed)
+    start = clock()
+    for attempt in range(policy.max_attempts):
+        try:
+            return fn()
+        except retry_on as exc:
+            elapsed = clock() - start
+            if attempt == policy.max_attempts - 1:
+                raise RetryError(
+                    f"{describe} failed after {attempt + 1} attempt(s) over "
+                    f"{elapsed:.2f}s; last error: {exc!r}",
+                    attempts=attempt + 1,
+                    elapsed_s=elapsed,
+                    last_exception=exc,
+                ) from exc
+            delay = policy.delay(attempt, rng.random())
+            if (
+                policy.deadline_s is not None
+                and elapsed + delay > policy.deadline_s
+            ):
+                raise RetryError(
+                    f"{describe} abandoned after {attempt + 1} attempt(s): "
+                    f"the next retry (+{delay:.2f}s backoff) would exceed the "
+                    f"{policy.deadline_s:.2f}s deadline ({elapsed:.2f}s "
+                    f"elapsed); last error: {exc!r}",
+                    attempts=attempt + 1,
+                    elapsed_s=elapsed,
+                    last_exception=exc,
+                ) from exc
+            logger.warning(
+                "%s attempt %d/%d failed (%r); retrying in %.2fs",
+                describe,
+                attempt + 1,
+                policy.max_attempts,
+                exc,
+                delay,
+            )
+            sleep(delay)
+    raise AssertionError("unreachable: loop either returns or raises")

@@ -1,0 +1,207 @@
+"""Deadline watchdogs: bounded execution for code that can hang, not just fail.
+
+The retry layer (:mod:`.retry`) handles operations that *raise*; this module
+handles the nastier class that simply never returns — a DCN collective
+waiting on a dead peer, a native walker wedged in a pathological input, a
+Pallas kernel stuck in compilation. Python cannot cancel such work, so the
+watchdog runs it in a daemon worker thread and *abandons* it at the
+deadline: the stalled thread keeps whatever it was doing (it dies with the
+process), while the caller gets a typed :class:`WatchdogTimeout` promptly
+and can take a different path — the scoring dispatch retries on the
+portable gather kernel through the degradation ladder
+(``score_matrix(timeout_s=...)``, rung ``scoring_timeout``), and the
+multihost worker converts it into a
+:class:`~isoforest_tpu.resilience.retry.DistributedTimeoutError` carrying
+per-peer heartbeat diagnostics.
+
+Heartbeats are the companion primitive: each multihost process runs a
+:class:`HeartbeatWriter` (a background thread re-writing a small JSON file
+every ``interval_s``), and on timeout any survivor reads the whole
+directory back with :func:`peer_heartbeat_ages` — so the error names the
+peer that went quiet and for how long, instead of reporting only "my own
+deadline passed".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+
+class WatchdogTimeout(RuntimeError):
+    """The watched operation did not finish inside its deadline."""
+
+    def __init__(self, message: str, *, deadline_s: Optional[float] = None) -> None:
+        super().__init__(message)
+        self.deadline_s = deadline_s
+
+
+# threads whose deadline fired and were left behind; an interpreter exiting
+# while one is inside native/XLA code can abort in C++, so tests drain them
+# with join_abandoned() after releasing whatever stalled them
+_abandoned: list = []
+_abandoned_lock = threading.Lock()
+
+
+def join_abandoned(timeout_s: float = 5.0) -> int:
+    """Join previously abandoned watchdog threads (test teardown hygiene);
+    returns how many are still alive after ``timeout_s``. Release the stall
+    first (e.g. exit the ``slow_collective`` inject scope) or they cannot
+    finish."""
+    deadline = time.monotonic() + timeout_s
+    with _abandoned_lock:
+        threads = list(_abandoned)
+    for worker in threads:
+        worker.join(timeout=max(0.0, deadline - time.monotonic()))
+    alive = [w for w in threads if w.is_alive()]
+    with _abandoned_lock:
+        _abandoned[:] = alive
+    return len(alive)
+
+
+def run_with_deadline(
+    fn: Callable[[], object],
+    timeout_s: float,
+    *,
+    describe: str = "operation",
+    on_timeout: Optional[Callable[[], str]] = None,
+):
+    """Run ``fn()`` with a hard wall-clock deadline; returns its result,
+    re-raises its exception, or raises :class:`WatchdogTimeout`.
+
+    The work runs in a daemon thread. On timeout the thread is ABANDONED —
+    Python has no thread cancellation — so use this only around operations
+    whose stalled continuation is harmless (a wedged kernel, a blocked
+    collective) and where the caller falls back to a different code path.
+    ``on_timeout`` supplies extra diagnostics (e.g. peer heartbeat ages)
+    for the error message at the moment the deadline fires.
+    """
+    if timeout_s <= 0:
+        raise ValueError(f"timeout_s must be positive, got {timeout_s}")
+    outcome: dict = {}
+    done = threading.Event()
+
+    def target() -> None:
+        try:
+            outcome["value"] = fn()
+        except BaseException as exc:  # re-raised in the caller below
+            outcome["error"] = exc
+        finally:
+            done.set()
+
+    worker = threading.Thread(
+        target=target, daemon=True, name=f"isoforest-watchdog[{describe}]"
+    )
+    worker.start()
+    if not done.wait(timeout_s):
+        with _abandoned_lock:
+            _abandoned.append(worker)
+        detail = ""
+        if on_timeout is not None:
+            try:
+                detail = on_timeout()
+            except Exception as exc:
+                detail = f"(diagnostics unavailable: {exc!r})"
+        raise WatchdogTimeout(
+            f"{describe} exceeded its {timeout_s:g}s deadline; the stalled "
+            "worker thread was abandoned" + (f" [{detail}]" if detail else ""),
+            deadline_s=timeout_s,
+        )
+    if "error" in outcome:
+        raise outcome["error"]
+    return outcome["value"]
+
+
+# --------------------------------------------------------------------------- #
+# peer heartbeats (multihost liveness diagnostics)
+# --------------------------------------------------------------------------- #
+
+_HEARTBEAT_PREFIX = "heartbeat-"
+
+
+class HeartbeatWriter:
+    """Background thread re-writing ``<dir>/heartbeat-<name>.json`` every
+    ``interval_s`` with a wall-clock timestamp — one per multihost process,
+    so survivors can tell a dead peer from a slow one. Writes are
+    tmp-file + ``os.replace`` so a reader never sees a torn JSON."""
+
+    def __init__(
+        self,
+        directory: str,
+        name: str,
+        interval_s: float = 1.0,
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        self.path = os.path.join(directory, f"{_HEARTBEAT_PREFIX}{name}.json")
+        self.name = str(name)
+        self.interval_s = float(interval_s)
+        self._clock = clock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self) -> None:
+        """Write one heartbeat now (also called by the background loop)."""
+        payload = {"name": self.name, "pid": os.getpid(), "time": self._clock()}
+        tmp = f"{self.path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, self.path)
+
+    def start(self) -> "HeartbeatWriter":
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self.beat()  # first beat synchronously: peers see us immediately
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"isoforest-heartbeat[{self.name}]"
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.beat()
+            except OSError:  # a full/vanished disk must not kill the worker
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval_s)
+
+
+def peer_heartbeat_ages(
+    directory: str, clock: Callable[[], float] = time.time
+) -> Dict[str, float]:
+    """``{peer name: seconds since its last heartbeat}`` for every heartbeat
+    file under ``directory``; unreadable/torn files report ``inf`` (a peer
+    that died mid-write is still a dead peer)."""
+    ages: Dict[str, float] = {}
+    if not os.path.isdir(directory):
+        return ages
+    for fname in sorted(os.listdir(directory)):
+        if not fname.startswith(_HEARTBEAT_PREFIX) or not fname.endswith(".json"):
+            continue
+        name = fname[len(_HEARTBEAT_PREFIX) : -len(".json")]
+        try:
+            with open(os.path.join(directory, fname)) as fh:
+                payload = json.load(fh)
+            ages[name] = max(0.0, clock() - float(payload["time"]))
+        except (OSError, ValueError, KeyError, TypeError):
+            ages[name] = float("inf")
+    return ages
+
+
+def format_heartbeat_ages(ages: Dict[str, float], stale_after_s: float) -> str:
+    """Human summary for timeout diagnostics: flags peers whose last beat is
+    older than ``stale_after_s`` as likely dead."""
+    if not ages:
+        return "no peer heartbeats found"
+    parts = []
+    for name in sorted(ages):
+        age = ages[name]
+        flag = " (LIKELY DEAD)" if age > stale_after_s else ""
+        parts.append(f"peer {name}: last heartbeat {age:.1f}s ago{flag}")
+    return ", ".join(parts)

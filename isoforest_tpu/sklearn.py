@@ -83,10 +83,27 @@ class TpuIsolationForest(BaseEstimator, OutlierMixin):
             )
         return IsolationForest(params=IsolationForestParams(**common))
 
-    def fit(self, X, y=None, mesh=None):
+    def fit(
+        self,
+        X,
+        y=None,
+        mesh=None,
+        checkpoint_dir=None,
+        checkpoint_every=None,
+        resume=False,
+    ):
+        """Fit; ``checkpoint_dir``/``checkpoint_every``/``resume`` enable
+        preemption-safe block-wise training with bitwise-identical resume
+        (docs/resilience.md §5), threaded straight to the underlying
+        estimator's ``fit``."""
         X = np.asarray(X, np.float32)
         self.model_ = self._build_estimator().fit(
-            X, mesh=mesh, nonfinite=self.nonfinite
+            X,
+            mesh=mesh,
+            nonfinite=self.nonfinite,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            resume=resume,
         )
         thr = self.model_.outlier_score_threshold
         # decision_function offset: sklearn flags decision_function < 0

@@ -4,9 +4,17 @@ global mesh over DCN(Gloo) collectives, running the fused distributed train
 step. The TPU-pod analogue is identical code with real hosts/ICI
 (parallel/mesh.py::initialize_distributed).
 
+Fault-tolerance hardening (docs/resilience.md §7): each worker writes
+peer-visible heartbeats and runs its whole distributed body under a hard
+deadline, so a dead peer produces a typed ``DistributedTimeoutError`` naming
+the quiet peer (exit code :data:`EXIT_TIMEOUT`) instead of an indefinite
+hang — the property the kill-one-worker test pins.
+
 Importable for :data:`STEP_KWARGS` (the single source of the step config the
 host test must mirror); the distributed body only runs as ``__main__``.
 """
+
+import argparse
 
 # single source for the step config — the host test mirrors these exactly
 STEP_KWARGS = dict(
@@ -18,73 +26,197 @@ STEP_KWARGS = dict(
     contamination=0.05,
 )
 
+# distinct exit codes so the host test can assert the FAILURE MODE, not just
+# "nonzero": a typed deadline error is the designed outcome of a dead peer,
+# any other crash is a bug
+EXIT_TIMEOUT = 43
+EXIT_DIED_EARLY = 44
+
+HEARTBEAT_INTERVAL_S = 0.5
+
+
+def _parse_args(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("proc_id", type=int)
+    parser.add_argument("nprocs", type=int)
+    parser.add_argument("port")
+    parser.add_argument("out_path")
+    parser.add_argument(
+        "--heartbeat-dir",
+        default=None,
+        help="directory for peer-visible heartbeat files (resilience.watchdog)",
+    )
+    parser.add_argument(
+        "--deadline-s",
+        type=float,
+        default=0.0,
+        help="hard wall-clock bound on the whole distributed body; "
+        "0 disables the watchdog (legacy behaviour)",
+    )
+    parser.add_argument(
+        "--die-early",
+        action="store_true",
+        help="announce a heartbeat then exit before joining the collective "
+        "(the killed-peer simulation the kill-one-worker test drives)",
+    )
+    return parser.parse_args(argv)
+
 
 def main() -> None:
     import os
     import sys
 
-    proc_id = int(sys.argv[1])
-    nprocs = int(sys.argv[2])
-    port = sys.argv[3]
-    out_path = sys.argv[4]
+    args = _parse_args()
 
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(
-        coordinator_address=f"127.0.0.1:{port}",
-        num_processes=nprocs,
-        process_id=proc_id,
+    try:
+        # cross-process CPU collectives default to "none" on the jax range
+        # this repo supports (0.4.x-0.6.x) — without gloo the train step
+        # fails with "Multiprocess computations aren't implemented on the
+        # CPU backend" before a single collective runs
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):  # option renamed/removed upstream
+        pass
+
+    from isoforest_tpu.resilience.retry import DistributedTimeoutError
+    from isoforest_tpu.resilience.watchdog import (
+        HeartbeatWriter,
+        WatchdogTimeout,
+        format_heartbeat_ages,
+        peer_heartbeat_ages,
+        run_with_deadline,
     )
 
-    import numpy as np
-    from jax.experimental import multihost_utils
-    from jax.sharding import Mesh
+    heartbeat = None
+    if args.heartbeat_dir:
+        heartbeat = HeartbeatWriter(
+            args.heartbeat_dir,
+            f"proc{args.proc_id}",
+            interval_s=HEARTBEAT_INTERVAL_S,
+        ).start()
 
-    from isoforest_tpu.parallel import make_train_step
-    from isoforest_tpu.parallel.mesh import DATA_AXIS, TREES_AXIS
+    if args.die_early:
+        # the killed-peer simulation: visible to peers (one heartbeat is on
+        # disk), but never joins the collective — survivors must detect the
+        # silence within their deadline, not hang
+        print(f"worker {args.proc_id}: dying before joining", flush=True)
+        raise SystemExit(EXIT_DIED_EARLY)
 
-    devices = jax.devices()
-    assert len(devices) == 4 * nprocs, f"expected {4 * nprocs} global devices"
-    mesh = Mesh(np.asarray(devices).reshape(2, 2 * nprocs), (DATA_AXIS, TREES_AXIS))
+    def body() -> None:
+        from isoforest_tpu.parallel.mesh import initialize_distributed
 
-    rng = np.random.default_rng(0)
-    X = rng.normal(size=(512, 4)).astype(np.float32)
-    X[:8] += 6.0
-
-    step = make_train_step(mesh, **STEP_KWARGS)
-    result = step(jax.random.PRNGKey(0), X)
-    scores = np.asarray(multihost_utils.process_allgather(result.scores, tiled=True))
-    threshold = float(result.threshold)
-
-    # second step with an error budget: the threshold comes from the
-    # psum-able refined-histogram sketch, whose collectives here cross a
-    # REAL process boundary over Gloo — the multi-host approxQuantile
-    # replacement end to end
-    step_sketch = make_train_step(mesh, **STEP_KWARGS, contamination_error=0.02)
-    result_sketch = step_sketch(jax.random.PRNGKey(0), X)
-    threshold_sketch = float(result_sketch.threshold)
-    # the element-of-scores contract holds against the SKETCH program's own
-    # scores (a separately compiled program may differ from the first step's
-    # scores by a ulp)
-    scores_sketch = np.asarray(
-        multihost_utils.process_allgather(result_sketch.scores, tiled=True)
-    )
-
-    if proc_id == 0:
-        np.savez(
-            out_path,
-            scores=scores,
-            threshold=threshold,
-            threshold_sketch=threshold_sketch,
-            scores_sketch=scores_sketch,
+        # the production bring-up path (retry/backoff + typed exhaustion).
+        # Deliberately NO timeout_s here: clamping jax's own
+        # initialization_timeout makes the XLA coordination service treat a
+        # missing peer as a FATAL error and abort() the process before
+        # Python can raise — the body watchdog below is what bounds a
+        # stalled bring-up, and it exits typed instead
+        initialize_distributed(
+            coordinator_address=f"127.0.0.1:{args.port}",
+            num_processes=args.nprocs,
+            process_id=args.proc_id,
         )
+
+        import numpy as np
+        from jax.experimental import multihost_utils
+        from jax.sharding import Mesh
+
+        from isoforest_tpu.parallel import make_train_step
+        from isoforest_tpu.parallel.mesh import DATA_AXIS, TREES_AXIS
+
+        devices = jax.devices()
+        assert (
+            len(devices) == 4 * args.nprocs
+        ), f"expected {4 * args.nprocs} global devices"
+        mesh = Mesh(
+            np.asarray(devices).reshape(2, 2 * args.nprocs),
+            (DATA_AXIS, TREES_AXIS),
+        )
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(512, 4)).astype(np.float32)
+        X[:8] += 6.0
+
+        step = make_train_step(mesh, **STEP_KWARGS)
+        result = step(jax.random.PRNGKey(0), X)
+        scores = np.asarray(
+            multihost_utils.process_allgather(result.scores, tiled=True)
+        )
+        threshold = float(result.threshold)
+
+        # second step with an error budget: the threshold comes from the
+        # psum-able refined-histogram sketch, whose collectives here cross a
+        # REAL process boundary over Gloo — the multi-host approxQuantile
+        # replacement end to end
+        step_sketch = make_train_step(
+            mesh, **STEP_KWARGS, contamination_error=0.02
+        )
+        result_sketch = step_sketch(jax.random.PRNGKey(0), X)
+        threshold_sketch = float(result_sketch.threshold)
+        # the element-of-scores contract holds against the SKETCH program's
+        # own scores (a separately compiled program may differ from the first
+        # step's scores by a ulp)
+        scores_sketch = np.asarray(
+            multihost_utils.process_allgather(result_sketch.scores, tiled=True)
+        )
+
+        if args.proc_id == 0:
+            np.savez(
+                args.out_path,
+                scores=scores,
+                threshold=threshold,
+                threshold_sketch=threshold_sketch,
+                scores_sketch=scores_sketch,
+            )
+            print(
+                f"multihost worker 0: scores {scores.shape} threshold "
+                f"{threshold:.4f} sketch {threshold_sketch:.4f}",
+                flush=True,
+            )
+
+    def _peer_report() -> str:
+        if not args.heartbeat_dir:
+            return "no heartbeat directory configured"
+        return format_heartbeat_ages(
+            peer_heartbeat_ages(args.heartbeat_dir),
+            stale_after_s=4 * HEARTBEAT_INTERVAL_S,
+        )
+
+    try:
+        if args.deadline_s > 0:
+            # hard bound on the WHOLE body: bring-up, both train steps and
+            # their cross-process collectives — a peer dying at any point
+            # becomes a typed error within the deadline
+            try:
+                run_with_deadline(
+                    body,
+                    args.deadline_s,
+                    describe=f"multihost worker {args.proc_id} distributed body",
+                    on_timeout=_peer_report,
+                )
+            except WatchdogTimeout as exc:
+                raise DistributedTimeoutError(
+                    str(exc), deadline_s=args.deadline_s
+                ) from exc
+        else:
+            body()
+    except DistributedTimeoutError as exc:
         print(
-            f"multihost worker 0: scores {scores.shape} threshold "
-            f"{threshold:.4f} sketch {threshold_sketch:.4f}",
+            f"worker {args.proc_id}: DistributedTimeoutError: {exc} "
+            f"[{_peer_report()}]",
+            file=sys.stderr,
             flush=True,
         )
+        # _exit: the abandoned body thread may be wedged inside the XLA
+        # coordination client, whose interpreter-teardown/atexit hooks can
+        # abort() or hang — the typed exit code must win
+        os._exit(EXIT_TIMEOUT)
+    finally:
+        if heartbeat is not None:
+            heartbeat.stop()
 
 
 if __name__ == "__main__":

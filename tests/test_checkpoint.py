@@ -1,0 +1,249 @@
+"""Preemption-safe training matrix (docs/resilience.md §5): a fit killed
+after any sealed block and resumed must produce forest arrays, scores and
+threshold **bitwise identical** to an uninterrupted fit — std and extended
+models, single-device and mesh growth, kill at first/mid/last block. Resume
+safety: config/data fingerprint mismatches refuse loudly, corrupt or
+unsealed blocks are re-grown losslessly, and ``resume=False`` never
+clobbers sealed progress."""
+
+import os
+
+import numpy as np
+import pytest
+
+from isoforest_tpu import ExtendedIsolationForest, IsolationForest
+from isoforest_tpu.parallel import create_mesh
+from isoforest_tpu.resilience import CheckpointMismatchError, faults
+from isoforest_tpu.resilience import checkpoint as ckpt
+from isoforest_tpu.sklearn import TpuIsolationForest
+
+N_TREES = 12
+BLOCK = 4  # -> 3 blocks: kill-at covers first / mid / last
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(17)
+    X = rng.normal(size=(400, 5)).astype(np.float32)
+    X[:10] += 6.0
+    return X
+
+
+def _std():
+    return IsolationForest(num_estimators=N_TREES, max_samples=64.0, random_seed=11)
+
+
+def _ext():
+    return ExtendedIsolationForest(
+        num_estimators=N_TREES, max_samples=64.0, extension_level=2, random_seed=11
+    )
+
+
+_MAKERS = {"std": _std, "ext": _ext}
+
+
+def _assert_bitwise_equal(model_a, model_b, X):
+    __tracebackhide__ = True
+    assert type(model_a.forest) is type(model_b.forest)
+    for field in model_a.forest._fields:
+        a = np.asarray(getattr(model_a.forest, field))
+        b = np.asarray(getattr(model_b.forest, field))
+        assert a.dtype == b.dtype and a.shape == b.shape, field
+        assert np.array_equal(a, b), f"forest field {field!r} differs"
+    assert np.array_equal(model_a.score(X), model_b.score(X))
+    assert model_a.outlier_score_threshold == model_b.outlier_score_threshold
+
+
+# --------------------------------------------------------------------------- #
+# block partition / fingerprint helpers
+# --------------------------------------------------------------------------- #
+
+
+class TestHelpers:
+    def test_resolve_block_size(self):
+        assert ckpt.resolve_block_size(None, 100) == ckpt.DEFAULT_BLOCK_TREES
+        assert ckpt.resolve_block_size(None, 8) == 8  # clamped to ensemble
+        assert ckpt.resolve_block_size(10, 100) == 10
+        assert ckpt.resolve_block_size(500, 100) == 100
+        with pytest.raises(ValueError, match="checkpoint_every"):
+            ckpt.resolve_block_size(0, 100)
+
+    def test_block_ranges_cover_ensemble_exactly(self):
+        ranges = ckpt.block_ranges(10, 4)
+        assert ranges == [(0, 0, 4), (1, 4, 8), (2, 8, 10)]
+        assert ckpt.block_ranges(4, 4) == [(0, 0, 4)]
+
+    def test_data_fingerprint_sensitivity(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(300, 4)).astype(np.float32)
+        base = ckpt.data_fingerprint(X)
+        assert base == ckpt.data_fingerprint(X.copy())  # content, not identity
+        assert base != ckpt.data_fingerprint(X[:-1])  # shape change
+        assert base != ckpt.data_fingerprint(X.astype(np.float64))  # dtype
+        tweaked = X.copy()
+        tweaked[0, 0] += 1.0  # first rows are always sampled
+        assert base != ckpt.data_fingerprint(tweaked)
+
+
+# --------------------------------------------------------------------------- #
+# kill / resume bitwise equivalence
+# --------------------------------------------------------------------------- #
+
+
+class TestKillAndResume:
+    @pytest.mark.parametrize("kind", ["std", "ext"])
+    def test_uninterrupted_checkpointed_fit_is_bitwise(self, data, tmp_path, kind):
+        plain = _MAKERS[kind]().fit(data)
+        ck = _MAKERS[kind]().fit(
+            data, checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=BLOCK
+        )
+        _assert_bitwise_equal(plain, ck, data)
+        assert ck.fit_checkpoint.blocks_written == 3
+        assert ck.fit_checkpoint.blocks_loaded == 0
+        assert plain.fit_checkpoint is None
+
+    @pytest.mark.parametrize("kill_at", [0, 1, 2], ids=["first", "mid", "last"])
+    @pytest.mark.parametrize("kind", ["std", "ext"])
+    def test_killed_fit_resumes_bitwise(self, data, tmp_path, kind, kill_at):
+        plain = _MAKERS[kind]().fit(data)
+        d = str(tmp_path / "ck")
+        with pytest.raises(faults.FaultInjectedError):
+            with faults.inject(kill_fit_after_block=kill_at):
+                _MAKERS[kind]().fit(data, checkpoint_dir=d, checkpoint_every=BLOCK)
+        resumed = _MAKERS[kind]().fit(
+            data, checkpoint_dir=d, checkpoint_every=BLOCK, resume=True
+        )
+        _assert_bitwise_equal(plain, resumed, data)
+        # exactly the sealed blocks were reused, the rest re-grown
+        assert resumed.fit_checkpoint.blocks_loaded == kill_at + 1
+        assert resumed.fit_checkpoint.blocks_written == 3 - (kill_at + 1)
+
+    def test_mesh_checkpointed_fit_matches_local_plain(self, data, tmp_path):
+        mesh = create_mesh()
+        plain = _std().fit(data)
+        d = str(tmp_path / "ck")
+        with pytest.raises(faults.FaultInjectedError):
+            with faults.inject(kill_fit_after_block=1):
+                _std().fit(data, mesh=mesh, checkpoint_dir=d, checkpoint_every=BLOCK)
+        resumed = _std().fit(
+            data, mesh=mesh, checkpoint_dir=d, checkpoint_every=BLOCK, resume=True
+        )
+        _assert_bitwise_equal(plain, resumed, data)
+
+    def test_mesh_extended_checkpointed_fit_matches_local_plain(self, data, tmp_path):
+        mesh = create_mesh()
+        plain = _ext().fit(data)
+        d = str(tmp_path / "ck")
+        with pytest.raises(faults.FaultInjectedError):
+            with faults.inject(kill_fit_after_block=2):
+                _ext().fit(data, mesh=mesh, checkpoint_dir=d, checkpoint_every=BLOCK)
+        resumed = _ext().fit(
+            data, mesh=mesh, checkpoint_dir=d, checkpoint_every=BLOCK, resume=True
+        )
+        _assert_bitwise_equal(plain, resumed, data)
+
+    def test_resume_across_device_placement(self, data, tmp_path):
+        """Blocks sealed by a mesh fit resume bitwise on a single device —
+        the preempted-pod-resumes-on-different-topology case."""
+        mesh = create_mesh()
+        d = str(tmp_path / "ck")
+        with pytest.raises(faults.FaultInjectedError):
+            with faults.inject(kill_fit_after_block=0):
+                _std().fit(data, mesh=mesh, checkpoint_dir=d, checkpoint_every=BLOCK)
+        resumed = _std().fit(data, checkpoint_dir=d, checkpoint_every=BLOCK, resume=True)
+        _assert_bitwise_equal(_std().fit(data), resumed, data)
+
+    def test_sklearn_adapter_kill_and_resume(self, data, tmp_path):
+        d = str(tmp_path / "ck")
+        mk = lambda: TpuIsolationForest(n_estimators=N_TREES, random_state=11)
+        with pytest.raises(faults.FaultInjectedError):
+            with faults.inject(kill_fit_after_block=1):
+                mk().fit(data, checkpoint_dir=d, checkpoint_every=BLOCK)
+        resumed = mk().fit(data, checkpoint_dir=d, checkpoint_every=BLOCK, resume=True)
+        plain = mk().fit(data)
+        assert np.array_equal(plain.score_samples(data), resumed.score_samples(data))
+        assert np.array_equal(
+            plain.decision_function(data), resumed.decision_function(data)
+        )
+
+
+# --------------------------------------------------------------------------- #
+# resume safety: refusals and lossless regrowth
+# --------------------------------------------------------------------------- #
+
+
+class TestResumeSafety:
+    @pytest.fixture()
+    def killed_dir(self, data, tmp_path):
+        d = str(tmp_path / "ck")
+        with pytest.raises(faults.FaultInjectedError):
+            with faults.inject(kill_fit_after_block=1):
+                _std().fit(data, checkpoint_dir=d, checkpoint_every=BLOCK)
+        return d
+
+    def test_mismatched_config_refuses(self, data, killed_dir):
+        with pytest.raises(CheckpointMismatchError, match="randomSeed") as err:
+            IsolationForest(
+                num_estimators=N_TREES, max_samples=64.0, random_seed=99
+            ).fit(data, checkpoint_dir=killed_dir, checkpoint_every=BLOCK, resume=True)
+        assert "randomSeed" in err.value.mismatched_fields
+
+    def test_mismatched_data_refuses(self, data, killed_dir):
+        other = data.copy()
+        other[0, 0] += 1.0
+        with pytest.raises(CheckpointMismatchError, match="dataSha256"):
+            _std().fit(other, checkpoint_dir=killed_dir, checkpoint_every=BLOCK, resume=True)
+
+    def test_mismatched_block_size_refuses(self, data, killed_dir):
+        """The block partition is part of the fingerprint: resuming with a
+        different checkpoint_every would misalign sealed tree ranges."""
+        with pytest.raises(CheckpointMismatchError, match="blockTrees"):
+            _std().fit(data, checkpoint_dir=killed_dir, checkpoint_every=6, resume=True)
+
+    def test_resume_false_refuses_sealed_progress(self, data, killed_dir):
+        with pytest.raises(CheckpointMismatchError, match="resume=True"):
+            _std().fit(data, checkpoint_dir=killed_dir, checkpoint_every=BLOCK)
+
+    def test_corrupt_block_regrown_lossless(self, data, killed_dir):
+        npz = os.path.join(killed_dir, "block-00001", ckpt._ARRAYS_NAME)
+        raw = bytearray(open(npz, "rb").read())
+        raw[len(raw) // 2] ^= 0x5A
+        open(npz, "wb").write(bytes(raw))
+        resumed = _std().fit(
+            data, checkpoint_dir=killed_dir, checkpoint_every=BLOCK, resume=True
+        )
+        _assert_bitwise_equal(_std().fit(data), resumed, data)
+        # the corrupt block was re-grown, not trusted
+        assert resumed.fit_checkpoint.blocks_loaded == 1
+        assert resumed.fit_checkpoint.blocks_written == 2
+
+    def test_unsealed_block_regrown(self, data, killed_dir):
+        os.remove(os.path.join(killed_dir, "block-00000", "_MANIFEST.json"))
+        resumed = _std().fit(
+            data, checkpoint_dir=killed_dir, checkpoint_every=BLOCK, resume=True
+        )
+        _assert_bitwise_equal(_std().fit(data), resumed, data)
+        assert resumed.fit_checkpoint.blocks_loaded == 1
+
+    def test_sealed_blocks_without_fingerprint_refuse(self, data, killed_dir):
+        os.remove(os.path.join(killed_dir, ckpt.FINGERPRINT_NAME))
+        with pytest.raises(CheckpointMismatchError, match="no fingerprint"):
+            _std().fit(data, checkpoint_dir=killed_dir, checkpoint_every=BLOCK, resume=True)
+
+    def test_unreadable_fingerprint_refuses(self, data, killed_dir):
+        with open(os.path.join(killed_dir, ckpt.FINGERPRINT_NAME), "w") as fh:
+            fh.write("{not json")
+        with pytest.raises(CheckpointMismatchError, match="unreadable"):
+            _std().fit(data, checkpoint_dir=killed_dir, checkpoint_every=BLOCK, resume=True)
+
+    def test_env_hook_arms_kill(self, data, tmp_path, monkeypatch):
+        """The CI chaos step arms the kill through the environment, not
+        inject() — prove the env spelling lands on the same seam."""
+        monkeypatch.setenv("ISOFOREST_TPU_FAULTS", "kill_fit_after_block=0")
+        with pytest.raises(faults.FaultInjectedError):
+            _std().fit(data, checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=BLOCK)
+        monkeypatch.delenv("ISOFOREST_TPU_FAULTS")
+        resumed = _std().fit(
+            data, checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=BLOCK, resume=True
+        )
+        _assert_bitwise_equal(_std().fit(data), resumed, data)

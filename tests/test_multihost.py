@@ -3,13 +3,22 @@ virtual CPU devices each, one global 8-device mesh with Gloo (DCN-analogue)
 collectives — the closest single-machine exercise of the reference's
 multi-executor distribution (SURVEY.md §5.8). The distributed result must
 match the single-process 8-device result exactly (global per-tree PRNG
-streams make sharding placement-invariant)."""
+streams make sharding placement-invariant).
+
+Hardened (docs/resilience.md §7) so tier-1 can never wedge here: every
+spawned worker runs under a hard host-side wall-clock timeout AND its own
+in-process deadline watchdog, every exit path (including assertion
+failures) reaps the whole process group, and a kill-one-worker test pins
+the designed failure mode — a dead peer yields a typed
+``DistributedTimeoutError`` naming the quiet peer, within the deadline,
+instead of an indefinite hang."""
 
 import os
 import pathlib
 import socket
 import subprocess
 import sys
+import time
 
 import jax
 import numpy as np
@@ -19,6 +28,11 @@ from isoforest_tpu.parallel import create_mesh, make_train_step
 
 _WORKER = pathlib.Path(__file__).parent / "multihost_worker.py"
 
+# host-side hard bound per worker; the in-worker watchdog (--deadline-s)
+# always fires first on a hang, so hitting this means the watchdog itself
+# failed — still a clean kill + failure, never a wedged tier-1
+_HARD_TIMEOUT_S = 540
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -26,35 +40,80 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _spawn(proc_id: int, nprocs: int, port: int, out, *extra: str):
+    env = dict(os.environ)
+    repo_root = str(_WORKER.parent.parent)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [
+            sys.executable,
+            str(_WORKER),
+            str(proc_id),
+            str(nprocs),
+            str(port),
+            str(out),
+            *extra,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+    )
+
+
+def _reap(procs) -> None:
+    """Kill and wait every worker still running — no orphans survive a
+    failure, and no zombie lingers past the test."""
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:  # pragma: no cover - kernel-level wedge
+            pass
+
+
+def _communicate_all(procs, timeout_s: float):
+    """Collect every worker's output under one shared wall-clock budget;
+    any overrun kills the whole group and fails loudly."""
+    logs = []
+    deadline = time.monotonic() + timeout_s
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=max(1.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            _reap(procs)
+            pytest.fail(
+                f"multihost workers exceeded the {timeout_s:.0f}s host-side "
+                "hard timeout (the in-worker watchdog should have fired "
+                "first); group killed"
+            )
+        logs.append(stdout)
+    return logs
+
+
 @pytest.mark.slow
 def test_two_process_train_step_matches_single_process(tmp_path):
     port = _free_port()
     out = tmp_path / "mh_result.npz"
-    env = dict(os.environ)
-    repo_root = str(_WORKER.parent.parent)
-    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(_WORKER), str(i), "2", str(port), str(out)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-            env=env,
-        )
-        for i in range(2)
-    ]
-    logs = []
-    for p in procs:
-        try:
-            stdout, _ = p.communicate(timeout=600)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("multihost workers timed out")
-        logs.append(stdout)
-    for p, log in zip(procs, logs):
-        assert p.returncode == 0, f"worker failed:\n{log[-3000:]}"
-    assert out.exists(), f"worker 0 produced no result:\n{logs[0][-2000:]}"
+    hb_dir = tmp_path / "heartbeats"
+    extra = (
+        f"--heartbeat-dir={hb_dir}",
+        f"--deadline-s={_HARD_TIMEOUT_S - 60}",
+    )
+    procs = [_spawn(i, 2, port, out, *extra) for i in range(2)]
+    try:
+        logs = _communicate_all(procs, _HARD_TIMEOUT_S)
+        for p, log in zip(procs, logs):
+            assert p.returncode == 0, f"worker failed:\n{log[-3000:]}"
+        assert out.exists(), f"worker 0 produced no result:\n{logs[0][-2000:]}"
+    finally:
+        _reap(procs)
+
+    # both workers heartbeated through the run
+    beats = sorted(f.name for f in hb_dir.glob("heartbeat-*.json"))
+    assert beats == ["heartbeat-proc0.json", "heartbeat-proc1.json"]
 
     dist = np.load(out)
 
@@ -83,3 +142,41 @@ def test_two_process_train_step_matches_single_process(tmp_path):
     assert thr_sketch == pytest.approx(float(local_sketch.threshold), abs=1e-6)
     # membership is guaranteed against the sketch program's OWN scores
     assert np.float32(thr_sketch) in np.asarray(dist["scores_sketch"], np.float32)
+
+
+@pytest.mark.slow
+def test_killed_worker_yields_typed_timeout_not_hang(tmp_path):
+    """The designed dead-peer outcome: worker 1 announces itself then dies
+    before joining the collective; worker 0 must exit with the dedicated
+    typed-timeout code within its deadline, and its error must name the
+    quiet peer — never hang (the failure mode this suite had at seed, where
+    only a 600s host timeout bounded it)."""
+    from multihost_worker import EXIT_DIED_EARLY, EXIT_TIMEOUT
+
+    port = _free_port()
+    out = tmp_path / "unused.npz"
+    hb_dir = tmp_path / "heartbeats"
+    deadline_s = 15.0
+    procs = [
+        _spawn(0, 2, port, out, f"--heartbeat-dir={hb_dir}", f"--deadline-s={deadline_s}"),
+        _spawn(1, 2, port, out, f"--heartbeat-dir={hb_dir}", f"--deadline-s={deadline_s}", "--die-early"),
+    ]
+    try:
+        start = time.monotonic()
+        logs = _communicate_all(procs, 120)
+        elapsed = time.monotonic() - start
+    finally:
+        _reap(procs)
+
+    assert procs[1].returncode == EXIT_DIED_EARLY, logs[1][-2000:]
+    # the survivor failed TYPED, promptly, and named the dead peer
+    assert procs[0].returncode == EXIT_TIMEOUT, (
+        f"expected exit {EXIT_TIMEOUT} (typed DistributedTimeoutError), got "
+        f"{procs[0].returncode}:\n{logs[0][-3000:]}"
+    )
+    assert "DistributedTimeoutError" in logs[0]
+    assert "proc1" in logs[0], logs[0][-3000:]
+    # deadline + generous slack for interpreter startup/teardown — the point
+    # is "seconds, not the 600s host timeout"
+    assert elapsed < 90, f"typed failure took {elapsed:.0f}s"
+    assert not out.exists()

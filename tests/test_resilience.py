@@ -528,3 +528,373 @@ class TestNonfinitePolicy:
         with pytest.raises(ValueError, match="non-finite"):
             TpuIsolationForest(n_estimators=2, nonfinite="raise").fit(data).predict(X)
         assert clf2.predict(data[:8]).shape == (8,)
+
+
+# --------------------------------------------------------------------------- #
+# retry/backoff: provable schedules, zero real sleeps (docs/resilience.md §7)
+# --------------------------------------------------------------------------- #
+
+
+class TestRetryPolicy:
+    def test_deterministic_curve_without_jitter(self):
+        from isoforest_tpu.resilience import RetryPolicy
+        from isoforest_tpu.resilience.retry import backoff_schedule
+
+        policy = RetryPolicy(
+            max_attempts=6, base_delay_s=0.5, multiplier=2.0, max_delay_s=3.0, jitter=0.0
+        )
+        assert backoff_schedule(policy) == [0.5, 1.0, 2.0, 3.0, 3.0]  # capped
+
+    def test_jitter_bounds_and_reproducibility(self):
+        from isoforest_tpu.resilience import RetryPolicy
+        from isoforest_tpu.resilience.retry import backoff_schedule
+
+        policy = RetryPolicy(
+            max_attempts=8, base_delay_s=1.0, multiplier=2.0, max_delay_s=60.0, jitter=0.2
+        )
+        sched = backoff_schedule(policy, seed=7)
+        assert sched == backoff_schedule(policy, seed=7)  # seeded: reproducible
+        assert sched != backoff_schedule(policy, seed=8)
+        for attempt, delay in enumerate(sched):
+            base = min(60.0, 1.0 * 2.0**attempt)
+            assert base * 0.8 <= delay <= base * 1.2  # within ±jitter
+
+    def test_invalid_policies_rejected(self):
+        from isoforest_tpu.resilience import RetryPolicy
+
+        with pytest.raises(ValueError, match="max_attempts"):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError, match="jitter"):
+            RetryPolicy(jitter=1.5)
+        with pytest.raises(ValueError, match="non-negative"):
+            RetryPolicy(base_delay_s=-1.0)
+
+
+class TestRetryCall:
+    def test_success_after_transients_sleeps_exact_schedule(self):
+        from isoforest_tpu.resilience import RetryPolicy, retry_call
+        from isoforest_tpu.resilience.faults import FakeClock
+        from isoforest_tpu.resilience.retry import backoff_schedule
+
+        clk = FakeClock()
+        policy = RetryPolicy(max_attempts=5, base_delay_s=1.0, jitter=0.1)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("coordinator not up")
+            return "up"
+
+        assert (
+            retry_call(flaky, policy=policy, clock=clk.now, sleep=clk.sleep, seed=5)
+            == "up"
+        )
+        assert len(calls) == 3
+        # the sleeps are EXACTLY the previewable seeded schedule
+        assert clk.sleeps == backoff_schedule(policy, attempts=2, seed=5)
+
+    def test_exhaustion_raises_typed_with_diagnostics(self):
+        from isoforest_tpu.resilience import RetryError, RetryPolicy, retry_call
+        from isoforest_tpu.resilience.faults import FakeClock
+
+        clk = FakeClock()
+        policy = RetryPolicy(max_attempts=3, base_delay_s=1.0, jitter=0.0)
+        boom = OSError("port in use")
+
+        def always_fails():
+            clk.advance(0.25)  # each attempt costs virtual time
+            raise boom
+
+        with pytest.raises(RetryError) as err:
+            retry_call(always_fails, policy=policy, clock=clk.now, sleep=clk.sleep)
+        assert err.value.attempts == 3
+        assert err.value.last_exception is boom
+        assert err.value.elapsed_s == pytest.approx(3 * 0.25 + 1.0 + 2.0)
+        assert clk.sleeps == [1.0, 2.0]  # no sleep after the final attempt
+
+    def test_deadline_abandons_unaffordable_retry(self):
+        from isoforest_tpu.resilience import RetryError, RetryPolicy, retry_call
+        from isoforest_tpu.resilience.faults import FakeClock
+
+        clk = FakeClock()
+        policy = RetryPolicy(
+            max_attempts=10, base_delay_s=4.0, jitter=0.0, deadline_s=5.0
+        )
+
+        def always_fails():
+            clk.advance(1.0)
+            raise OSError("nope")
+
+        with pytest.raises(RetryError, match="deadline") as err:
+            retry_call(always_fails, policy=policy, clock=clk.now, sleep=clk.sleep)
+        # attempt 1 (1s) + 4s backoff = 5s, attempt 2 at t=5, its 8s backoff
+        # would overrun the 5s deadline -> abandoned with 2 attempts made,
+        # not 10, and the second backoff never slept
+        assert err.value.attempts == 2
+        assert clk.sleeps == [4.0]
+
+    def test_non_matching_exception_propagates_immediately(self):
+        from isoforest_tpu.resilience import RetryPolicy, retry_call
+        from isoforest_tpu.resilience.faults import FakeClock
+
+        clk = FakeClock()
+        calls = []
+
+        def wrong_kind():
+            calls.append(1)
+            raise ValueError("config error, not transient")
+
+        with pytest.raises(ValueError, match="config error"):
+            retry_call(
+                wrong_kind,
+                policy=RetryPolicy(max_attempts=5),
+                retry_on=(OSError,),
+                clock=clk.now,
+                sleep=clk.sleep,
+            )
+        assert calls == [1] and clk.sleeps == []
+
+
+# --------------------------------------------------------------------------- #
+# distributed bring-up: retry + typed timeout (docs/resilience.md §7)
+# --------------------------------------------------------------------------- #
+
+
+class TestDistributedBringup:
+    def test_single_process_is_noop(self):
+        from isoforest_tpu.parallel.mesh import initialize_distributed
+
+        initialize_distributed()  # num_processes=None
+        initialize_distributed(num_processes=1)
+
+    def test_transient_failures_retried_to_success(self, monkeypatch):
+        import jax
+
+        from isoforest_tpu.parallel.mesh import initialize_distributed
+        from isoforest_tpu.resilience.faults import FakeClock
+
+        real_calls = []
+        monkeypatch.setattr(
+            jax.distributed, "initialize", lambda **kw: real_calls.append(kw)
+        )
+        clk = FakeClock()
+        with faults.inject(fail_distributed_init=2):
+            initialize_distributed(
+                coordinator_address="10.0.0.1:8476",
+                num_processes=4,
+                process_id=1,
+                clock=clk.now,
+                sleep=clk.sleep,
+            )
+        assert len(real_calls) == 1  # first 2 attempts consumed by the fault
+        assert len(clk.sleeps) == 2
+        assert clk.sleeps == sorted(clk.sleeps)  # backoff grows
+
+    def test_exhaustion_raises_distributed_timeout(self, monkeypatch):
+        import jax
+
+        from isoforest_tpu.parallel.mesh import initialize_distributed
+        from isoforest_tpu.resilience import DistributedTimeoutError
+        from isoforest_tpu.resilience.faults import FakeClock
+
+        monkeypatch.setattr(
+            jax.distributed,
+            "initialize",
+            lambda **kw: pytest.fail("must never reach jax"),
+        )
+        clk = FakeClock()
+        with faults.inject(fail_distributed_init=99):
+            with pytest.raises(DistributedTimeoutError) as err:
+                initialize_distributed(
+                    coordinator_address="10.0.0.1:8476",
+                    num_processes=4,
+                    process_id=1,
+                    clock=clk.now,
+                    sleep=clk.sleep,
+                )
+        msg = str(err.value)
+        assert "coordinator=10.0.0.1:8476" in msg
+        assert "process_id=1" in msg
+        assert "attempts=3" in msg
+
+    def test_deadline_bounds_whole_bringup(self, monkeypatch):
+        import jax
+
+        from isoforest_tpu.parallel.mesh import initialize_distributed
+        from isoforest_tpu.resilience import DistributedTimeoutError, RetryPolicy
+        from isoforest_tpu.resilience.faults import FakeClock
+
+        def hang_simulated(**kw):
+            clk.advance(10.0)  # each attempt burns 10 virtual seconds
+            raise RuntimeError("barrier timed out")
+
+        monkeypatch.setattr(jax.distributed, "initialize", hang_simulated)
+        clk = FakeClock()
+        with pytest.raises(DistributedTimeoutError) as err:
+            initialize_distributed(
+                coordinator_address="x:1",
+                num_processes=2,
+                process_id=0,
+                timeout_s=12.0,
+                retry_policy=RetryPolicy(max_attempts=10, base_delay_s=4.0, jitter=0.0),
+                clock=clk.now,
+                sleep=clk.sleep,
+            )
+        assert err.value.deadline_s == 12.0
+        # one 10s attempt + 4s backoff overruns 12s: abandoned after 1 attempt
+        assert "attempts=1" in str(err.value)
+
+
+# --------------------------------------------------------------------------- #
+# watchdog primitives + scoring deadline rung (docs/resilience.md §6)
+# --------------------------------------------------------------------------- #
+
+
+class TestWatchdogPrimitives:
+    @pytest.fixture(autouse=True)
+    def _drain_abandoned(self):
+        from isoforest_tpu.resilience import watchdog
+
+        yield
+        assert watchdog.join_abandoned(10.0) == 0
+
+    def test_returns_value_and_reraises(self):
+        from isoforest_tpu.resilience.watchdog import run_with_deadline
+
+        assert run_with_deadline(lambda: 41 + 1, 5.0) == 42
+        with pytest.raises(KeyError, match="boom"):
+            run_with_deadline(lambda: (_ for _ in ()).throw(KeyError("boom")), 5.0)
+        with pytest.raises(ValueError, match="timeout_s"):
+            run_with_deadline(lambda: None, 0.0)
+
+    def test_timeout_carries_on_timeout_diagnostics(self):
+        from isoforest_tpu.resilience.watchdog import WatchdogTimeout, run_with_deadline
+
+        # real (wall-clock) stall: released the moment inject() exits
+        with faults.inject(slow_collective=True):
+            with pytest.raises(WatchdogTimeout, match="peer worker-3") as err:
+                run_with_deadline(
+                    faults.maybe_slow_collective,
+                    0.2,
+                    describe="test op",
+                    on_timeout=lambda: "peer worker-3: last heartbeat 9.0s ago",
+                )
+        assert err.value.deadline_s == 0.2
+
+    def test_heartbeat_files_and_ages(self, tmp_path):
+        from isoforest_tpu.resilience.watchdog import (
+            HeartbeatWriter,
+            format_heartbeat_ages,
+            peer_heartbeat_ages,
+        )
+
+        d = str(tmp_path)
+        # no threads: injected clocks make ages exact
+        HeartbeatWriter(d, "alive", clock=lambda: 100.0).beat()
+        HeartbeatWriter(d, "dead", clock=lambda: 80.0).beat()
+        with open(os.path.join(d, "heartbeat-torn.json"), "w") as fh:
+            fh.write('{"name": "torn", "ti')  # mid-write kill
+        ages = peer_heartbeat_ages(d, clock=lambda: 103.0)
+        assert ages["alive"] == pytest.approx(3.0)
+        assert ages["dead"] == pytest.approx(23.0)
+        assert ages["torn"] == float("inf")
+        report = format_heartbeat_ages(ages, stale_after_s=10.0)
+        assert "peer alive: last heartbeat 3.0s ago" in report
+        assert "peer dead: last heartbeat 23.0s ago (LIKELY DEAD)" in report
+        assert format_heartbeat_ages({}, stale_after_s=1.0) == "no peer heartbeats found"
+
+    def test_heartbeat_writer_thread_beats(self, tmp_path):
+        import time as _time
+
+        from isoforest_tpu.resilience.watchdog import HeartbeatWriter, peer_heartbeat_ages
+
+        hb = HeartbeatWriter(str(tmp_path), "w0", interval_s=0.05).start()
+        try:
+            deadline = _time.monotonic() + 5.0
+            first = json.load(open(hb.path))["time"]
+            while _time.monotonic() < deadline:
+                if json.load(open(hb.path))["time"] > first:
+                    break
+                _time.sleep(0.02)
+            else:
+                pytest.fail("heartbeat never refreshed")
+        finally:
+            hb.stop()
+        assert peer_heartbeat_ages(str(tmp_path))["w0"] < 60.0
+
+
+class TestScoringWatchdog:
+    @pytest.fixture(autouse=True)
+    def _drain_abandoned(self):
+        from isoforest_tpu.resilience import watchdog
+
+        yield
+        assert watchdog.join_abandoned(10.0) == 0
+
+    @pytest.fixture()
+    def prewarmed(self, std_model, data):
+        """Compile dense + gather for this forest up front so watchdog
+        deadlines measure the injected stall, not first-call compile time."""
+        score_matrix(std_model.forest, data[:64], std_model.num_samples, strategy="dense")
+        score_matrix(std_model.forest, data[:64], std_model.num_samples, strategy="gather")
+        return std_model
+
+    def test_stalled_strategy_degrades_to_gather_with_parity(
+        self, prewarmed, data, monkeypatch
+    ):
+        monkeypatch.setenv("ISOFOREST_TPU_STRATEGY", "dense")
+        reset_degradations()
+        baseline = prewarmed.score(data[:64])
+        with faults.inject(slow_collective="dense"):
+            scores = prewarmed.score(data[:64], timeout_s=3.0)
+        np.testing.assert_allclose(scores, baseline, rtol=1e-6, atol=1e-6)
+        events = [e for e in prewarmed.degradations() if e.reason == "scoring_timeout"]
+        assert events and events[0].from_ == "dense" and events[0].to == "gather"
+
+    def test_strict_mode_raises_at_timeout(self, prewarmed, data, monkeypatch):
+        monkeypatch.setenv("ISOFOREST_TPU_STRATEGY", "dense")
+        with faults.inject(slow_collective="dense"):
+            with pytest.raises(DegradationError, match="scoring_timeout"):
+                prewarmed.score(data[:64], strict=True, timeout_s=1.0)
+
+    def test_gather_timeout_raises_no_lower_rung(self, prewarmed, data):
+        from isoforest_tpu.resilience import WatchdogTimeout
+
+        with faults.inject(slow_collective="gather"):
+            with pytest.raises(WatchdogTimeout, match="gather"):
+                score_matrix(
+                    prewarmed.forest,
+                    data[:64],
+                    prewarmed.num_samples,
+                    strategy="gather",
+                    timeout_s=1.0,
+                )
+
+    @pytest.mark.skipif(
+        not __import__("isoforest_tpu.native", fromlist=["available"]).available(),
+        reason="native scorer not built",
+    )
+    def test_stalled_native_walker_degrades(self, prewarmed, data):
+        reset_degradations()
+        baseline = prewarmed.score(data[:64])
+        with faults.inject(slow_collective="native"):
+            scores = score_matrix(
+                prewarmed.forest,
+                data[:64],
+                prewarmed.num_samples,
+                strategy="native",
+                timeout_s=3.0,
+            )
+        np.testing.assert_allclose(scores, baseline, rtol=1e-6, atol=1e-6)
+        assert degradation_report().count("scoring_timeout") >= 1
+
+    def test_no_timeout_means_no_watchdog(self, prewarmed, data):
+        """timeout_s=None is the historical no-watchdog path: a stall is
+        NOT bounded (proved with virtual time, not a real 30s hang)."""
+        from isoforest_tpu.resilience.faults import FakeClock
+
+        clk = FakeClock()
+        with faults.inject(slow_collective=2.0):
+            faults.maybe_slow_collective("dense", clock=clk.now, sleep=clk.sleep)
+        assert clk.now() >= 2.0  # the stall ran its full simulated course
