@@ -428,7 +428,16 @@ def main() -> None:
     # the unified degradation ladder (docs/resilience.md): any fallback the
     # run hit — e.g. native→gather on a toolchain-less host, the EIF pallas
     # fence — is dumped so a benchmark number is never silently mislabeled
+    from isoforest_tpu import telemetry
     from isoforest_tpu.resilience import degradations
+
+    # compact telemetry roll-up (docs/observability.md): per-span phase
+    # totals + event-timeline size, so the headline line carries the same
+    # phase breakdown a full telemetry.snapshot() would explain
+    telemetry_spans = {
+        name: {"count": agg["count"], "total_s": round(agg["total_wall_s"], 3)}
+        for name, agg in telemetry.span_summary().items()
+    }
 
     print(
         json.dumps(
@@ -453,6 +462,8 @@ def main() -> None:
                 "checkpoint_blocks_written": ck["checkpoint_blocks_written"],
                 "checkpointed_fit_s": ck["checkpointed_fit_s"],
                 "degradations": [e.as_dict() for e in degradations()],
+                "telemetry_spans": telemetry_spans,
+                "telemetry_events": len(telemetry.get_events()),
             }
         )
     )

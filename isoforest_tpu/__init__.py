@@ -9,7 +9,7 @@ sharding over a `jax.sharding.Mesh`.
 
 __version__ = "0.6.0"
 
-from . import ops, parallel, resilience, utils  # noqa: F401
+from . import ops, parallel, resilience, telemetry, utils  # noqa: F401
 from .models import (
     ExtendedIsolationForest,
     ExtendedIsolationForestModel,
@@ -21,6 +21,7 @@ __all__ = [
     "ops",
     "parallel",
     "resilience",
+    "telemetry",
     "utils",
     "__version__",
     "ExtendedIsolationForest",

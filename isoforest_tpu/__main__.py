@@ -9,6 +9,8 @@ operational surface here is a small CLI over CSV files:
         --output scores.csv
     python -m isoforest_tpu convert --model /tmp/model --output model.onnx
     python -m isoforest_tpu inspect --model /tmp/model [--tree 0]
+    python -m isoforest_tpu telemetry [--format json|prometheus] \\
+        [--input data.csv [--model /tmp/model]]
 
 CSV rows are feature columns; ``--labeled`` treats the last column as a label
 (excluded from features; used to report AUROC after fit/score).
@@ -167,6 +169,42 @@ def cmd_inspect(args) -> int:
     return 0
 
 
+def cmd_telemetry(args) -> int:
+    """Run a workload with full instrumentation and print the telemetry
+    snapshot — the operational smoke test for the observability layer
+    (docs/observability.md): span timings, metric series and the event
+    timeline for a real fit+score, in JSON or Prometheus exposition.
+
+    With ``--input`` the workload is the user's CSV (scored with ``--model``
+    when given, else fit+scored); without it, a small synthetic mixture.
+    """
+    from . import telemetry
+
+    if args.input:
+        X, _ = _load(args.input, args.labeled)
+        if args.model:
+            model = _load_model(args.model)
+        else:
+            from .models import IsolationForest
+
+            model = IsolationForest(
+                num_estimators=args.trees, random_seed=1
+            ).fit(X)
+    else:
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(args.rows, 4)).astype(np.float32)
+        X[: max(1, args.rows // 100)] += 4.0
+        from .models import IsolationForest
+
+        model = IsolationForest(num_estimators=args.trees, random_seed=1).fit(X)
+    model.score(X)
+    if args.format == "prometheus":
+        print(telemetry.to_prometheus(), end="")
+    else:
+        print(telemetry.snapshot_json(indent=1))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="isoforest_tpu", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -211,6 +249,20 @@ def build_parser() -> argparse.ArgumentParser:
     insp.add_argument("--model", required=True)
     insp.add_argument("--tree", type=int, default=None)
     insp.set_defaults(func=cmd_inspect)
+
+    tele = sub.add_parser(
+        "telemetry",
+        help="run an instrumented workload and print the telemetry snapshot",
+    )
+    tele.add_argument(
+        "--format", choices=("json", "prometheus"), default="json"
+    )
+    tele.add_argument("--input", default=None, help="CSV workload (default: synthetic)")
+    tele.add_argument("--model", default=None, help="score with a saved model")
+    tele.add_argument("--labeled", action="store_true")
+    tele.add_argument("--rows", type=int, default=4096, help="synthetic workload rows")
+    tele.add_argument("--trees", type=int, default=50)
+    tele.set_defaults(func=cmd_telemetry)
     return p
 
 

@@ -29,6 +29,8 @@ from ..utils import (
     resolve_params,
 )
 from .isolation_forest import (
+    _FIT_ROWS_TOTAL,
+    _FIT_TREES_TOTAL,
     IsolationForestModel,
     _ParamSetters,
     _blockwise_grow,
@@ -148,6 +150,8 @@ class ExtendedIsolationForest(_ParamSetters):
                 )
             forest = jax.tree_util.tree_map(jax.block_until_ready, forest)
 
+        _FIT_ROWS_TOTAL.inc(total_rows, model="extended")
+        _FIT_TREES_TOTAL.inc(p.num_estimators, model="extended")
         model = ExtendedIsolationForestModel(
             forest=forest,
             params=p,

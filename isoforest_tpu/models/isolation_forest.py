@@ -23,6 +23,8 @@ from ..ops.bagging import bagged_indices, feature_subsets, per_tree_keys
 from ..ops.quantile import contamination_threshold, observed_contamination
 from ..ops.traversal import score_matrix
 from ..ops.tree_growth import StandardForest, grow_forest_fused
+from ..telemetry.metrics import counter as _telemetry_counter
+from ..telemetry.spans import span as _telemetry_span
 from ..utils import (
     IsolationForestParams,
     UNKNOWN_TOTAL_NUM_FEATURES,
@@ -37,6 +39,19 @@ from ..utils import (
 
 _REFERENCE_MODEL_CLASS = "com.linkedin.relevance.isolationforest.IsolationForestModel"
 _REFERENCE_ESTIMATOR_CLASS = "com.linkedin.relevance.isolationforest.IsolationForest"
+
+# Fit volume counters (docs/observability.md): labeled by model family so a
+# mixed standard/EIF service can attribute training load.
+_FIT_ROWS_TOTAL = _telemetry_counter(
+    "isoforest_fit_rows_total",
+    "Training rows consumed by fit(), by model family",
+    labelnames=("model",),
+)
+_FIT_TREES_TOTAL = _telemetry_counter(
+    "isoforest_fit_trees_total",
+    "Trees grown by fit(), by model family",
+    labelnames=("model",),
+)
 
 
 def _new_uid(prefix: str) -> str:
@@ -111,15 +126,16 @@ def _blockwise_grow(
     for index, start, stop in ckpt.block_ranges(num_trees, block_trees):
         arrays = state.load_block(index, start, stop)
         if arrays is None:
-            block = grow_block(
-                tree_keys[start:stop], bag[start:stop], fidx[start:stop]
-            )
-            block = jax.tree_util.tree_map(jax.block_until_ready, block)
-            arrays = {
-                field: np.asarray(getattr(block, field))
-                for field in forest_cls._fields
-            }
-            state.seal_block(index, start, stop, arrays)
+            with _telemetry_span("fit.grow_block", block=index, trees=stop - start):
+                block = grow_block(
+                    tree_keys[start:stop], bag[start:stop], fidx[start:stop]
+                )
+                block = jax.tree_util.tree_map(jax.block_until_ready, block)
+                arrays = {
+                    field: np.asarray(getattr(block, field))
+                    for field in forest_cls._fields
+                }
+                state.seal_block(index, start, stop, arrays)
             # preemption seam: fires AFTER the seal, like a real kill
             # landing between blocks (tests/test_checkpoint.py)
             faults.check_fit_block(index)
@@ -290,6 +306,8 @@ class IsolationForest(_ParamSetters):
                 )
             forest = jax.tree_util.tree_map(jax.block_until_ready, forest)
 
+        _FIT_ROWS_TOTAL.inc(total_rows, model="standard")
+        _FIT_TREES_TOTAL.inc(p.num_estimators, model="standard")
         model = IsolationForestModel(
             forest=forest,
             params=p,
@@ -414,7 +432,8 @@ class IsolationForestModel:
             if self.total_num_features != UNKNOWN_TOTAL_NUM_FEATURES
             else None
         )
-        self._scoring_layout = get_layout(self.forest, num_features=width)
+        with _telemetry_span("model.finalize_scoring", trees=self.forest.num_trees):
+            self._scoring_layout = get_layout(self.forest, num_features=width)
         return self
 
     def score(
@@ -440,26 +459,27 @@ class IsolationForestModel:
         X = np.asarray(X, np.float32)
         check_non_finite(X, nonfinite)
         validate_feature_vector_size(X.shape[1], self.total_num_features)
-        if mesh is not None:
-            from ..parallel.sharded import sharded_score
+        with _telemetry_span("model.score", rows=int(X.shape[0])):
+            if mesh is not None:
+                from ..parallel.sharded import sharded_score
 
-            return sharded_score(mesh, self.forest, X, self.num_samples)
-        if self._scoring_layout is None:
-            self.finalize_scoring()
-        expected = (
-            self.total_num_features
-            if self.total_num_features != UNKNOWN_TOTAL_NUM_FEATURES
-            else None
-        )
-        return score_matrix(
-            self.forest,
-            X,
-            self.num_samples,
-            layout=self._scoring_layout,
-            strict=strict,
-            expected_features=expected,
-            timeout_s=timeout_s,
-        )
+                return sharded_score(mesh, self.forest, X, self.num_samples)
+            if self._scoring_layout is None:
+                self.finalize_scoring()
+            expected = (
+                self.total_num_features
+                if self.total_num_features != UNKNOWN_TOTAL_NUM_FEATURES
+                else None
+            )
+            return score_matrix(
+                self.forest,
+                X,
+                self.num_samples,
+                layout=self._scoring_layout,
+                strict=strict,
+                expected_features=expected,
+                timeout_s=timeout_s,
+            )
 
     def degradations(self):
         """Structured degradation events recorded in this process (the

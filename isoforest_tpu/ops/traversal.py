@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +36,9 @@ from jax import lax
 
 from ..resilience import faults
 from ..resilience.degradation import degrade
+from ..telemetry import _state as _telemetry_state
+from ..telemetry.metrics import counter as _telemetry_counter
+from ..telemetry.metrics import histogram as _telemetry_histogram
 from ..utils.math import avg_path_length, height_of as _height_of, score_from_path_length
 from ..utils.validation import validate_feature_vector_size
 from .ext_growth import ExtendedForest
@@ -241,6 +245,22 @@ PLATFORM_DEFAULT_STRATEGY = {
 PALLAS_MAX_ROWS = 1 << 18
 
 STRATEGIES = ("gather", "dense", "pallas", "walk", "native")
+
+# Scoring telemetry (docs/observability.md): per-strategy wall-clock of the
+# RESOLVED strategy's execution (post-ladder, so a native→gather fallback
+# times as gather) and rows scored. Module-cached metric objects: the
+# serving path calls score_matrix in a tight loop and must not pay a
+# registry lookup per batch.
+_SCORING_SECONDS = _telemetry_histogram(
+    "isoforest_scoring_seconds",
+    "Wall-clock seconds per score_matrix execution, by resolved strategy",
+    labelnames=("strategy",),
+)
+_SCORED_ROWS_TOTAL = _telemetry_counter(
+    "isoforest_scored_rows_total",
+    "Rows scored by score_matrix, by resolved strategy",
+    labelnames=("strategy",),
+)
 
 # Forest -> minimum input width (1 + max referenced feature id), cached by
 # array identity: serving loops score small batches in a tight loop and the
@@ -529,6 +549,7 @@ def score_matrix(
     if strategy == "native":
         faults.check_strategy("native")
         timed_out = False
+        t0 = time.perf_counter() if _telemetry_state.enabled() else 0.0
         if timeout_s is None:
             out = _score_native(forest, X, num_samples)
         else:
@@ -550,6 +571,11 @@ def score_matrix(
                 timed_out = True
                 out = None
         if out is not None:
+            if _telemetry_state.enabled():
+                _SCORING_SECONDS.observe(
+                    time.perf_counter() - t0, strategy="native"
+                )
+                _SCORED_ROWS_TOTAL.inc(n, strategy="native")
             return out
         if timed_out:
             strategy = degrade(
@@ -638,8 +664,17 @@ def score_matrix(
             outs.append(scores[: chunk_size - pad] if pad else scores)
         return np.concatenate([np.asarray(o) for o in outs])
 
+    def _execute_timed() -> np.ndarray:
+        if not _telemetry_state.enabled():
+            return _execute()
+        t0 = time.perf_counter()
+        out = _execute()
+        _SCORING_SECONDS.observe(time.perf_counter() - t0, strategy=strategy)
+        _SCORED_ROWS_TOTAL.inc(n, strategy=strategy)
+        return out
+
     if timeout_s is None:
-        return _execute()
+        return _execute_timed()
 
     # scoring watchdog (docs/resilience.md §6): bound the strategy's
     # wall-clock — a wedged native walker or a stalled Pallas compile is
@@ -650,7 +685,7 @@ def score_matrix(
 
     try:
         return _watchdog.run_with_deadline(
-            _execute, timeout_s, describe=f"scoring strategy {strategy!r}"
+            _execute_timed, timeout_s, describe=f"scoring strategy {strategy!r}"
         )
     except _watchdog.WatchdogTimeout:
         if strategy == "gather":

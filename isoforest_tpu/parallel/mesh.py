@@ -91,6 +91,7 @@ def initialize_distributed(
         RetryPolicy,
         retry_call,
     )
+    from ..telemetry.events import record_event
 
     policy = retry_policy or RetryPolicy(
         max_attempts=3, base_delay_s=1.0, max_delay_s=30.0
@@ -102,8 +103,17 @@ def initialize_distributed(
         in inspect.signature(jax.distributed.initialize).parameters
     )
     start = clock()
+    attempts = {"n": 0}
 
     def attempt() -> None:
+        attempts["n"] += 1
+        record_event(
+            "distributed.init_attempt",
+            attempt=attempts["n"],
+            coordinator=coordinator_address,
+            process_id=process_id,
+            num_processes=num_processes,
+        )
         faults.take_distributed_init_failure()
         kwargs = {}
         if timeout_s is not None and supports_init_timeout:
@@ -127,7 +137,20 @@ def initialize_distributed(
             clock=clock,
             sleep=sleep,
         )
+        record_event(
+            "distributed.init_ok",
+            attempts=attempts["n"],
+            coordinator=coordinator_address,
+            process_id=process_id,
+        )
     except RetryError as exc:
+        record_event(
+            "distributed.init_failed",
+            attempts=attempts["n"],
+            coordinator=coordinator_address,
+            process_id=process_id,
+            error=repr(exc),
+        )
         raise DistributedTimeoutError(
             f"multi-host runtime never came up: {exc}",
             elapsed_s=exc.elapsed_s,

@@ -53,8 +53,19 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..telemetry.events import record_event
+from ..telemetry.metrics import counter as _counter
 from ..utils.logging import logger
 from . import manifest as _manifest
+
+_BLOCKS_SEALED_TOTAL = _counter(
+    "isoforest_checkpoint_blocks_sealed_total",
+    "Checkpointed-fit tree blocks sealed durably this process",
+)
+_BLOCKS_RESUMED_TOTAL = _counter(
+    "isoforest_checkpoint_blocks_resumed_total",
+    "Checkpointed-fit tree blocks loaded from a previous session's seals",
+)
 
 CHECKPOINT_VERSION = 1
 FINGERPRINT_NAME = "fingerprint.json"
@@ -243,6 +254,12 @@ class FitCheckpoint:
                 json.dump(self.fingerprint, fh, indent=1, sort_keys=True)
                 fh.write("\n")
             os.replace(tmp, fp_path)
+        record_event(
+            "checkpoint.begin",
+            directory=self.directory,
+            resume=bool(resume),
+            sealed_blocks=len(sealed),
+        )
 
     # ------------------------------------------------------------------ #
 
@@ -288,6 +305,13 @@ class FitCheckpoint:
             except Exception as exc:
                 issues.append(f"unreadable {_ARRAYS_NAME} ({exc})")
         if issues:
+            record_event(
+                "checkpoint.block_regrown",
+                index=index,
+                start=start,
+                stop=stop,
+                issues="; ".join(issues),
+            )
             logger.warning(
                 "checkpoint block %s is unusable (%s); re-growing trees "
                 "[%d, %d) — deterministic streams make regrowth lossless",
@@ -298,6 +322,10 @@ class FitCheckpoint:
             )
             return None
         self.blocks_loaded += 1
+        _BLOCKS_RESUMED_TOTAL.inc()
+        record_event(
+            "checkpoint.block_resumed", index=index, start=start, stop=stop
+        )
         return arrays
 
     def seal_block(
@@ -328,6 +356,10 @@ class FitCheckpoint:
                 )
                 fh.write("\n")
         self.blocks_written += 1
+        _BLOCKS_SEALED_TOTAL.inc()
+        record_event(
+            "checkpoint.block_sealed", index=index, start=start, stop=stop
+        )
 
 
 def block_ranges(num_trees: int, block_trees: int) -> List[Tuple[int, int, int]]:

@@ -31,7 +31,15 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..telemetry.events import record_event
+from ..telemetry.metrics import counter as _counter
 from ..utils.logging import logger
+
+_DEGRADATIONS_TOTAL = _counter(
+    "isoforest_degradations_total",
+    "Degradation-ladder rungs taken, by reason (docs/resilience.md)",
+    labelnames=("reason",),
+)
 
 # The documented ladder: reason -> (parity guarantee) — one row per rung.
 # degrade() refuses unknown reasons so a typo cannot create an untracked,
@@ -218,6 +226,18 @@ def degrade(
             f"{detail or LADDER[reason]}"
         )
     first = _REPORT.record(reason, from_, to, detail)
+    # every fallback is one timeline event + one counter tick, so a single
+    # telemetry.snapshot() shows WHEN each rung fired relative to retries,
+    # checkpoint seals and watchdog timeouts — model.degradations() remains
+    # the aggregated per-reason view of the same facts (and stays exact
+    # even when telemetry is disabled or the bounded timeline wraps)
+    _DEGRADATIONS_TOTAL.inc(reason=reason)
+    record_event(
+        "degradation",
+        reason=reason,
+        **{"from": from_, "to": to},
+        detail=detail or LADDER[reason],
+    )
     if first:
         logger.warning(
             "degraded [%s] %s -> %s: %s", reason, from_, to, detail or LADDER[reason]

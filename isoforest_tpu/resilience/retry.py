@@ -35,7 +35,15 @@ import random
 import time
 from typing import Callable, List, Optional, Tuple
 
+from ..telemetry.events import record_event
+from ..telemetry.metrics import counter as _counter
 from ..utils.logging import logger
+
+_RETRY_ATTEMPTS_TOTAL = _counter(
+    "isoforest_retry_attempts_total",
+    "Failed attempts seen by retry_call, by outcome (retried vs exhausted)",
+    labelnames=("outcome",),
+)
 
 
 class RetryError(RuntimeError):
@@ -157,6 +165,14 @@ def retry_call(
         except retry_on as exc:
             elapsed = clock() - start
             if attempt == policy.max_attempts - 1:
+                _RETRY_ATTEMPTS_TOTAL.inc(outcome="exhausted")
+                record_event(
+                    "retry.exhausted",
+                    describe=describe,
+                    attempts=attempt + 1,
+                    elapsed_s=round(elapsed, 4),
+                    error=repr(exc),
+                )
                 raise RetryError(
                     f"{describe} failed after {attempt + 1} attempt(s) over "
                     f"{elapsed:.2f}s; last error: {exc!r}",
@@ -169,6 +185,15 @@ def retry_call(
                 policy.deadline_s is not None
                 and elapsed + delay > policy.deadline_s
             ):
+                _RETRY_ATTEMPTS_TOTAL.inc(outcome="exhausted")
+                record_event(
+                    "retry.exhausted",
+                    describe=describe,
+                    attempts=attempt + 1,
+                    elapsed_s=round(elapsed, 4),
+                    deadline_s=policy.deadline_s,
+                    error=repr(exc),
+                )
                 raise RetryError(
                     f"{describe} abandoned after {attempt + 1} attempt(s): "
                     f"the next retry (+{delay:.2f}s backoff) would exceed the "
@@ -178,6 +203,15 @@ def retry_call(
                     elapsed_s=elapsed,
                     last_exception=exc,
                 ) from exc
+            _RETRY_ATTEMPTS_TOTAL.inc(outcome="retried")
+            record_event(
+                "retry.attempt",
+                describe=describe,
+                attempt=attempt + 1,
+                max_attempts=policy.max_attempts,
+                delay_s=round(delay, 4),
+                error=repr(exc),
+            )
             logger.warning(
                 "%s attempt %d/%d failed (%r); retrying in %.2fs",
                 describe,

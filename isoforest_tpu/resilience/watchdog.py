@@ -30,6 +30,20 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ..telemetry.events import record_event
+from ..telemetry.metrics import counter as _counter, gauge as _gauge
+
+_WATCHDOG_TIMEOUTS_TOTAL = _counter(
+    "isoforest_watchdog_timeouts_total",
+    "Watchdog deadlines that fired (the watched work was abandoned)",
+)
+_PEER_HEARTBEAT_AGE = _gauge(
+    "isoforest_peer_heartbeat_age_seconds",
+    "Seconds since each multihost peer's last heartbeat, at last read "
+    "(inf = unreadable/torn heartbeat file)",
+    labelnames=("peer",),
+)
+
 
 class WatchdogTimeout(RuntimeError):
     """The watched operation did not finish inside its deadline."""
@@ -105,6 +119,13 @@ def run_with_deadline(
                 detail = on_timeout()
             except Exception as exc:
                 detail = f"(diagnostics unavailable: {exc!r})"
+        _WATCHDOG_TIMEOUTS_TOTAL.inc()
+        record_event(
+            "watchdog.timeout",
+            describe=describe,
+            deadline_s=timeout_s,
+            detail=detail,
+        )
         raise WatchdogTimeout(
             f"{describe} exceeded its {timeout_s:g}s deadline; the stalled "
             "worker thread was abandoned" + (f" [{detail}]" if detail else ""),
@@ -157,6 +178,9 @@ class HeartbeatWriter:
             target=self._loop, daemon=True, name=f"isoforest-heartbeat[{self.name}]"
         )
         self._thread.start()
+        record_event(
+            "heartbeat.start", peer=self.name, interval_s=self.interval_s
+        )
         return self
 
     def _loop(self) -> None:
@@ -170,6 +194,7 @@ class HeartbeatWriter:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2 * self.interval_s)
+            record_event("heartbeat.stop", peer=self.name)
 
 
 def peer_heartbeat_ages(
@@ -191,6 +216,8 @@ def peer_heartbeat_ages(
             ages[name] = max(0.0, clock() - float(payload["time"]))
         except (OSError, ValueError, KeyError, TypeError):
             ages[name] = float("inf")
+    for name, age in ages.items():
+        _PEER_HEARTBEAT_AGE.set(age, peer=name)
     return ages
 
 

@@ -1,0 +1,82 @@
+"""Telemetry subsystem: structured spans, metrics registry, event timeline.
+
+The observability substrate for every layer of the package (ISSUE 4 /
+ROADMAP serving north star): the reference only logs at phase boundaries
+via Spark's ``Logging`` mixin; here a single ``telemetry.snapshot()``
+explains a whole run — which phases ran and for how long (spans), how much
+work flowed through which kernel (metrics), and every operational incident
+in causal order (events: degradation rungs, retries, watchdog timeouts,
+checkpoint seals/resumes, distributed bring-up attempts).
+
+Four coordinated pieces, stdlib-only:
+
+* :mod:`.spans` — nestable, thread-safe span tracer with wall/process time
+  and optional ``jax.profiler.TraceAnnotation`` pass-through;
+* :mod:`.metrics` — process-wide registry of counters, gauges and
+  fixed-bucket histograms with p50/p95/p99 summaries;
+* :mod:`.events` — one ordered, timestamped, bounded event timeline;
+* :mod:`.export` — JSON snapshot + Prometheus text exposition, wired into
+  ``bench.py`` and ``python -m isoforest_tpu telemetry``.
+
+Telemetry is ON by default and near-zero cost when disabled
+(``ISOFOREST_TPU_TELEMETRY=0`` or :func:`disable`; the enabled-vs-disabled
+scoring overhead is gated at 3% in CI via ``tools/bench_smoke.py``).
+Span/metric/event names and schemas are documented in
+``docs/observability.md``.
+"""
+
+from ._state import disable, enable, enabled
+from .events import Event, EventTimeline, get_events, record_event, timeline
+from .export import (
+    parse_prometheus,
+    reset,
+    snapshot,
+    snapshot_json,
+    to_prometheus,
+)
+from .metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    exponential_buckets,
+    gauge,
+    histogram,
+    registry,
+)
+from .spans import SpanRecord, current_span_name, span
+from .spans import records as span_records
+from .spans import summary as span_summary
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS",
+    "Counter",
+    "Event",
+    "EventTimeline",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "SpanRecord",
+    "counter",
+    "current_span_name",
+    "disable",
+    "enable",
+    "enabled",
+    "exponential_buckets",
+    "gauge",
+    "get_events",
+    "histogram",
+    "parse_prometheus",
+    "record_event",
+    "registry",
+    "reset",
+    "snapshot",
+    "snapshot_json",
+    "span",
+    "span_records",
+    "span_summary",
+    "timeline",
+    "to_prometheus",
+]

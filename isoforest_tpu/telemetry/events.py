@@ -1,0 +1,129 @@
+"""One ordered, timestamped event timeline for the whole process.
+
+Before this module each resilience mechanism kept its own bookkeeping:
+degradations in ``DegradationReport``, retries in log lines, watchdog
+timeouts in raised exceptions, checkpoint seals in ``FitCheckpoint``
+counters. The timeline unifies them: every discrete operational fact —
+a degradation rung taken, a retry attempt, a watchdog timeout, a heartbeat
+writer starting, a checkpoint block sealed or resumed, a distributed
+bring-up attempt — is appended here with a process-wide monotonically
+increasing sequence number, so a single ``telemetry.snapshot()`` explains a
+run in causal order.
+
+Event kinds and their fields are documented in ``docs/observability.md``;
+producers are the resilience modules (``degradation``/``retry``/
+``watchdog``/``checkpoint``), ``parallel/mesh.py`` and anything user code
+records via :func:`record_event`.
+
+The timeline is bounded (:data:`MAX_EVENTS`, drop-oldest) with an exact
+``dropped`` count, and thread-safe. Disabled telemetry drops events at the
+door (``record_event`` returns None) — existing aggregate APIs like
+``model.degradations()`` keep their own counts and stay exact either way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import _state
+
+MAX_EVENTS = 4096
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One timeline entry: ``seq`` orders events across all threads."""
+
+    seq: int
+    unix_s: float
+    kind: str
+    fields: Dict[str, object]
+
+    def as_dict(self) -> dict:
+        return {
+            "seq": self.seq,
+            "unix_s": self.unix_s,
+            "kind": self.kind,
+            **{k: v for k, v in self.fields.items()},
+        }
+
+
+class EventTimeline:
+    """Bounded, ordered, thread-safe event store."""
+
+    def __init__(self, maxlen: int = MAX_EVENTS) -> None:
+        self._lock = threading.Lock()
+        self._maxlen = int(maxlen)
+        self._events: List[Event] = []
+        self._next_seq = 0
+        self._dropped = 0
+
+    def record(self, kind: str, **fields: object) -> Optional[Event]:
+        if not _state.enabled():
+            return None
+        with self._lock:
+            event = Event(
+                seq=self._next_seq,
+                unix_s=time.time(),
+                kind=str(kind),
+                fields=fields,
+            )
+            self._next_seq += 1
+            self._events.append(event)
+            if len(self._events) > self._maxlen:
+                overflow = len(self._events) - self._maxlen
+                del self._events[:overflow]
+                self._dropped += overflow
+        return event
+
+    def events(
+        self, kind: Optional[str] = None, since_seq: Optional[int] = None
+    ) -> List[Event]:
+        with self._lock:
+            out = list(self._events)
+        if kind is not None:
+            out = [e for e in out if e.kind == kind]
+        if since_seq is not None:
+            out = [e for e in out if e.seq > since_seq]
+        return out
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def clear(self) -> None:
+        """Drop stored events; the sequence counter keeps advancing so
+        ordering comparisons stay valid across a clear."""
+        with self._lock:
+            self._events.clear()
+            self._dropped = 0
+
+
+_TIMELINE = EventTimeline()
+
+
+def timeline() -> EventTimeline:
+    """The process-wide timeline instance."""
+    return _TIMELINE
+
+
+def record_event(kind: str, **fields: object) -> Optional[Event]:
+    """Append one event; returns it (None when telemetry is disabled).
+    Field values should stay JSON-serialisable — they flow straight into
+    ``telemetry.snapshot()``."""
+    return _TIMELINE.record(kind, **fields)
+
+
+def get_events(
+    kind: Optional[str] = None, since_seq: Optional[int] = None
+) -> List[Event]:
+    """Recorded events in order; optionally one kind / after a sequence."""
+    return _TIMELINE.events(kind=kind, since_seq=since_seq)
+
+
+def reset_events() -> None:
+    _TIMELINE.clear()

@@ -1,0 +1,186 @@
+"""Telemetry exporters: JSON snapshot and Prometheus text exposition.
+
+``snapshot()`` is the one-call run explainer: telemetry state, per-span
+aggregates, the recent-span trace, every metric series and the ordered
+event timeline, all plain JSON types (``json.dumps`` round-trips it
+losslessly — proven in tests/test_telemetry.py).
+
+``to_prometheus()`` renders the metrics registry in the Prometheus text
+exposition format (version 0.0.4): ``# HELP``/``# TYPE`` headers, sorted
+label sets, cumulative ``le`` histogram buckets with ``_sum``/``_count``.
+:func:`parse_prometheus` is the matching minimal parser — tests round-trip
+the exposition through it, and operators can use it to spot-check a
+scraped payload without a Prometheus server.
+
+``bench.py`` embeds a compact snapshot in its JSON line and
+``python -m isoforest_tpu telemetry`` prints either format after a
+(synthetic or user-supplied) fit+score workload.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+from typing import Dict, Optional, Tuple
+
+from . import _state, events, metrics, spans
+
+# how many trailing SpanRecords snapshot() embeds; the full bounded ring
+# stays queryable via spans.records()
+SNAPSHOT_RECENT_SPANS = 64
+
+
+def snapshot() -> dict:
+    """Everything telemetry knows, as plain JSON types."""
+    timeline = events.timeline()
+    return {
+        "telemetry_enabled": _state.enabled(),
+        "generated_unix_s": round(time.time(), 3),
+        "spans": spans.summary(),
+        "recent_spans": [
+            r.as_dict() for r in spans.records()[-SNAPSHOT_RECENT_SPANS:]
+        ],
+        "metrics": metrics.registry().snapshot(),
+        "events": [e.as_dict() for e in events.get_events()],
+        "events_dropped": timeline.dropped,
+    }
+
+
+def snapshot_json(indent: Optional[int] = None) -> str:
+    return json.dumps(snapshot(), indent=indent, sort_keys=True)
+
+
+# --------------------------------------------------------------------------- #
+# Prometheus text exposition
+# --------------------------------------------------------------------------- #
+
+
+def _format_value(value: float) -> str:
+    if math.isinf(value):
+        return "+Inf" if value > 0 else "-Inf"
+    if math.isnan(value):  # pragma: no cover - nothing here produces NaN
+        return "NaN"
+    if float(value) == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(float(value))
+
+
+def _escape_label_value(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _format_labels(labels: Dict[str, str], extra: Tuple[Tuple[str, str], ...] = ()) -> str:
+    items = [*sorted(labels.items()), *extra]
+    if not items:
+        return ""
+    body = ",".join(
+        f'{name}="{_escape_label_value(str(value))}"' for name, value in items
+    )
+    return "{" + body + "}"
+
+
+def to_prometheus(registry: Optional[metrics.MetricsRegistry] = None) -> str:
+    """Prometheus text-format exposition of the (default: process-wide)
+    metrics registry."""
+    registry = registry if registry is not None else metrics.registry()
+    lines = []
+    for metric in registry.metrics():
+        snap = metric.snapshot()
+        if metric.help:
+            lines.append(f"# HELP {metric.name} {metric.help}")
+        lines.append(f"# TYPE {metric.name} {snap['type']}")
+        for series in snap["series"]:
+            labels = series["labels"]
+            if snap["type"] == "histogram":
+                cumulative = 0
+                for bound, count in series["buckets"]:
+                    cumulative += count
+                    le = bound if bound == "+Inf" else _format_value(float(bound))
+                    lines.append(
+                        f"{metric.name}_bucket"
+                        f"{_format_labels(labels, (('le', le),))} {cumulative}"
+                    )
+                lines.append(
+                    f"{metric.name}_sum{_format_labels(labels)} "
+                    f"{_format_value(series['sum'])}"
+                )
+                lines.append(
+                    f"{metric.name}_count{_format_labels(labels)} {series['count']}"
+                )
+            else:
+                lines.append(
+                    f"{metric.name}{_format_labels(labels)} "
+                    f"{_format_value(series['value'])}"
+                )
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def parse_prometheus(text: str) -> Dict[str, Dict[Tuple[Tuple[str, str], ...], float]]:
+    """Minimal exposition parser: ``{metric name: {sorted label tuple:
+    value}}``. Histogram series appear under their ``_bucket``/``_sum``/
+    ``_count`` sample names, exactly as exposed."""
+    out: Dict[str, Dict[Tuple[Tuple[str, str], ...], float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            label_body, value_part = rest.rsplit("}", 1)
+            labels = []
+            for item in _split_labels(label_body):
+                key, _, raw = item.partition("=")
+                raw = raw.strip()[1:-1]  # strip quotes
+                labels.append(
+                    (
+                        key.strip(),
+                        raw.replace('\\"', '"')
+                        .replace("\\n", "\n")
+                        .replace("\\\\", "\\"),
+                    )
+                )
+            key = tuple(sorted(labels))
+            value_text = value_part.strip()
+        else:
+            parts = line.split()
+            name, value_text = parts[0], parts[1]
+            key = ()
+        value = {"+Inf": math.inf, "-Inf": -math.inf, "NaN": math.nan}.get(
+            value_text, None
+        )
+        out.setdefault(name, {})[key] = (
+            float(value_text) if value is None else value
+        )
+    return out
+
+
+def _split_labels(body: str):
+    """Split ``a="x",b="y,z"`` on commas outside quotes."""
+    items, depth, current = [], False, []
+    i = 0
+    while i < len(body):
+        ch = body[i]
+        if ch == "\\" and depth:
+            current.append(body[i : i + 2])
+            i += 2
+            continue
+        if ch == '"':
+            depth = not depth
+        if ch == "," and not depth:
+            items.append("".join(current))
+            current = []
+        else:
+            current.append(ch)
+        i += 1
+    if current:
+        items.append("".join(current))
+    return items
+
+
+def reset() -> None:
+    """Clear spans, metric series, and the event timeline (registered
+    metric objects stay valid). For tests and sample-and-clear operators."""
+    spans.reset_spans()
+    metrics.reset_metrics()
+    events.reset_events()

@@ -1,0 +1,222 @@
+"""Nestable, thread-safe span tracer.
+
+A span times one named region of work::
+
+    from isoforest_tpu import telemetry
+
+    with telemetry.span("fit.grow_block", block=3):
+        ...
+
+Each completed span records wall time (``perf_counter``) and process CPU
+time (``process_time``), its parent span (per-thread nesting stack), depth,
+thread name and any keyword attributes. Completions feed two sinks:
+
+* a bounded in-memory ring of recent :class:`SpanRecord` s (the
+  ``snapshot()["recent_spans"]`` trace an operator reads after a run);
+* the ``isoforest_span_seconds{span=<name>}`` histogram in the metrics
+  registry, which supplies per-name count/total/p50/p95/p99 for
+  :func:`summary` and the Prometheus exposition.
+
+``annotate=True`` additionally passes the span through
+``jax.profiler.TraceAnnotation`` so the same names show up in
+TensorBoard/XProf traces on real hardware (``utils.logging.phase`` uses
+this — every existing fit/score phase is a span now).
+
+When telemetry is disabled (:mod:`._state`) :func:`span` returns a shared
+no-op context manager: no allocation beyond the kwargs dict, no clocks, no
+locks — the near-zero disabled cost ``tools/bench_smoke.py`` gates.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import _state
+from .metrics import DEFAULT_LATENCY_BUCKETS, histogram
+
+# Completed-span ring size: big enough to hold a full faulted fit+score run
+# (a 1000-tree checkpointed fit seals ~32 blocks; a bench run spans ~10
+# phases), small enough to stay O(100 KB).
+MAX_RECORDS = 512
+
+_SPAN_SECONDS = histogram(
+    "isoforest_span_seconds",
+    "Wall-clock duration of telemetry spans, by span name",
+    labelnames=("span",),
+    buckets=DEFAULT_LATENCY_BUCKETS,
+)
+
+_records: collections.deque = collections.deque(maxlen=MAX_RECORDS)
+_records_lock = threading.Lock()
+_local = threading.local()
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanRecord:
+    """One completed span."""
+
+    name: str
+    parent: Optional[str]
+    depth: int
+    thread: str
+    start_unix_s: float
+    wall_s: float
+    process_s: float
+    attrs: Dict[str, object]
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "parent": self.parent,
+            "depth": self.depth,
+            "thread": self.thread,
+            "start_unix_s": self.start_unix_s,
+            "wall_s": self.wall_s,
+            "process_s": self.process_s,
+            "attrs": dict(self.attrs),
+        }
+
+
+def _stack() -> list:
+    stack = getattr(_local, "stack", None)
+    if stack is None:
+        stack = _local.stack = []
+    return stack
+
+
+class _NullSpan:
+    """Shared do-nothing context manager returned while disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+# jax.profiler.TraceAnnotation, resolved once on first annotated span:
+# False = tried and unavailable (no jax / headless failure), None = untried
+_annotation_cls: object = None
+
+
+def _annotation(name: str):
+    global _annotation_cls
+    if _annotation_cls is None:
+        try:
+            import jax.profiler
+
+            _annotation_cls = jax.profiler.TraceAnnotation
+        except Exception:  # pragma: no cover - jax is a hard dep in practice
+            _annotation_cls = False
+    if _annotation_cls is False:
+        return None
+    return _annotation_cls(name)
+
+
+class _Span:
+    __slots__ = (
+        "name", "attrs", "parent", "depth", "start_unix_s",
+        "_t0", "_p0", "_annotation_cm",
+    )
+
+    def __init__(self, name: str, annotate: bool, attrs: Dict[str, object]) -> None:
+        self.name = name
+        self.attrs = attrs
+        self._annotation_cm = _annotation(name) if annotate else None
+
+    def __enter__(self) -> "_Span":
+        stack = _stack()
+        self.parent = stack[-1].name if stack else None
+        self.depth = len(stack)
+        stack.append(self)
+        if self._annotation_cm is not None:
+            self._annotation_cm.__enter__()
+        self.start_unix_s = time.time()
+        self._p0 = time.process_time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        wall = time.perf_counter() - self._t0
+        process = time.process_time() - self._p0
+        if self._annotation_cm is not None:
+            self._annotation_cm.__exit__(exc_type, exc, tb)
+        stack = _stack()
+        if self in stack:  # tolerate exotic exits without corrupting peers
+            stack.remove(self)
+        record = SpanRecord(
+            name=self.name,
+            parent=self.parent,
+            depth=self.depth,
+            thread=threading.current_thread().name,
+            start_unix_s=self.start_unix_s,
+            wall_s=wall,
+            process_s=process,
+            attrs=self.attrs,
+        )
+        with _records_lock:
+            _records.append(record)
+        _SPAN_SECONDS.observe(wall, span=self.name)
+        return False
+
+
+def span(name: str, annotate: bool = False, **attrs: object):
+    """Context manager timing the enclosed block as span ``name``.
+
+    ``annotate=True`` also wraps the block in a
+    ``jax.profiler.TraceAnnotation``. Extra keyword arguments are recorded
+    verbatim as span attributes (keep them JSON-serialisable). Returns a
+    shared no-op when telemetry is disabled.
+    """
+    if not _state.enabled():
+        return _NULL_SPAN
+    return _Span(name, annotate, attrs)
+
+
+def current_span_name() -> Optional[str]:
+    """Name of this thread's innermost open span (None outside any span)."""
+    stack = getattr(_local, "stack", None)
+    return stack[-1].name if stack else None
+
+
+def records(name: Optional[str] = None) -> List[SpanRecord]:
+    """Recent completed spans, oldest first (bounded at
+    :data:`MAX_RECORDS`); optionally filtered by name."""
+    with _records_lock:
+        out = list(_records)
+    if name is not None:
+        out = [r for r in out if r.name == name]
+    return out
+
+
+def summary() -> Dict[str, dict]:
+    """Per-span-name aggregate: count, total/max wall seconds and
+    bucket-estimated p50/p95/p99 from the backing histogram."""
+    out: Dict[str, dict] = {}
+    for series in _SPAN_SECONDS.snapshot()["series"]:
+        name = series["labels"]["span"]
+        stats = _SPAN_SECONDS.summary(span=name)
+        out[name] = {
+            "count": stats["count"],
+            "total_wall_s": stats["sum"],
+            "max_wall_s": stats["max"],
+            "p50_s": stats["p50"],
+            "p95_s": stats["p95"],
+            "p99_s": stats["p99"],
+        }
+    return out
+
+
+def reset_spans() -> None:
+    """Drop recorded spans (the backing histogram is cleared by
+    ``metrics.reset_metrics`` / ``telemetry.reset``)."""
+    with _records_lock:
+        _records.clear()

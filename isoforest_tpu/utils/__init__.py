@@ -19,7 +19,7 @@ from .validation import (
     extract_features,
     validate_feature_vector_size,
 )
-from .logging import logger, phase, trace
+from .logging import logger, phase, set_level, trace
 
 __all__ = [
     "EULER_GAMMA",
@@ -39,5 +39,6 @@ __all__ = [
     "validate_feature_vector_size",
     "logger",
     "phase",
+    "set_level",
     "trace",
 ]

@@ -2,9 +2,12 @@
 
 The reference only logs at phase boundaries via Spark's ``Logging`` mixin
 (SURVEY.md §5.1/§5.5 — e.g. SharedTrainLogic.scala:39-42,118-126,147-150).
-The TPU build upgrades that to (a) a standard library logger and (b) optional
+The TPU build upgrades that to (a) a standard library logger, (b) optional
 ``jax.profiler`` trace annotations around each phase so traces show up in
-TensorBoard/XProf when profiling on real hardware.
+TensorBoard/XProf when profiling on real hardware, and (c) the telemetry
+subsystem: every :func:`phase` is also a telemetry span, so phase timings
+land in ``telemetry.snapshot()`` and the Prometheus exposition
+(docs/observability.md).
 """
 
 from __future__ import annotations
@@ -14,12 +17,39 @@ import logging
 import os
 import time
 
+LOGLEVEL_ENV = "ISOFOREST_TPU_LOGLEVEL"
+
+# marks OUR stream handler so a module reload (importlib.reload under
+# pytest, a second sys.path alias of the package) re-finds it instead of
+# stacking a duplicate and double-printing every record
+_HANDLER_MARK = "_isoforest_tpu_handler"
+
 logger = logging.getLogger("isoforest_tpu")
-if not logger.handlers:
+
+
+def _configured_level() -> str:
+    return os.environ.get(LOGLEVEL_ENV, "WARNING").upper()
+
+
+if not any(getattr(h, _HANDLER_MARK, False) for h in logger.handlers):
     _h = logging.StreamHandler()
     _h.setFormatter(logging.Formatter("%(asctime)s %(name)s %(levelname)s: %(message)s"))
+    setattr(_h, _HANDLER_MARK, True)
     logger.addHandler(_h)
-    logger.setLevel(os.environ.get("ISOFOREST_TPU_LOGLEVEL", "WARNING").upper())
+    logger.setLevel(_configured_level())
+
+
+def set_level(level: int | str | None = None) -> str:
+    """Set the package log level; ``None`` re-reads ``ISOFOREST_TPU_LOGLEVEL``
+    from the CURRENT environment (the module-import read is otherwise
+    sticky for the process lifetime). Returns the effective level name::
+
+        os.environ["ISOFOREST_TPU_LOGLEVEL"] = "DEBUG"
+        isoforest_tpu.utils.set_level()       # -> "DEBUG"
+        isoforest_tpu.utils.set_level("INFO")  # explicit override
+    """
+    logger.setLevel(_configured_level() if level is None else level)
+    return logging.getLevelName(logger.level)
 
 
 @contextlib.contextmanager
@@ -42,13 +72,26 @@ def trace(log_dir: str):
 
 @contextlib.contextmanager
 def phase(name: str, log_level: int = logging.INFO):
-    """Time a named phase; annotate it in any active jax profiler trace."""
-    try:
-        import jax.profiler as _prof
+    """Time a named phase: telemetry span + jax profiler annotation + log.
 
-        ctx = _prof.TraceAnnotation(name)
-    except Exception:  # pragma: no cover
-        ctx = contextlib.nullcontext()
+    With telemetry enabled the phase records as a span (annotated into any
+    active jax profiler trace by the span itself); with telemetry disabled
+    it falls back to the bare ``TraceAnnotation`` so hardware profiling
+    keeps working either way.
+    """
+    # lazy import: utils.logging is imported by telemetry's own producers
+    from ..telemetry import _state as _tstate
+    from ..telemetry.spans import span as _span
+
+    if _tstate.enabled():
+        ctx = _span(name, annotate=True)
+    else:
+        try:
+            import jax.profiler as _prof
+
+            ctx = _prof.TraceAnnotation(name)
+        except Exception:  # pragma: no cover
+            ctx = contextlib.nullcontext()
     start = time.perf_counter()
     with ctx:
         yield

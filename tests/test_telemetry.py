@@ -1,0 +1,479 @@
+"""Telemetry subsystem tests (ISSUE 4): span nesting/threading, histogram
+bucket edges, Prometheus exposition golden file, event-timeline ordering
+under injected faults, and disabled-mode no-op behaviour.
+
+Span/metric/event names asserted here are the public schema documented in
+docs/observability.md — renaming one is a breaking change for dashboards.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import threading
+
+import numpy as np
+import pytest
+
+from isoforest_tpu import IsolationForest, telemetry
+from isoforest_tpu.resilience import faults
+from isoforest_tpu.telemetry import events as events_mod
+from isoforest_tpu.telemetry import export, metrics, spans
+
+RESOURCES = pathlib.Path(__file__).parent / "resources"
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Each test starts from empty telemetry state, enabled."""
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    telemetry.enable()
+    telemetry.reset()
+
+
+def _small_fit(trees: int = 8, rows: int = 256, **fit_kw):
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(rows, 4)).astype(np.float32)
+    X[:8] += 4.0
+    est = IsolationForest(num_estimators=trees, random_seed=1)
+    return est, X, est.fit(X, **fit_kw)
+
+
+# --------------------------------------------------------------------------- #
+# spans
+# --------------------------------------------------------------------------- #
+
+
+class TestSpans:
+    def test_nesting_records_parent_and_depth(self):
+        with telemetry.span("outer"):
+            with telemetry.span("middle"):
+                with telemetry.span("inner"):
+                    assert spans.current_span_name() == "inner"
+        by_name = {r.name: r for r in telemetry.span_records()}
+        assert by_name["outer"].parent is None and by_name["outer"].depth == 0
+        assert by_name["middle"].parent == "outer" and by_name["middle"].depth == 1
+        assert by_name["inner"].parent == "middle" and by_name["inner"].depth == 2
+        # children complete first: the ring is ordered by completion
+        names = [r.name for r in telemetry.span_records()]
+        assert names.index("inner") < names.index("middle") < names.index("outer")
+
+    def test_wall_and_process_time_recorded(self):
+        with telemetry.span("timed", batch=7):
+            sum(range(10_000))
+        (record,) = telemetry.span_records("timed")
+        assert record.wall_s >= 0.0
+        assert record.process_s >= 0.0
+        assert record.attrs == {"batch": 7}
+        assert record.thread == threading.current_thread().name
+
+    def test_thread_isolation(self):
+        barrier = threading.Barrier(2, timeout=10)
+
+        def worker(tag: str):
+            with telemetry.span(f"outer.{tag}"):
+                barrier.wait()  # both outers open simultaneously
+                with telemetry.span("inner"):
+                    pass
+                barrier.wait()
+
+        threads = [
+            threading.Thread(target=worker, args=(tag,)) for tag in ("a", "b")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        inners = telemetry.span_records("inner")
+        assert len(inners) == 2
+        # each inner's parent is ITS thread's outer, never the peer's
+        assert {r.parent for r in inners} == {"outer.a", "outer.b"}
+
+    def test_exception_still_records(self):
+        with pytest.raises(RuntimeError):
+            with telemetry.span("failing"):
+                raise RuntimeError("boom")
+        assert len(telemetry.span_records("failing")) == 1
+
+    def test_summary_aggregates_counts(self):
+        for _ in range(5):
+            with telemetry.span("repeated"):
+                pass
+        agg = telemetry.span_summary()["repeated"]
+        assert agg["count"] == 5
+        assert agg["total_wall_s"] >= 0.0
+        assert agg["p50_s"] is not None
+
+    def test_ring_is_bounded(self):
+        for i in range(spans.MAX_RECORDS + 50):
+            with telemetry.span("flood"):
+                pass
+        assert len(telemetry.span_records()) == spans.MAX_RECORDS
+
+
+# --------------------------------------------------------------------------- #
+# metrics
+# --------------------------------------------------------------------------- #
+
+
+class TestMetrics:
+    def test_counter_labels_and_values(self):
+        c = metrics.MetricsRegistry().counter("c_total", "c", labelnames=("k",))
+        c.inc(3, k="a")
+        c.inc(k="a")
+        c.inc(k="b")
+        assert c.value(k="a") == 4 and c.value(k="b") == 1
+        with pytest.raises(ValueError):
+            c.inc(k="a", extra="nope")
+        with pytest.raises(ValueError):
+            c.inc(-1, k="a")
+
+    def test_registry_refuses_shape_changes(self):
+        reg = metrics.MetricsRegistry()
+        reg.counter("m", "help", labelnames=("a",))
+        with pytest.raises(ValueError):
+            reg.gauge("m", "help", labelnames=("a",))
+        with pytest.raises(ValueError):
+            reg.counter("m", "help", labelnames=("b",))
+        reg.histogram("h", buckets=(1.0, 2.0))
+        with pytest.raises(ValueError):
+            reg.histogram("h", buckets=(1.0, 3.0))
+
+    def test_histogram_bucket_edges_le_semantics(self):
+        h = metrics.MetricsRegistry().histogram("h", buckets=(1.0, 2.0, 5.0))
+        for v in (1.0, 1.0000001, 5.0, 5.1):
+            h.observe(v)
+        (series,) = h.snapshot()["series"]
+        # value == bound lands IN that bucket (Prometheus `le`), one past
+        # the last finite bound lands in +Inf
+        assert series["buckets"] == [[1.0, 1], [2.0, 1], [5.0, 1], ["+Inf", 1]]
+        assert series["count"] == 4
+        assert series["min"] == 1.0 and series["max"] == 5.1
+
+    def test_histogram_quantile_interpolation(self):
+        h = metrics.MetricsRegistry().histogram("h", buckets=(1.0, 2.0, 5.0))
+        for v in (0.5, 1.5, 4.0):
+            h.observe(v)
+        # p50 target = 1.5 observations -> second bucket (1, 2], linear
+        # interpolation at half the bucket's single count
+        assert h.quantile(0.5) == pytest.approx(1.5)
+
+    def test_histogram_quantile_clamped_to_observed(self):
+        h = metrics.MetricsRegistry().histogram("h", buckets=(1.0,))
+        h.observe(0.7)
+        # interpolation inside [0, 1] would say 0.99; nothing observed
+        # above 0.7, so the estimate clamps there
+        assert h.quantile(0.99) == pytest.approx(0.7)
+        summary = h.summary()
+        assert summary["p99"] == pytest.approx(0.7)
+        assert summary["count"] == 1
+
+    def test_histogram_empty_summary(self):
+        h = metrics.MetricsRegistry().histogram("h", buckets=(1.0,))
+        assert h.summary() == {
+            "count": 0, "sum": 0.0, "min": None, "max": None,
+            "p50": None, "p95": None, "p99": None,
+        }
+
+    def test_exponential_buckets(self):
+        b = metrics.exponential_buckets(0.001, 2.0, 4)
+        assert b == (0.001, 0.002, 0.004, 0.008)
+        with pytest.raises(ValueError):
+            metrics.exponential_buckets(0.0, 2.0, 4)
+
+    def test_gauge_set_inc_dec(self):
+        g = metrics.MetricsRegistry().gauge("g")
+        g.set(2.5)
+        g.inc()
+        g.dec(0.5)
+        assert g.value() == pytest.approx(3.0)
+
+
+# --------------------------------------------------------------------------- #
+# exporters
+# --------------------------------------------------------------------------- #
+
+
+class TestExport:
+    def _golden_registry(self) -> metrics.MetricsRegistry:
+        reg = metrics.MetricsRegistry()
+        c = reg.counter("demo_requests_total", "Requests served", labelnames=("route",))
+        c.inc(3, route="fit")
+        c.inc(route="score")
+        reg.gauge("demo_queue_depth", "Current queue depth").set(2.5)
+        h = reg.histogram("demo_latency_seconds", "Request latency", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        return reg
+
+    def test_prometheus_golden_file(self):
+        text = export.to_prometheus(self._golden_registry())
+        golden = (RESOURCES / "telemetry_golden.prom").read_text()
+        assert text == golden
+
+    def test_prometheus_parse_round_trip(self):
+        reg = self._golden_registry()
+        parsed = export.parse_prometheus(export.to_prometheus(reg))
+        assert parsed["demo_requests_total"] == {
+            (("route", "fit"),): 3.0,
+            (("route", "score"),): 1.0,
+        }
+        assert parsed["demo_queue_depth"][()] == 2.5
+        # cumulative le buckets + sum/count round-trip exactly
+        assert parsed["demo_latency_seconds_bucket"][(("le", "+Inf"),)] == 3.0
+        assert parsed["demo_latency_seconds_bucket"][(("le", "0.1"),)] == 1.0
+        assert parsed["demo_latency_seconds_sum"][()] == pytest.approx(5.55)
+        assert parsed["demo_latency_seconds_count"][()] == 3.0
+
+    def test_prometheus_escapes_label_values(self):
+        reg = metrics.MetricsRegistry()
+        reg.counter("esc_total", labelnames=("k",)).inc(k='a"b\\c\nd')
+        parsed = export.parse_prometheus(export.to_prometheus(reg))
+        assert parsed["esc_total"] == {(("k", 'a"b\\c\nd'),): 1.0}
+
+    def test_snapshot_json_round_trip_after_workload(self):
+        _, X, model = _small_fit()
+        model.score(X)
+        snap = telemetry.snapshot()
+        assert snap["telemetry_enabled"] is True
+        assert "isolation_forest.fit.grow" in snap["spans"]
+        assert "model.score" in snap["spans"]
+        assert "isoforest_scoring_seconds" in snap["metrics"]
+        restored = json.loads(json.dumps(snap))
+        assert restored == snap
+        # and through the pretty-printer entry point too (a fresh snapshot:
+        # only its generation timestamp may differ)
+        pretty = json.loads(telemetry.snapshot_json(indent=1))
+        pretty.pop("generated_unix_s")
+        expected = dict(snap)
+        expected.pop("generated_unix_s")
+        assert pretty == expected
+
+
+# --------------------------------------------------------------------------- #
+# events + instrumentation integration
+# --------------------------------------------------------------------------- #
+
+
+class TestEvents:
+    def test_sequence_is_ordered_and_filterable(self):
+        telemetry.record_event("alpha", n=1)
+        telemetry.record_event("beta", n=2)
+        telemetry.record_event("alpha", n=3)
+        seqs = [e.seq for e in telemetry.get_events()]
+        assert seqs == sorted(seqs) and len(set(seqs)) == 3
+        assert [e.fields["n"] for e in telemetry.get_events("alpha")] == [1, 3]
+
+    def test_timeline_bounded_with_drop_count(self):
+        timeline = events_mod.EventTimeline(maxlen=4)
+        for i in range(7):
+            timeline.record("k", i=i)
+        kept = timeline.events()
+        assert [e.fields["i"] for e in kept] == [3, 4, 5, 6]
+        assert timeline.dropped == 3
+
+    def test_checkpoint_fault_kill_and_resume_event_order(self, tmp_path):
+        """The acceptance-criteria run: a faulted fit + resume, then the
+        timeline explains it in causal order."""
+        est, X, _ = _small_fit(trees=8)  # plain fit to warm compile caches
+        telemetry.reset()
+        ck = tmp_path / "ck"
+        with faults.inject(kill_fit_after_block=0):
+            with pytest.raises(faults.FaultInjectedError):
+                est.fit(X, checkpoint_dir=str(ck), checkpoint_every=4)
+        est.fit(X, checkpoint_dir=str(ck), checkpoint_every=4, resume=True)
+        kinds = [
+            e.kind
+            for e in telemetry.get_events()
+            if e.kind.startswith("checkpoint.")
+        ]
+        assert kinds == [
+            "checkpoint.begin",          # killed session
+            "checkpoint.block_sealed",   # block 0 seals, then the kill
+            "checkpoint.begin",          # resumed session
+            "checkpoint.block_resumed",  # block 0 loaded from disk
+            "checkpoint.block_sealed",   # block 1 grown this session
+        ]
+        seqs = [e.seq for e in telemetry.get_events()]
+        assert seqs == sorted(seqs)
+
+    def test_retry_feeds_timeline_with_zero_real_sleeps(self):
+        from isoforest_tpu.resilience import RetryError, RetryPolicy, retry_call
+
+        clock = faults.FakeClock()
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.5, jitter=0.0)
+        with pytest.raises(RetryError):
+            retry_call(
+                lambda: (_ for _ in ()).throw(OSError("flaky")),
+                policy=policy,
+                describe="demo op",
+                clock=clock.now,
+                sleep=clock.sleep,
+            )
+        attempts = telemetry.get_events("retry.attempt")
+        assert [e.fields["attempt"] for e in attempts] == [1, 2]
+        assert all(e.fields["describe"] == "demo op" for e in attempts)
+        (exhausted,) = telemetry.get_events("retry.exhausted")
+        assert exhausted.fields["attempts"] == 3
+        assert exhausted.seq > attempts[-1].seq
+        counter = telemetry.counter(
+            "isoforest_retry_attempts_total", labelnames=("outcome",)
+        )
+        assert counter.value(outcome="retried") == 2
+        assert counter.value(outcome="exhausted") == 1
+
+    def test_degradation_feeds_timeline_and_counter(self):
+        _, X, model = _small_fit()
+        telemetry.reset()
+        from isoforest_tpu.resilience import reset_degradations
+
+        reset_degradations()
+        try:
+            from isoforest_tpu.ops.traversal import score_matrix
+
+            with faults.inject(hide_native=True):
+                # a pinned native strategy must fall back THROUGH the ladder
+                score_matrix(
+                    model.forest, X, model.num_samples, strategy="native"
+                )
+            events = telemetry.get_events("degradation")
+            assert len(events) >= 1
+            ev = events[0].as_dict()
+            assert ev["reason"] == "native_unavailable"
+            assert ev["from"] == "native" and ev["to"] == "gather"
+            counter = telemetry.counter(
+                "isoforest_degradations_total", labelnames=("reason",)
+            )
+            assert counter.value(reason="native_unavailable") == len(events)
+            # model.degradations() stays the aggregated view of the same facts
+            (report,) = [
+                d for d in model.degradations() if d.reason == "native_unavailable"
+            ]
+            assert report.count == len(events)
+        finally:
+            reset_degradations()
+
+    def test_faulted_fit_score_snapshot_has_all_three(self, tmp_path):
+        """snapshot() after a faulted fit+score contains spans, metrics AND
+        the checkpoint/degradation events, in order (ISSUE 4 acceptance)."""
+        est, X, _ = _small_fit(trees=8)
+        telemetry.reset()
+        from isoforest_tpu.resilience import reset_degradations
+
+        reset_degradations()
+        try:
+            ck = tmp_path / "ck"
+            with faults.inject(kill_fit_after_block=0):
+                with pytest.raises(faults.FaultInjectedError):
+                    est.fit(X, checkpoint_dir=str(ck), checkpoint_every=4)
+            model = est.fit(
+                X, checkpoint_dir=str(ck), checkpoint_every=4, resume=True
+            )
+            from isoforest_tpu.ops.traversal import score_matrix
+
+            with faults.inject(hide_native=True):
+                score_matrix(
+                    model.forest, X, model.num_samples, strategy="native"
+                )
+            model.score(X)
+            snap = telemetry.snapshot()
+            assert "fit.grow_block" in snap["spans"]
+            assert "model.score" in snap["spans"]
+            fit_trees = snap["metrics"]["isoforest_fit_trees_total"]["series"]
+            assert any(s["value"] >= 8 for s in fit_trees)
+            kinds = [e["kind"] for e in snap["events"]]
+            assert "checkpoint.block_sealed" in kinds
+            assert "checkpoint.block_resumed" in kinds
+            assert "degradation" in kinds
+            # degradation happened after the checkpoint lifecycle
+            assert kinds.index("degradation") > kinds.index(
+                "checkpoint.block_resumed"
+            )
+            seqs = [e["seq"] for e in snap["events"]]
+            assert seqs == sorted(seqs)
+        finally:
+            reset_degradations()
+
+
+# --------------------------------------------------------------------------- #
+# disabled mode
+# --------------------------------------------------------------------------- #
+
+
+class TestDisabledMode:
+    def test_span_is_shared_noop(self):
+        telemetry.disable()
+        s1 = telemetry.span("x")
+        s2 = telemetry.span("y", attr=1)
+        assert s1 is s2  # the cached null span: no per-call allocation
+        with s1:
+            assert spans.current_span_name() is None
+        assert telemetry.span_records() == []
+        assert telemetry.span_summary() == {}
+
+    def test_metrics_and_events_do_not_record(self):
+        c = telemetry.counter("disabled_total", labelnames=())
+        h = telemetry.histogram("disabled_seconds", buckets=(1.0,))
+        telemetry.disable()
+        c.inc()
+        h.observe(0.5)
+        assert telemetry.record_event("nope") is None
+        telemetry.enable()
+        assert c.value() == 0
+        assert h.summary()["count"] == 0
+        assert telemetry.get_events() == []
+
+    def test_disabled_scoring_records_nothing(self):
+        _, X, model = _small_fit()
+        telemetry.reset()
+        telemetry.disable()
+        model.score(X)
+        telemetry.enable()
+        snap = telemetry.snapshot()
+        assert snap["spans"] == {}
+        assert all(
+            not m["series"] for m in snap["metrics"].values()
+        ), "disabled run must leave every metric empty"
+
+    def test_snapshot_reports_disabled_flag(self):
+        telemetry.disable()
+        assert telemetry.snapshot()["telemetry_enabled"] is False
+
+
+# --------------------------------------------------------------------------- #
+# scoring instrumentation + CLI
+# --------------------------------------------------------------------------- #
+
+
+class TestIntegration:
+    def test_scoring_metrics_recorded(self):
+        _, X, model = _small_fit()
+        telemetry.reset()
+        model.score(X)
+        snap = telemetry.snapshot()["metrics"]
+        scored = snap["isoforest_scored_rows_total"]["series"]
+        assert sum(s["value"] for s in scored) >= len(X)
+        timed = snap["isoforest_scoring_seconds"]["series"]
+        assert sum(s["count"] for s in timed) >= 1
+
+    def test_cli_telemetry_json(self, capsys):
+        from isoforest_tpu.__main__ import main
+
+        assert main(["telemetry", "--rows", "256", "--trees", "5"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["telemetry_enabled"] is True
+        assert "isolation_forest.fit.grow" in out["spans"]
+        assert "isoforest_scored_rows_total" in out["metrics"]
+
+    def test_cli_telemetry_prometheus(self, capsys):
+        from isoforest_tpu.__main__ import main
+
+        rc = main(
+            ["telemetry", "--rows", "256", "--trees", "5", "--format", "prometheus"]
+        )
+        assert rc == 0
+        parsed = telemetry.parse_prometheus(capsys.readouterr().out)
+        fit_rows = parsed["isoforest_fit_rows_total"]
+        assert fit_rows[(("model", "standard"),)] >= 256

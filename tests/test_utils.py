@@ -188,3 +188,50 @@ class TestResolveExtensionLevel:
 
     def test_axis_aligned_level_zero(self):
         assert resolve_extension_level(0, 6) == 0
+
+
+class TestLogging:
+    """utils/logging.py: runtime level control + reload-safe handlers."""
+
+    def test_set_level_rereads_env(self, monkeypatch):
+        from isoforest_tpu.utils import logging as iflog
+
+        original = iflog.logger.level
+        try:
+            monkeypatch.setenv("ISOFOREST_TPU_LOGLEVEL", "DEBUG")
+            assert iflog.set_level() == "DEBUG"
+            monkeypatch.setenv("ISOFOREST_TPU_LOGLEVEL", "ERROR")
+            assert iflog.set_level() == "ERROR"
+            assert iflog.set_level("INFO") == "INFO"
+        finally:
+            iflog.logger.setLevel(original)
+
+    def test_reload_does_not_duplicate_handlers(self):
+        import importlib
+
+        from isoforest_tpu.utils import logging as iflog
+
+        marked = [
+            h
+            for h in iflog.logger.handlers
+            if getattr(h, iflog._HANDLER_MARK, False)
+        ]
+        assert len(marked) == 1
+        importlib.reload(iflog)
+        marked_after = [
+            h
+            for h in iflog.logger.handlers
+            if getattr(h, iflog._HANDLER_MARK, False)
+        ]
+        assert len(marked_after) == 1
+
+    def test_phase_records_telemetry_span(self):
+        from isoforest_tpu import telemetry
+        from isoforest_tpu.utils import phase
+
+        telemetry.enable()
+        before = len(telemetry.span_records("test.phase_span"))
+        with phase("test.phase_span"):
+            pass
+        after = telemetry.span_records("test.phase_span")
+        assert len(after) == before + 1

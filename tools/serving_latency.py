@@ -1,28 +1,47 @@
 """Serving-batch latency microbench for the native CPU walker.
 
-Measures p50/p99 `model.score(batch)` latency at serving batch sizes with
-the per-forest prep cache warm — the number a low-latency deployment cares
-about, complementary to bench.py's bulk-throughput headline. Run with
+Measures p50/p95/p99 `model.score(batch)` latency at serving batch sizes
+with the per-forest prep cache warm — the number a low-latency deployment
+cares about, complementary to bench.py's bulk-throughput headline. Run with
 ``PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python tools/serving_latency.py``
 in this image (see benchmarks/README.md for the tunnel-wedge context).
 
-Round-5 build host (1 core, avx512f/dq; iters in each JSON row — p99 is a
-real tail statistic now, ADVICE r4): batch 1 p50 0.94 ms / p99 2.45 ms;
-batch 64 p50 0.98 ms; batch 1024 p50 1.49 ms; batch 8192 p50 3.57 ms —
-the 16k-row thread gate keeps serving batches single-threaded by design.
-(Round-4 p50s at 50/10 iters were 0.57/0.63/0.93/2.98 ms; the spread is
-shared-host contention, not a kernel change.)
+Latency collection goes through the telemetry subsystem
+(``isoforest_serving_latency_seconds{batch=...}`` histogram,
+docs/observability.md) rather than a hand-rolled list of floats: the
+reported quantiles are the bucket-interpolated ones a scraped Prometheus
+deployment would compute (~1.3x-geometric buckets, so p99 resolves to
+~15% relative error per bucket edge), plus the exact max the histogram
+tracks alongside. Each JSON row carries the sample count.
+
+Round-5 build host (1 core, avx512f/dq; exact-percentile collection):
+batch 1 p50 0.94 ms / p99 2.45 ms; batch 64 p50 0.98 ms; batch 1024 p50
+1.49 ms; batch 8192 p50 3.57 ms — the 16k-row thread gate keeps serving
+batches single-threaded by design. (Bucketed quantiles land within one
+bucket edge of those.)
 """
 
 import json
+import pathlib
+import sys
 import time
 
-import numpy as np
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
 def main() -> None:
-    from isoforest_tpu import IsolationForest
+    from isoforest_tpu import IsolationForest, telemetry
     from isoforest_tpu.data import kddcup_http_hard
+
+    # ~1.3x-geometric bounds, 50 us .. ~0.65 s: serving latencies from a
+    # warm 1-row native walk up to a cold 8k-row batch all resolve
+    buckets = telemetry.exponential_buckets(50e-6, 1.3, 36)
+    latency = telemetry.histogram(
+        "isoforest_serving_latency_seconds",
+        "model.score wall-clock at serving batch sizes (prep caches warm)",
+        labelnames=("batch",),
+        buckets=buckets,
+    )
 
     X, _ = kddcup_http_hard(n=200_000)
     model = IsolationForest(num_estimators=100, random_seed=1).fit(X)
@@ -32,20 +51,22 @@ def main() -> None:
         # enough iterations that p99 is a real tail statistic, not the max
         # of a tiny sample (ADVICE r4); the sample size ships in the JSON
         iters = 200 if bs <= 1024 else 100
-        times = []
         for _ in range(iters):
             t0 = time.perf_counter()
             model.score(xb)
-            times.append(time.perf_counter() - t0)
+            latency.observe(time.perf_counter() - t0, batch=bs)
+        stats = latency.summary(batch=bs)
+        assert stats["count"] == iters
         print(
             json.dumps(
                 {
                     "metric": "serving_latency_ms",
                     "batch": bs,
                     "iters": iters,
-                    "p50": round(float(np.percentile(times, 50)) * 1e3, 3),
-                    "p99": round(float(np.percentile(times, 99)) * 1e3, 3),
-                    "max": round(float(np.max(times)) * 1e3, 3),
+                    "p50": round(stats["p50"] * 1e3, 3),
+                    "p95": round(stats["p95"] * 1e3, 3),
+                    "p99": round(stats["p99"] * 1e3, 3),
+                    "max": round(stats["max"] * 1e3, 3),
                 }
             ),
             flush=True,
