@@ -11,6 +11,9 @@ operational surface here is a small CLI over CSV files:
     python -m isoforest_tpu inspect --model /tmp/model [--tree 0]
     python -m isoforest_tpu telemetry [--format json|prometheus] \\
         [--input data.csv [--model /tmp/model]]
+    python -m isoforest_tpu diagnose /tmp/model [--format json|prometheus]
+    python -m isoforest_tpu monitor /tmp/model --input live.csv \\
+        [--threshold 0.25] [--port 9101] [--format json|prometheus]
 
 CSV rows are feature columns; ``--labeled`` treats the last column as a label
 (excluded from features; used to report AUROC after fit/score).
@@ -205,6 +208,67 @@ def cmd_telemetry(args) -> int:
     return 0
 
 
+def cmd_diagnose(args) -> int:
+    """Forest-structure diagnostics for a saved model
+    (docs/observability.md §8): tree depths, leaf sizes, split-feature
+    usage, expected-vs-realised average path length and imbalance stats —
+    straight from the packed node tables, no data needed."""
+    from . import telemetry
+
+    model = _load_model(args.model_dir)
+    diag = model.diagnostics()
+    if args.format == "prometheus":
+        telemetry.publish_gauges(diag)
+        print(telemetry.to_prometheus(), end="")
+    else:
+        print(json.dumps(diag, indent=1, sort_keys=True))
+    return 0
+
+
+def cmd_monitor(args) -> int:
+    """Score a CSV through a saved model with the drift monitor attached
+    and report PSI/KS of the served scores and input features against the
+    model's training baseline (docs/observability.md §8). ``--port`` serves
+    the live /metrics endpoint while scoring (0 = ephemeral)."""
+    from . import telemetry
+
+    model = _load_model(args.model_dir)
+    if model.baseline is None:
+        print(
+            "error: this model directory has no _BASELINE.json sidecar "
+            "(legacy save, or fit with baseline capture disabled) — refit "
+            "and re-save to enable drift monitoring",
+            file=sys.stderr,
+        )
+        return 2
+    monitor = model.enable_monitoring(
+        threshold=args.threshold, min_rows=args.min_rows
+    )
+    server = telemetry.serve(port=args.port) if args.port is not None else None
+    try:
+        rows = 0
+        with open(args.input) as in_fh:
+            for X, _ in _iter_csv_chunks(in_fh, args.labeled, args.chunk_rows):
+                model.score(X)  # folds into the monitor
+                rows += len(X)
+    finally:
+        if server is not None:
+            server.stop()
+    report = monitor.report()
+    report["model"] = args.model_dir
+    report["input"] = args.input
+    if args.format == "prometheus":
+        print(telemetry.to_prometheus(), end="")
+        if report["drifted"]:
+            print(
+                f"# drift alerts: {json.dumps(report['alerts'])}",
+                file=sys.stderr,
+            )
+    else:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="isoforest_tpu", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -263,6 +327,43 @@ def build_parser() -> argparse.ArgumentParser:
     tele.add_argument("--rows", type=int, default=4096, help="synthetic workload rows")
     tele.add_argument("--trees", type=int, default=50)
     tele.set_defaults(func=cmd_telemetry)
+
+    diag = sub.add_parser(
+        "diagnose", help="forest-structure diagnostics for a saved model"
+    )
+    diag.add_argument("model_dir")
+    diag.add_argument("--format", choices=("json", "prometheus"), default="json")
+    diag.set_defaults(func=cmd_diagnose)
+
+    mon = sub.add_parser(
+        "monitor",
+        help="score a CSV with drift monitoring vs the model's baseline",
+    )
+    mon.add_argument("model_dir")
+    mon.add_argument("--input", required=True, help="CSV of serving traffic")
+    mon.add_argument("--labeled", action="store_true")
+    mon.add_argument(
+        "--threshold",
+        type=float,
+        default=None,
+        help="PSI alert threshold (default 0.25, the 'major shift' band)",
+    )
+    mon.add_argument(
+        "--min-rows",
+        type=int,
+        default=512,
+        help="rows to fold before drift is evaluated",
+    )
+    mon.add_argument("--chunk-rows", type=int, default=1 << 16)
+    mon.add_argument(
+        "--port",
+        type=int,
+        default=None,
+        help="serve the live /metrics endpoint on this port while scoring "
+        "(0 = ephemeral)",
+    )
+    mon.add_argument("--format", choices=("json", "prometheus"), default="json")
+    mon.set_defaults(func=cmd_monitor)
     return p
 
 

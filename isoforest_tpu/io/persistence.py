@@ -35,6 +35,13 @@ Resilience additions (docs/resilience.md) on top of the reference layout:
   from a corrupt directory, rebuilds a valid smaller forest (path-length
   normalisation rescales automatically) and reports exactly which trees
   were lost (``model.load_report``).
+
+Observability addition (docs/observability.md §8): models fitted with
+baseline capture persist a ``_BASELINE.json`` sidecar next to the Avro node
+table — the training-score histogram + per-feature stats the drift monitor
+compares serving traffic against. The sidecar is sealed by the same
+``_MANIFEST.json``; directories without one (reference/Spark layouts, or
+pre-monitoring saves) load with ``model.baseline = None`` and a warning.
 """
 
 from __future__ import annotations
@@ -956,6 +963,44 @@ def _write_data_raw(path: str, schema: dict, body: bytes, count: int) -> None:
     _mark_success(path)
 
 
+def _write_baseline(model, tmp: str) -> None:
+    """Persist the drift baseline as a manifest-sealed sidecar (written
+    inside the atomic temp dir, so it is covered by the same
+    ``_MANIFEST.json`` and ``os.rename`` as the node table)."""
+    from ..telemetry.monitor import BASELINE_NAME
+
+    baseline = getattr(model, "baseline", None)
+    if baseline is not None:
+        baseline.save(os.path.join(tmp, BASELINE_NAME))
+
+
+def _read_baseline(path: str):
+    """Load the ``_BASELINE.json`` sidecar; None (with a warning) when the
+    directory predates monitoring or was written by the reference."""
+    from ..telemetry.monitor import BASELINE_NAME, Baseline
+
+    sidecar = os.path.join(path, BASELINE_NAME)
+    if not os.path.exists(sidecar):
+        logger.warning(
+            "model directory %s has no %s sidecar (legacy/reference layout "
+            "or a fit with baseline capture disabled): drift monitoring is "
+            "unavailable for this model until it is refitted",
+            path,
+            BASELINE_NAME,
+        )
+        return None
+    try:
+        return Baseline.load(sidecar)
+    except Exception as exc:
+        logger.warning(
+            "ignoring unreadable baseline sidecar %s (%s): drift monitoring "
+            "unavailable for this model",
+            sidecar,
+            exc,
+        )
+        return None
+
+
 def _fast_standard_body(forest):
     """Vectorised pre-order + native columnar encode; None if unavailable."""
     from .. import native
@@ -982,6 +1027,7 @@ def _fast_standard_body(forest):
 def save_standard_model(model, path: str, overwrite: bool = False) -> None:
     with _atomic_dir(path, overwrite) as tmp:
         _write_metadata(tmp, _model_metadata(model, STANDARD_MODEL_CLASS))
+        _write_baseline(model, tmp)
         fast = _fast_standard_body(model.forest)
         if fast is not None:
             _write_data_raw(tmp, STANDARD_SCHEMA, *fast)
@@ -1041,6 +1087,7 @@ def save_extended_model(model, path: str, overwrite: bool = False) -> None:
         # estimator left it unset — ExtendedIsolationForest.scala:102)
         meta["paramMap"]["extensionLevel"] = int(model.extension_level)
         _write_metadata(tmp, meta)
+        _write_baseline(model, tmp)
         fast = _fast_extended_body(model.forest)
         if fast is not None:
             _write_data_raw(tmp, EXTENDED_SCHEMA, *fast)
@@ -1147,6 +1194,7 @@ def load_standard_model(
         uid=metadata.get("uid"),
     )
     model.load_report = load_report
+    model.baseline = _read_baseline(path)
     threshold = float(metadata.get("outlierScoreThreshold", -1.0))
     if threshold >= 0:
         model.set_outlier_score_threshold(threshold)
@@ -1201,6 +1249,7 @@ def load_extended_model(
         uid=metadata.get("uid"),
     )
     model.load_report = load_report
+    model.baseline = _read_baseline(path)
     threshold = float(metadata.get("outlierScoreThreshold", -1.0))
     if threshold >= 0:
         model.set_outlier_score_threshold(threshold)

@@ -33,7 +33,9 @@ from .isolation_forest import (
     _FIT_TREES_TOTAL,
     IsolationForestModel,
     _ParamSetters,
+    _baseline_env_enabled,
     _blockwise_grow,
+    _capture_fit_baseline,
     _compute_and_set_threshold,
     _new_uid,
 )
@@ -71,10 +73,12 @@ class ExtendedIsolationForest(_ParamSetters):
         checkpoint_dir=None,
         checkpoint_every=None,
         resume: bool = False,
+        baseline: bool = True,
     ) -> "ExtendedIsolationForestModel":
         """Train; same knobs as :meth:`IsolationForest.fit`, including the
         preemption-safe ``checkpoint_dir``/``checkpoint_every``/``resume``
-        block-wise growth (docs/resilience.md §5)."""
+        block-wise growth (docs/resilience.md §5) and the drift-monitoring
+        ``baseline`` capture (docs/observability.md §8)."""
         p = self.params
         X, _ = extract_features(data, p.features_col, nonfinite=nonfinite)
         total_rows, total_feats = int(X.shape[0]), int(X.shape[1])
@@ -166,6 +170,8 @@ class ExtendedIsolationForest(_ParamSetters):
         # threshold pass — same contract as the standard estimator
         model.finalize_scoring()
         _compute_and_set_threshold(model, Xd, mesh=mesh)
+        if baseline and _baseline_env_enabled():
+            _capture_fit_baseline(model, X)
         return model
 
     def save(self, path: str, overwrite: bool = False) -> None:

@@ -12,6 +12,7 @@ vector-valued features column (the Dataset analogue); ``transform`` appends
 
 from __future__ import annotations
 
+import os
 import uuid
 from typing import Optional
 
@@ -56,6 +57,49 @@ _FIT_TREES_TOTAL = _telemetry_counter(
 
 def _new_uid(prefix: str) -> str:
     return f"{prefix}_{uuid.uuid4().hex[:12]}"
+
+
+# Fit-time drift-baseline capture (docs/observability.md §8): scored rows
+# are capped so the capture stays a few percent of fit even at bench scale;
+# the subsample is a deterministic stride (no RNG — checkpointed and plain
+# fits must stay bitwise-identical).
+_BASELINE_ENV = "ISOFOREST_TPU_BASELINE"
+_BASELINE_MAX_ROWS = 65536
+
+
+def _baseline_env_enabled() -> bool:
+    return os.environ.get(_BASELINE_ENV, "1").strip().lower() not in (
+        "0", "false", "off", "no",
+    )
+
+
+def _capture_fit_baseline(model, X) -> None:
+    """Capture the model's drift baseline from the training matrix: score a
+    deterministic subsample and snapshot score + per-feature histograms
+    (:func:`~isoforest_tpu.telemetry.monitor.capture_baseline`).
+
+    Scoring is pinned to native (when available) or gather directly — not
+    ``model.score``/``strategy="auto"`` — so the capture never takes a
+    degradation rung of its own and never perturbs strategy-pinning tests.
+    """
+    from .. import native
+    from ..ops.traversal import score_matrix as _score_matrix
+    from ..telemetry.monitor import capture_baseline
+
+    X = np.asarray(X, np.float32)
+    n = int(X.shape[0])
+    step = max(1, -(-n // _BASELINE_MAX_ROWS))
+    sub = np.ascontiguousarray(X[::step])
+    with _telemetry_span("fit.baseline", rows=int(sub.shape[0])):
+        strategy = "native" if native.available() else "gather"
+        scores = _score_matrix(
+            model.forest,
+            sub,
+            model.num_samples,
+            layout=model._scoring_layout,
+            strategy=strategy,
+        )
+        model.baseline = capture_baseline(scores, sub, total_rows=n)
 
 
 def _blockwise_grow(
@@ -215,6 +259,7 @@ class IsolationForest(_ParamSetters):
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: Optional[int] = None,
         resume: bool = False,
+        baseline: bool = True,
     ) -> "IsolationForestModel":
         """Train. With ``mesh`` (a `jax.sharding.Mesh` with a ``'trees'`` axis),
         tree growth is sharded across devices (SURVEY.md §2.4 tree parallelism);
@@ -232,7 +277,13 @@ class IsolationForest(_ParamSetters):
         continues from the last sealed block — producing a forest, scores
         and threshold **bitwise identical** to an uninterrupted fit. A
         config/data mismatch on resume raises
-        :class:`~isoforest_tpu.resilience.CheckpointMismatchError`."""
+        :class:`~isoforest_tpu.resilience.CheckpointMismatchError`.
+
+        ``baseline`` (default on; also gated by ``ISOFOREST_TPU_BASELINE``)
+        captures the drift-monitoring baseline — training-score histogram +
+        quantiles and per-feature stats from a capped deterministic
+        subsample — persisted with the model as a ``_BASELINE.json``
+        sidecar (docs/observability.md §8)."""
         p = self.params
         X, _ = extract_features(data, p.features_col, nonfinite=nonfinite)
         total_rows, total_feats = int(X.shape[0]), int(X.shape[1])
@@ -320,6 +371,8 @@ class IsolationForest(_ParamSetters):
         # threshold pass below (and every later score) consumes it
         model.finalize_scoring()
         _compute_and_set_threshold(model, Xd, mesh=mesh)
+        if baseline and _baseline_env_enabled():
+            _capture_fit_baseline(model, X)
         return model
 
     # -- persistence (estimator: params-only metadata, IsolationForest.scala:114-125)
@@ -401,6 +454,13 @@ class IsolationForestModel:
         # resilience.FitCheckpoint with blocks_written/blocks_loaded;
         # None for plain fits and loads
         self.fit_checkpoint = None
+        # drift-monitoring baseline (telemetry.monitor.Baseline): captured
+        # by fit(), restored from the _BASELINE.json sidecar on load; None
+        # for legacy directories and fit(baseline=False)
+        self.baseline = None
+        # streaming drift monitor attached by enable_monitoring(); every
+        # score() folds into it while set
+        self._monitor = None
         # packed scoring layout (ops.scoring_layout): built eagerly by
         # fit()/finalize_scoring(), lazily on first score for persisted
         # models — the on-disk format stays the reference Avro node arrays
@@ -463,23 +523,31 @@ class IsolationForestModel:
             if mesh is not None:
                 from ..parallel.sharded import sharded_score
 
-                return sharded_score(mesh, self.forest, X, self.num_samples)
-            if self._scoring_layout is None:
-                self.finalize_scoring()
-            expected = (
-                self.total_num_features
-                if self.total_num_features != UNKNOWN_TOTAL_NUM_FEATURES
-                else None
-            )
-            return score_matrix(
-                self.forest,
-                X,
-                self.num_samples,
-                layout=self._scoring_layout,
-                strict=strict,
-                expected_features=expected,
-                timeout_s=timeout_s,
-            )
+                scores = sharded_score(mesh, self.forest, X, self.num_samples)
+            else:
+                if self._scoring_layout is None:
+                    self.finalize_scoring()
+                expected = (
+                    self.total_num_features
+                    if self.total_num_features != UNKNOWN_TOTAL_NUM_FEATURES
+                    else None
+                )
+                scores = score_matrix(
+                    self.forest,
+                    X,
+                    self.num_samples,
+                    layout=self._scoring_layout,
+                    strict=strict,
+                    expected_features=expected,
+                    timeout_s=timeout_s,
+                )
+        monitor = self._monitor
+        if monitor is not None:
+            # drift monitoring (docs/observability.md §8): fold the served
+            # batch AFTER scoring so monitor cost never sits between the
+            # caller and its scores on an alerting path
+            monitor.observe(scores, X)
+        return scores
 
     def degradations(self):
         """Structured degradation events recorded in this process (the
@@ -489,6 +557,49 @@ class IsolationForestModel:
         from ..resilience import degradations as _degradations
 
         return _degradations()
+
+    def diagnostics(self) -> dict:
+        """Forest-structure diagnostics from the packed scoring layout
+        (docs/observability.md §8): per-tree depth distribution, leaf-size
+        histogram, feature split-usage counts, expected-vs-realised average
+        path length and imbalance stats — plain JSON types, no Avro
+        re-traversal."""
+        from ..telemetry.diagnostics import forest_diagnostics
+
+        return forest_diagnostics(self)
+
+    def enable_monitoring(
+        self,
+        threshold: Optional[float] = None,
+        **monitor_kwargs,
+    ):
+        """Attach a streaming drift monitor
+        (:class:`~isoforest_tpu.telemetry.monitor.ScoreMonitor`): every
+        subsequent :meth:`score` folds its batch into the monitor, which
+        tracks PSI/KS of serving scores and input features against the
+        fit-time baseline, exports the ``isoforest_*_drift_psi`` gauges and
+        raises a ``drift_alert`` when the threshold is crossed (log-once;
+        ``strict`` scoring is unaffected — scores stay exact). Returns the
+        monitor; requires a baseline (fit with monitoring enabled, or a
+        model dir carrying the ``_BASELINE.json`` sidecar)."""
+        if self.baseline is None:
+            raise ValueError(
+                "this model has no drift baseline: it was loaded from a "
+                "legacy directory (no _BASELINE.json sidecar) or fitted "
+                "with baseline capture disabled — refit, or re-save from a "
+                "fit with baseline=True, to enable monitoring"
+            )
+        from ..telemetry.monitor import ScoreMonitor
+
+        kwargs = dict(monitor_kwargs)
+        if threshold is not None:
+            kwargs["threshold"] = threshold
+        self._monitor = ScoreMonitor(self.baseline, **kwargs)
+        return self._monitor
+
+    def disable_monitoring(self) -> None:
+        """Detach the drift monitor (its folded state is discarded)."""
+        self._monitor = None
 
     def warmup(
         self,

@@ -81,6 +81,14 @@ LADDER: Dict[str, str] = {
         "thread): scores are gather's, within cross-strategy f32 tolerance; "
         "a gather run that itself times out raises WatchdogTimeout"
     ),
+    # model-observability rung (telemetry/monitor.py, ScoreMonitor)
+    "drift_alert": (
+        "serving traffic drifted past the configured PSI threshold vs the "
+        "training baseline: scores are still computed exactly (no kernel "
+        "change) — the rung flags model-quality risk, not a compute "
+        "fallback, so strict scoring is deliberately unaffected "
+        "(docs/observability.md §8)"
+    ),
     # load-time rung (io/persistence.py, on_corrupt='drop')
     "dropped_trees": (
         "corrupt trees dropped at load -> valid smaller forest: path-length "

@@ -135,6 +135,26 @@ class TpuIsolationForest(BaseEstimator, OutlierMixin):
             np.asarray(X, np.float32), nonfinite=self.nonfinite
         )
 
+    # -- model observability pass-throughs (docs/observability.md §8) ---- #
+
+    def diagnostics(self) -> dict:
+        """Forest-structure diagnostics of the fitted model."""
+        self._check_fitted()
+        return self.model_.diagnostics()
+
+    def enable_monitoring(self, threshold=None, **monitor_kwargs):
+        """Attach a drift monitor to the fitted model; every subsequent
+        ``score_samples``/``predict``/``anomaly_score`` call folds its batch
+        into it. Returns the ScoreMonitor."""
+        self._check_fitted()
+        return self.model_.enable_monitoring(
+            threshold=threshold, **monitor_kwargs
+        )
+
+    def disable_monitoring(self) -> None:
+        self._check_fitted()
+        self.model_.disable_monitoring()
+
     def _check_fitted(self):
         if not hasattr(self, "model_"):
             raise NotFittedError(

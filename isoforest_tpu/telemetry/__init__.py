@@ -8,7 +8,7 @@ work flowed through which kernel (metrics), and every operational incident
 in causal order (events: degradation rungs, retries, watchdog timeouts,
 checkpoint seals/resumes, distributed bring-up attempts).
 
-Four coordinated pieces, stdlib-only:
+Seven coordinated pieces, stdlib-only:
 
 * :mod:`.spans` — nestable, thread-safe span tracer with wall/process time
   and optional ``jax.profiler.TraceAnnotation`` pass-through;
@@ -16,7 +16,16 @@ Four coordinated pieces, stdlib-only:
   fixed-bucket histograms with p50/p95/p99 summaries;
 * :mod:`.events` — one ordered, timestamped, bounded event timeline;
 * :mod:`.export` — JSON snapshot + Prometheus text exposition, wired into
-  ``bench.py`` and ``python -m isoforest_tpu telemetry``.
+  ``bench.py`` and ``python -m isoforest_tpu telemetry``;
+* :mod:`.monitor` — MODEL observability (ISSUE 5): training-baseline
+  capture at fit, and streaming PSI/KS drift of serving scores and input
+  features against it, with the ``drift_alert`` degradation rung;
+* :mod:`.diagnostics` — forest-structure diagnostics (depths, leaf sizes,
+  split-feature usage, realised vs expected path length) computed from the
+  packed scoring layout;
+* :mod:`.http` — a stdlib HTTP daemon serving ``/metrics`` (Prometheus),
+  ``/healthz`` (heartbeat liveness) and ``/snapshot`` (JSON), started via
+  :func:`serve` or ``ISOFOREST_TPU_METRICS_PORT``.
 
 Telemetry is ON by default and near-zero cost when disabled
 (``ISOFOREST_TPU_TELEMETRY=0`` or :func:`disable`; the enabled-vs-disabled
@@ -26,6 +35,7 @@ Span/metric/event names and schemas are documented in
 """
 
 from ._state import disable, enable, enabled
+from .diagnostics import forest_diagnostics, publish_gauges
 from .events import Event, EventTimeline, get_events, record_event, timeline
 from .export import (
     parse_prometheus,
@@ -34,6 +44,7 @@ from .export import (
     snapshot_json,
     to_prometheus,
 )
+from .http import MetricsServer, active_server, maybe_serve_from_env, serve
 from .metrics import (
     DEFAULT_LATENCY_BUCKETS,
     Counter,
@@ -46,32 +57,52 @@ from .metrics import (
     histogram,
     registry,
 )
+from .monitor import (
+    Baseline,
+    ScoreMonitor,
+    StreamBaseline,
+    capture_baseline,
+    ks,
+    psi,
+)
 from .spans import SpanRecord, current_span_name, span
 from .spans import records as span_records
 from .spans import summary as span_summary
 
 __all__ = [
     "DEFAULT_LATENCY_BUCKETS",
+    "Baseline",
     "Counter",
     "Event",
     "EventTimeline",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "MetricsServer",
+    "ScoreMonitor",
     "SpanRecord",
+    "StreamBaseline",
+    "active_server",
+    "capture_baseline",
     "counter",
     "current_span_name",
     "disable",
     "enable",
     "enabled",
     "exponential_buckets",
+    "forest_diagnostics",
     "gauge",
     "get_events",
     "histogram",
+    "ks",
+    "maybe_serve_from_env",
     "parse_prometheus",
+    "psi",
+    "publish_gauges",
     "record_event",
     "registry",
     "reset",
+    "serve",
     "snapshot",
     "snapshot_json",
     "span",
@@ -80,3 +111,8 @@ __all__ = [
     "timeline",
     "to_prometheus",
 ]
+
+# live /metrics endpoint opt-in: exporting ISOFOREST_TPU_METRICS_PORT makes
+# any process that imports the package serve its telemetry without a single
+# code change (docs/observability.md §8)
+maybe_serve_from_env()

@@ -1,0 +1,243 @@
+"""Live observability endpoint: a stdlib HTTP daemon serving telemetry.
+
+A serving deployment should not need a debugger (or even a Python prompt)
+to see what the model is doing: this module exposes the whole telemetry
+state over three paths on a ``http.server`` daemon thread — no external
+dependency, safe to run beside the scoring hot path (the server thread
+only *reads* registries that are already thread-safe):
+
+* ``GET /metrics`` — Prometheus text exposition 0.0.4
+  (:func:`..export.to_prometheus`): every counter/gauge/histogram,
+  including the drift gauges (:mod:`.monitor`) and forest-structure gauges
+  (:mod:`.diagnostics`). Point a Prometheus scraper at it verbatim.
+* ``GET /healthz`` — liveness wired to the resilience heartbeat files
+  (:func:`~isoforest_tpu.resilience.watchdog.peer_heartbeat_ages`): 200
+  while every peer's last heartbeat is younger than ``stale_after_s``,
+  503 (with the stale peers named) once any goes quiet. With no heartbeat
+  directory configured it reports plain process liveness (200).
+* ``GET /snapshot`` — the full JSON snapshot (:func:`..export.snapshot`):
+  spans, metrics, the event timeline.
+
+Start with ``telemetry.serve(port=...)`` (``port=0`` picks an ephemeral
+port, reported on the returned handle) or by exporting
+``ISOFOREST_TPU_METRICS_PORT`` before import — the package then starts the
+server automatically. ``ISOFOREST_TPU_HEARTBEAT_DIR`` /
+``ISOFOREST_TPU_STALE_AFTER_S`` configure the ``/healthz`` wiring the same
+way. Endpoint schema in ``docs/observability.md`` §8.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from . import export
+from .events import record_event
+
+METRICS_PORT_ENV = "ISOFOREST_TPU_METRICS_PORT"
+HEARTBEAT_DIR_ENV = "ISOFOREST_TPU_HEARTBEAT_DIR"
+STALE_AFTER_ENV = "ISOFOREST_TPU_STALE_AFTER_S"
+DEFAULT_STALE_AFTER_S = 15.0
+
+_INDEX = (
+    "isoforest_tpu telemetry endpoint\n"
+    "  /metrics   Prometheus text exposition\n"
+    "  /healthz   liveness (heartbeat ages when configured)\n"
+    "  /snapshot  full JSON telemetry snapshot\n"
+)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the MetricsServer instance is attached to the HTTPServer as `.owner`
+
+    def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler contract
+        owner: "MetricsServer" = self.server.owner  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            self._reply(
+                200,
+                "text/plain; version=0.0.4; charset=utf-8",
+                export.to_prometheus(),
+            )
+        elif path == "/snapshot":
+            self._reply(200, "application/json", export.snapshot_json() + "\n")
+        elif path in ("/healthz", "/health"):
+            payload, healthy = owner.health()
+            self._reply(
+                200 if healthy else 503,
+                "application/json",
+                json.dumps(payload, sort_keys=True) + "\n",
+            )
+        elif path == "/":
+            self._reply(200, "text/plain; charset=utf-8", _INDEX)
+        else:
+            self._reply(
+                404, "text/plain; charset=utf-8", f"unknown path {path}\n{_INDEX}"
+            )
+
+    def _reply(self, status: int, content_type: str, body: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, format: str, *args) -> None:
+        # request logging at debug only: a scraper polls every few seconds
+        # and must not flood the operator's log
+        from ..utils.logging import logger
+
+        logger.debug("metrics server: " + format, *args)
+
+
+class MetricsServer:
+    """Handle for a running telemetry HTTP daemon (see :func:`serve`)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        heartbeat_dir: Optional[str] = None,
+        stale_after_s: float = DEFAULT_STALE_AFTER_S,
+    ) -> None:
+        self.heartbeat_dir = heartbeat_dir
+        self.stale_after_s = float(stale_after_s)
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.owner = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            daemon=True,
+            name=f"isoforest-metrics[{self.port}]",
+        )
+        self._stopped = False
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "MetricsServer":
+        self._thread.start()
+        return self
+
+    def health(self) -> Tuple[dict, bool]:
+        """``(payload, healthy)`` for ``/healthz``: heartbeat ages from the
+        configured directory, flagging peers older than ``stale_after_s``
+        (an unreadable/torn heartbeat reports age ``null`` and counts as
+        stale — a peer that died mid-write is still a dead peer)."""
+        ages = {}
+        if self.heartbeat_dir:
+            # lazy import: watchdog imports telemetry at module load
+            from ..resilience.watchdog import peer_heartbeat_ages
+
+            ages = peer_heartbeat_ages(self.heartbeat_dir)
+        stale = sorted(
+            peer
+            for peer, age in ages.items()
+            if not math.isfinite(age) or age > self.stale_after_s
+        )
+        payload = {
+            "status": "ok" if not stale else "stale",
+            "peers": {
+                peer: (round(age, 3) if math.isfinite(age) else None)
+                for peer, age in sorted(ages.items())
+            },
+            "stale_peers": stale,
+            "stale_after_s": self.stale_after_s,
+            "heartbeat_dir": self.heartbeat_dir,
+        }
+        return payload, not stale
+
+    def stop(self) -> None:
+        """Shut the daemon down (idempotent)."""
+        if self._stopped:
+            return
+        self._stopped = True
+        port = self.port
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+        record_event("metrics_server.stop", port=port)
+        global _SERVER
+        if _SERVER is self:
+            _SERVER = None
+
+
+_SERVER: Optional[MetricsServer] = None
+
+
+def serve(
+    port: Optional[int] = None,
+    host: str = "127.0.0.1",
+    heartbeat_dir: Optional[str] = None,
+    stale_after_s: Optional[float] = None,
+) -> MetricsServer:
+    """Start the telemetry HTTP daemon; returns its handle (``.port`` for
+    ``port=0`` ephemeral binds, ``.stop()`` to shut down).
+
+    ``port=None`` reads ``ISOFOREST_TPU_METRICS_PORT``; ``heartbeat_dir``
+    and ``stale_after_s`` default from ``ISOFOREST_TPU_HEARTBEAT_DIR`` /
+    ``ISOFOREST_TPU_STALE_AFTER_S`` and wire ``/healthz`` to the multihost
+    heartbeat files (docs/resilience.md §7)."""
+    if port is None:
+        raw = os.environ.get(METRICS_PORT_ENV)
+        if raw is None:
+            raise ValueError(
+                f"serve() needs port=... or the {METRICS_PORT_ENV} env var"
+            )
+        port = int(raw)
+    if heartbeat_dir is None:
+        heartbeat_dir = os.environ.get(HEARTBEAT_DIR_ENV) or None
+    if stale_after_s is None:
+        stale_after_s = float(
+            os.environ.get(STALE_AFTER_ENV, DEFAULT_STALE_AFTER_S)
+        )
+    server = MetricsServer(
+        host=host,
+        port=port,
+        heartbeat_dir=heartbeat_dir,
+        stale_after_s=stale_after_s,
+    ).start()
+    record_event("metrics_server.start", port=server.port)
+    global _SERVER
+    _SERVER = server
+    return server
+
+
+def active_server() -> Optional[MetricsServer]:
+    """The most recently started (still running) server, if any."""
+    return _SERVER
+
+
+def maybe_serve_from_env() -> Optional[MetricsServer]:
+    """Auto-start at package import when ``ISOFOREST_TPU_METRICS_PORT`` is
+    set; a bind failure logs a warning instead of breaking the import (the
+    scoring library must work even when the operator fat-fingers a port)."""
+    raw = os.environ.get(METRICS_PORT_ENV)
+    if not raw or _SERVER is not None:
+        return None
+    try:
+        return serve(port=int(raw))
+    except Exception as exc:
+        from ..utils.logging import logger
+
+        logger.warning(
+            "could not start the telemetry metrics server from %s=%r: %s",
+            METRICS_PORT_ENV,
+            raw,
+            exc,
+        )
+        return None

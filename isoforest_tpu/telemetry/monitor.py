@@ -1,0 +1,592 @@
+"""Model observability: training baseline + streaming score/feature drift.
+
+A forest serving the ROADMAP's traffic can rot silently: the model keeps
+emitting well-formed scores while the input distribution walks away from the
+training bag, and the first operational signal is a delayed AUROC drop. The
+isolation-forest literature frames both score distributions and split-feature
+usage as the first-order health signals (arXiv:2309.11450 treats scores as
+distributional objects; arXiv:2505.12825 analyses the split-axis inductive
+bias), so this module tracks them continuously:
+
+* :func:`capture_baseline` — at ``fit()`` time, snapshot the training-score
+  histogram + exact quantiles and per-feature min/max/mean/histogram from a
+  deterministic subsample of the training matrix (the same rows the score
+  histogram uses; an unbiased stand-in for the per-tree bags). The
+  :class:`Baseline` persists as a ``_BASELINE.json`` sidecar next to the Avro
+  node table (``io/persistence.py``), sealed by the same ``_MANIFEST.json``
+  as every other content file, and round-trips through save/load. Legacy
+  directories load with ``model.baseline = None`` plus a warning.
+* :class:`ScoreMonitor` — at score time, folds every served batch into the
+  baseline's exact histogram shape and computes **PSI** (population
+  stability index) and **KS** (Kolmogorov-Smirnov statistic) of the serving
+  score and per-feature input distributions against the baseline, exporting
+  ``isoforest_score_drift_psi`` / ``isoforest_feature_drift_psi{feature=}``
+  gauges, recording a ``drift.alert`` timeline event when a configurable
+  threshold is crossed, and (optionally) taking the ``drift_alert`` rung of
+  the degradation ladder — log-once, and deliberately **never** strict:
+  scores are still computed exactly, so ``score(strict=True)`` is unaffected
+  (the rung flags model-quality risk, not a compute fallback).
+
+PSI/KS definitions, thresholds and the sidecar format are documented in
+``docs/observability.md`` §8; the drift rung's row lives in
+``docs/resilience.md``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .events import record_event
+from .metrics import counter as _counter, gauge as _gauge
+
+BASELINE_NAME = "_BASELINE.json"
+BASELINE_VERSION = 1
+
+# Histogram shapes: scores live in [0, 1] by construction (2^(-E[h]/c(n))),
+# features span their observed training range. 64/32 uniform bins keep the
+# sidecar small (~10 KB at F=10) while PSI at these widths resolves the
+# canonical 0.1/0.25 thresholds comfortably.
+SCORE_BINS = 64
+FEATURE_BINS = 32
+
+# Canonical PSI bands (banking/scorecard practice, and the operating points
+# docs/observability.md documents): < 0.1 stable, 0.1-0.25 moderate shift,
+# > 0.25 major shift. The default alert threshold is the major band.
+DEFAULT_PSI_THRESHOLD = 0.25
+
+_SCORE_QUANTILES = (0.01, 0.05, 0.25, 0.50, 0.75, 0.95, 0.99)
+
+# Drift gauges + fold volume (docs/observability.md §3): module-cached so the
+# serving hot path never pays a registry lookup per batch.
+_SCORE_DRIFT_PSI = _gauge(
+    "isoforest_score_drift_psi",
+    "PSI of the serving score distribution vs the training baseline",
+)
+_SCORE_DRIFT_KS = _gauge(
+    "isoforest_score_drift_ks",
+    "KS statistic of the serving score distribution vs the training baseline",
+)
+_FEATURE_DRIFT_PSI = _gauge(
+    "isoforest_feature_drift_psi",
+    "PSI of each serving input feature vs the training baseline",
+    labelnames=("feature",),
+)
+_MONITORED_ROWS_TOTAL = _counter(
+    "isoforest_monitored_rows_total",
+    "Rows folded into the serving drift monitor",
+)
+
+
+def _fold(values: np.ndarray, lo: float, hi: float, bins: int) -> np.ndarray:
+    """Histogram ``values`` into ``bins`` uniform buckets over ``[lo, hi]``;
+    out-of-range values clip into the edge buckets (a serving value past the
+    training max IS signal, and it must land in the last bucket rather than
+    vanish). Vectorised arithmetic, not ``np.histogram`` — this runs on the
+    scoring hot path under the ≤3% bench_smoke overhead gate."""
+    v = np.asarray(values, np.float64).reshape(-1)
+    if hi <= lo:  # degenerate (constant) training feature
+        hi = lo + 1.0
+    with np.errstate(invalid="ignore"):
+        idx = ((v - lo) * (bins / (hi - lo))).astype(np.int64)
+    np.clip(idx, 0, bins - 1, out=idx)
+    return np.bincount(idx, minlength=bins)
+
+
+def psi(
+    expected_counts: Sequence[float],
+    observed_counts: Sequence[float],
+    eps: float = 1e-4,
+) -> float:
+    """Population stability index between two aligned histograms:
+    ``sum((q_i - p_i) * ln(q_i / p_i))`` over bucket proportions ``p``
+    (expected/baseline) and ``q`` (observed/serving), each floored at
+    ``eps`` so empty buckets stay finite (the standard scorecard
+    formulation). Symmetric and >= 0; 0 iff the proportions agree."""
+    p = np.asarray(expected_counts, np.float64)
+    q = np.asarray(observed_counts, np.float64)
+    if p.shape != q.shape or p.ndim != 1:
+        raise ValueError(
+            f"histograms must be 1-D and aligned; got {p.shape} vs {q.shape}"
+        )
+    if p.sum() <= 0 or q.sum() <= 0:
+        raise ValueError("psi needs non-empty histograms on both sides")
+    p = np.maximum(p / p.sum(), eps)
+    q = np.maximum(q / q.sum(), eps)
+    return float(np.sum((q - p) * np.log(q / p)))
+
+
+def ks(
+    expected_counts: Sequence[float], observed_counts: Sequence[float]
+) -> float:
+    """Kolmogorov-Smirnov statistic between two aligned histograms: the
+    maximum absolute difference of their empirical CDFs, evaluated at the
+    shared bucket edges. In [0, 1]."""
+    p = np.asarray(expected_counts, np.float64)
+    q = np.asarray(observed_counts, np.float64)
+    if p.shape != q.shape or p.ndim != 1:
+        raise ValueError(
+            f"histograms must be 1-D and aligned; got {p.shape} vs {q.shape}"
+        )
+    if p.sum() <= 0 or q.sum() <= 0:
+        raise ValueError("ks needs non-empty histograms on both sides")
+    return float(np.max(np.abs(np.cumsum(p / p.sum()) - np.cumsum(q / q.sum()))))
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamBaseline:
+    """One monitored stream (the score, or one input feature): uniform
+    histogram over ``[lo, hi]`` plus exact min/max/mean of the captured
+    training values."""
+
+    lo: float
+    hi: float
+    counts: Tuple[int, ...]
+    min: float
+    max: float
+    mean: float
+
+    def as_dict(self) -> dict:
+        return {
+            "lo": self.lo,
+            "hi": self.hi,
+            "counts": list(self.counts),
+            "min": self.min,
+            "max": self.max,
+            "mean": self.mean,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "StreamBaseline":
+        return cls(
+            lo=float(d["lo"]),
+            hi=float(d["hi"]),
+            counts=tuple(int(c) for c in d["counts"]),
+            min=float(d["min"]),
+            max=float(d["max"]),
+            mean=float(d["mean"]),
+        )
+
+    def fold(self, values: np.ndarray) -> np.ndarray:
+        return _fold(values, self.lo, self.hi, len(self.counts))
+
+
+@dataclasses.dataclass(frozen=True)
+class Baseline:
+    """Training-time snapshot a :class:`ScoreMonitor` compares serving
+    traffic against. JSON round-trip is exact for the histogram counts
+    (ints) and ``repr``-faithful for the float summaries."""
+
+    score: StreamBaseline
+    features: Tuple[StreamBaseline, ...]
+    score_quantiles: Dict[str, float]
+    rows: int  # training rows the capture subsampled from
+    captured_rows: int  # rows actually scored/histogrammed
+
+    @property
+    def num_features(self) -> int:
+        return len(self.features)
+
+    def as_dict(self) -> dict:
+        return {
+            "baselineVersion": BASELINE_VERSION,
+            "rows": self.rows,
+            "capturedRows": self.captured_rows,
+            "score": self.score.as_dict(),
+            "scoreQuantiles": dict(self.score_quantiles),
+            "features": [f.as_dict() for f in self.features],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Baseline":
+        version = d.get("baselineVersion")
+        if version != BASELINE_VERSION:
+            raise ValueError(
+                f"baseline sidecar version {version!r} != supported "
+                f"{BASELINE_VERSION} (written by an incompatible version)"
+            )
+        return cls(
+            score=StreamBaseline.from_dict(d["score"]),
+            features=tuple(
+                StreamBaseline.from_dict(f) for f in d["features"]
+            ),
+            score_quantiles={
+                k: float(v) for k, v in d["scoreQuantiles"].items()
+            },
+            rows=int(d["rows"]),
+            captured_rows=int(d["capturedRows"]),
+        )
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.as_dict(), fh, indent=1, sort_keys=True)
+            fh.write("\n")
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path) as fh:
+            return cls.from_dict(json.load(fh))
+
+
+def _stream_baseline(
+    values: np.ndarray, lo: float, hi: float, bins: int
+) -> StreamBaseline:
+    v = np.asarray(values, np.float64).reshape(-1)
+    finite = v[np.isfinite(v)]
+    if finite.size == 0:
+        finite = np.zeros((1,), np.float64)
+    return StreamBaseline(
+        lo=float(lo),
+        hi=float(hi),
+        counts=tuple(int(c) for c in _fold(v, lo, hi, bins)),
+        min=float(finite.min()),
+        max=float(finite.max()),
+        mean=float(finite.mean()),
+    )
+
+
+def capture_baseline(
+    scores: np.ndarray,
+    X: np.ndarray,
+    total_rows: Optional[int] = None,
+    score_bins: int = SCORE_BINS,
+    feature_bins: int = FEATURE_BINS,
+) -> Baseline:
+    """Build a :class:`Baseline` from training scores and the matching
+    feature rows. ``scores`` and ``X`` must be row-aligned (both come from
+    the same training subsample); feature histogram ranges are the observed
+    training min/max, score range is the fixed ``[0, 1]`` score codomain."""
+    scores = np.asarray(scores, np.float64).reshape(-1)
+    X = np.asarray(X, np.float64)
+    if X.ndim != 2 or X.shape[0] != scores.shape[0]:
+        raise ValueError(
+            f"scores and X must be row-aligned; got {scores.shape} vs {X.shape}"
+        )
+    if scores.size == 0:
+        raise ValueError("cannot capture a baseline from zero rows")
+    qs = np.quantile(scores, _SCORE_QUANTILES)
+    features = []
+    for i in range(X.shape[1]):
+        col = X[:, i]
+        finite = col[np.isfinite(col)]
+        lo = float(finite.min()) if finite.size else 0.0
+        hi = float(finite.max()) if finite.size else 1.0
+        features.append(_stream_baseline(col, lo, hi, feature_bins))
+    return Baseline(
+        score=_stream_baseline(scores, 0.0, 1.0, score_bins),
+        features=tuple(features),
+        score_quantiles={
+            f"p{int(q * 100):02d}": float(v)
+            for q, v in zip(_SCORE_QUANTILES, qs)
+        },
+        rows=int(total_rows if total_rows is not None else scores.shape[0]),
+        captured_rows=int(scores.shape[0]),
+    )
+
+
+class ScoreMonitor:
+    """Streaming drift monitor: fold served batches, compare to a baseline.
+
+    Attach to a model with ``model.enable_monitoring()`` (every
+    ``model.score`` then folds automatically) or drive :meth:`observe`
+    directly. Thread-safe — serving stacks score from worker pools.
+
+    ``threshold``/``feature_threshold`` are PSI alert levels (default the
+    canonical 0.25 "major shift" band). Alerts are edge-triggered per
+    stream: crossing records one ``drift.alert`` timeline event (and, with
+    ``ladder=True``, takes the ``drift_alert`` degradation rung — log-once,
+    counted per occurrence) and re-arms only after the stream's PSI falls
+    back under its threshold. ``min_rows`` suppresses evaluation until the
+    fold is statistically meaningful. Folding is capped per batch at
+    ``max_score_rows_per_batch`` / ``max_feature_rows_per_batch``
+    deterministically-strided rows so huge batches and wide inputs stay
+    inside the ≤3% scoring-overhead gate (``tools/bench_smoke.py``) — PSI
+    compares *proportions*, so a strided subsample of a batch estimates the
+    same distribution (``rows`` still reports every served row).
+    """
+
+    def __init__(
+        self,
+        baseline: Baseline,
+        threshold: float = DEFAULT_PSI_THRESHOLD,
+        feature_threshold: Optional[float] = None,
+        ladder: bool = True,
+        min_rows: int = 512,
+        max_score_rows_per_batch: int = 32768,
+        max_feature_rows_per_batch: int = 2048,
+    ) -> None:
+        if threshold <= 0:
+            raise ValueError(f"threshold must be positive, got {threshold}")
+        self.baseline = baseline
+        self.threshold = float(threshold)
+        self.feature_threshold = float(
+            feature_threshold if feature_threshold is not None else threshold
+        )
+        self.ladder = bool(ladder)
+        self.min_rows = int(min_rows)
+        self.max_score_rows_per_batch = int(max_score_rows_per_batch)
+        self.max_feature_rows_per_batch = int(max_feature_rows_per_batch)
+        self._lock = threading.Lock()
+        self._score_counts = np.zeros(len(baseline.score.counts), np.int64)
+        self._rows = 0
+        self._feature_rows = 0
+        self._rows_at_eval = 0
+        self._feature_rows_at_eval = 0
+        self._alerted: set = set()
+        self._alerts: List[dict] = []
+        # fused-fold precomputation (the observe() hot path runs under the
+        # ≤3% bench_smoke gate): per-stream lo/scale in f32, all feature
+        # streams folded by ONE bincount over offset bucket indices. All
+        # capture_baseline features share one bin count by construction;
+        # a hand-built heterogeneous baseline falls back to per-stream fold.
+        s = baseline.score
+        self._score_bins = len(s.counts)
+        self._score_lo = np.float32(s.lo)
+        self._score_scale = np.float32(
+            self._score_bins / ((s.hi - s.lo) if s.hi > s.lo else 1.0)
+        )
+        bins_per_feature = {len(f.counts) for f in baseline.features}
+        self._uniform = len(bins_per_feature) <= 1
+        self._f_bins = bins_per_feature.pop() if self._uniform and bins_per_feature else 0
+        if self._uniform:
+            self._feature_counts = np.zeros(
+                (baseline.num_features, self._f_bins), np.int64
+            )
+            self._f_lo = np.asarray(
+                [f.lo for f in baseline.features], np.float32
+            )
+            self._f_scale = np.asarray(
+                [
+                    self._f_bins / ((f.hi - f.lo) if f.hi > f.lo else 1.0)
+                    for f in baseline.features
+                ],
+                np.float32,
+            )
+            self._f_offsets = (
+                np.arange(baseline.num_features, dtype=np.int32) * self._f_bins
+            )
+        else:
+            self._feature_counts = [
+                np.zeros(len(f.counts), np.int64) for f in baseline.features
+            ]
+        if self._uniform and baseline.num_features:
+            # baseline proportions pre-clamped at the psi() eps so the
+            # per-observe evaluation is one vectorised pass over [F, bins]
+            p = np.asarray([f.counts for f in baseline.features], np.float64)
+            self._f_p = np.maximum(p / np.maximum(p.sum(axis=1, keepdims=True), 1.0), 1e-4)
+        else:
+            self._f_p = None
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def rows(self) -> int:
+        with self._lock:
+            return self._rows
+
+    def observe(self, scores: np.ndarray, X: Optional[np.ndarray] = None) -> None:
+        """Fold one served batch: the scores, and (when given) the matching
+        feature matrix. Called by ``model.score`` when monitoring is
+        enabled."""
+        scores = np.asarray(scores)
+        if scores.size == 0:
+            return
+        base = self.baseline
+        total_rows = int(scores.size)
+        v = scores.reshape(-1)
+        step = max(1, -(-v.shape[0] // self.max_score_rows_per_batch))
+        if step > 1:
+            v = v[::step]
+        if v.dtype.kind not in "fd":
+            v = v.astype(np.float32)
+        with np.errstate(invalid="ignore"):
+            # intp indices feed np.bincount without an internal widening copy
+            score_idx = ((v - self._score_lo) * self._score_scale).astype(
+                np.intp
+            )
+        np.clip(score_idx, 0, self._score_bins - 1, out=score_idx)
+        score_fold = np.bincount(score_idx, minlength=self._score_bins)
+        feature_fold = None
+        sub_rows = 0
+        if X is not None:
+            X = np.asarray(X)
+            if X.ndim != 2 or X.shape[1] != base.num_features:
+                raise ValueError(
+                    f"monitored X must be [N, {base.num_features}] to match "
+                    f"the baseline; got shape {X.shape}"
+                )
+            step = max(1, -(-X.shape[0] // self.max_feature_rows_per_batch))
+            sub = X[::step]
+            if sub.dtype.kind not in "fd":
+                sub = sub.astype(np.float32)
+            sub_rows = int(sub.shape[0])
+            if self._uniform:
+                with np.errstate(invalid="ignore"):
+                    idx = ((sub - self._f_lo) * self._f_scale).astype(np.intp)
+                np.clip(idx, 0, self._f_bins - 1, out=idx)
+                idx += self._f_offsets
+                feature_fold = np.bincount(
+                    idx.ravel(), minlength=base.num_features * self._f_bins
+                ).reshape(base.num_features, self._f_bins)
+            else:
+                feature_fold = [
+                    base.features[i].fold(sub[:, i])
+                    for i in range(base.num_features)
+                ]
+        with self._lock:
+            self._score_counts += score_fold
+            self._rows += total_rows
+            if feature_fold is not None:
+                if self._uniform:
+                    self._feature_counts += feature_fold
+                else:
+                    for acc, fold in zip(self._feature_counts, feature_fold):
+                        acc += fold
+                self._feature_rows += sub_rows
+        _MONITORED_ROWS_TOTAL.inc(total_rows)
+        self._evaluate()
+
+    def drift(self) -> dict:
+        """Current drift statistics: ``{"score": {psi, ks}, "features":
+        {index: psi}}`` (streams without enough folded rows are absent)."""
+        base = self.baseline
+        with self._lock:
+            rows = self._rows
+            feature_rows = self._feature_rows
+            score_counts = self._score_counts.copy()
+            if self._uniform:
+                feature_counts = self._feature_counts.copy()
+            else:
+                feature_counts = [c.copy() for c in self._feature_counts]
+        out: dict = {"rows": rows, "feature_rows": feature_rows}
+        if rows >= self.min_rows:
+            out["score"] = {
+                "psi": psi(base.score.counts, score_counts),
+                "ks": ks(base.score.counts, score_counts),
+            }
+        if feature_rows >= self.min_rows and base.num_features:
+            if self._uniform:
+                # one vectorised PSI pass across every feature stream —
+                # identical numerics to psi() per stream (proven in tests)
+                q = feature_counts.astype(np.float64)
+                q = np.maximum(q / np.maximum(q.sum(axis=1, keepdims=True), 1.0), 1e-4)
+                vals = ((q - self._f_p) * np.log(q / self._f_p)).sum(axis=1)
+                out["features"] = {i: float(v) for i, v in enumerate(vals)}
+            else:
+                out["features"] = {
+                    i: psi(base.features[i].counts, feature_counts[i])
+                    for i in range(base.num_features)
+                }
+        return out
+
+    def report(self) -> dict:
+        """Operator-facing summary: thresholds, drift stats per stream, and
+        every alert fired so far. Plain JSON types."""
+        d = self.drift()
+        with self._lock:
+            alerts = [dict(a) for a in self._alerts]
+        report = {
+            "rows": d["rows"],
+            "feature_rows": d["feature_rows"],
+            "threshold": self.threshold,
+            "feature_threshold": self.feature_threshold,
+            "drifted": bool(alerts),
+            "alerts": alerts,
+        }
+        if "score" in d:
+            report["score"] = {
+                "psi": round(d["score"]["psi"], 6),
+                "ks": round(d["score"]["ks"], 6),
+            }
+        if "features" in d:
+            report["features"] = {
+                str(i): round(v, 6) for i, v in sorted(d["features"].items())
+            }
+        return report
+
+    def reset(self) -> None:
+        """Drop folded counts and re-arm every alert (the baseline stays)."""
+        with self._lock:
+            self._score_counts[:] = 0
+            if self._uniform:
+                self._feature_counts[:] = 0
+            else:
+                for acc in self._feature_counts:
+                    acc[:] = 0
+            self._rows = 0
+            self._feature_rows = 0
+            self._rows_at_eval = 0
+            self._feature_rows_at_eval = 0
+            self._alerted.clear()
+            self._alerts.clear()
+
+    # ------------------------------------------------------------------ #
+
+    def _evaluate(self) -> None:
+        # throttle: re-evaluate only after ~10% more rows folded since the
+        # last evaluation — PSI over ACCUMULATED counts moves slowly, so
+        # per-batch re-evaluation in a tight serving loop is pure overhead
+        # (the ≤3% gate); drift()/report() always compute fresh on demand
+        def _grew(now: int, then: int) -> bool:
+            return now > 0 if then == 0 else now >= max(then + 1, int(then * 1.1))
+
+        with self._lock:
+            if self._rows < self.min_rows:
+                return
+            if not (
+                _grew(self._rows, self._rows_at_eval)
+                or _grew(self._feature_rows, self._feature_rows_at_eval)
+            ):
+                return
+            self._rows_at_eval = self._rows
+            self._feature_rows_at_eval = self._feature_rows
+        d = self.drift()
+        if "score" in d:
+            _SCORE_DRIFT_PSI.set(d["score"]["psi"])
+            _SCORE_DRIFT_KS.set(d["score"]["ks"])
+            self._check("score", d["score"]["psi"], self.threshold, d["rows"])
+        if "features" in d:
+            for i, value in d["features"].items():
+                _FEATURE_DRIFT_PSI.set(value, feature=i)
+                self._check(
+                    f"feature:{i}", value, self.feature_threshold,
+                    d["feature_rows"],
+                )
+
+    def _check(self, stream: str, value: float, threshold: float, rows: int) -> None:
+        with self._lock:
+            crossed = value > threshold
+            if not crossed:
+                self._alerted.discard(stream)  # re-arm once back in band
+                return
+            if stream in self._alerted:
+                return
+            self._alerted.add(stream)
+            alert = {
+                "stream": stream,
+                "psi": round(float(value), 6),
+                "threshold": threshold,
+                "rows": rows,
+            }
+            self._alerts.append(alert)
+        record_event("drift.alert", **alert)
+        if self.ladder:
+            # lazy import: degradation imports telemetry at module load, so a
+            # top-level import here would be circular
+            from ..resilience.degradation import degrade
+
+            degrade(
+                "drift_alert",
+                "in-distribution serving traffic",
+                "drifted serving traffic (scores still exact)",
+                detail=(
+                    f"drift monitor: {stream} PSI {value:.4f} crossed the "
+                    f"alert threshold {threshold:g} after {rows} served rows "
+                    "— serving inputs no longer match the training baseline "
+                    "(docs/observability.md §8)"
+                ),
+            )

@@ -1,0 +1,595 @@
+"""Model observability: baseline capture/persistence, PSI/KS drift
+monitoring, forest diagnostics, and the live HTTP endpoint (ISSUE 5).
+
+Covers the acceptance matrix:
+  * baseline capture at fit + save/load round-trip (including legacy dirs
+    without the sidecar) with bitwise-identical scores;
+  * PSI/KS math against hand-computed fixtures;
+  * injected covariate shift fires the drift alert (event + ladder rung)
+    while re-serving the training distribution does not;
+  * diagnostics golden values on a hand-built fixed forest;
+  * HTTP endpoint golden behaviour + /healthz flip on a stale heartbeat
+    (fault-injected timestamps, zero real sleeps).
+"""
+
+import json
+import math
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from isoforest_tpu import IsolationForest, IsolationForestModel, telemetry
+from isoforest_tpu.models.extended import (
+    ExtendedIsolationForest,
+    ExtendedIsolationForestModel,
+)
+from isoforest_tpu.resilience.degradation import (
+    degradation_report,
+    reset_degradations,
+)
+from isoforest_tpu.telemetry.monitor import (
+    BASELINE_NAME,
+    Baseline,
+    ScoreMonitor,
+    capture_baseline,
+    ks,
+    psi,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    reset_degradations()
+    yield
+    telemetry.reset()
+    reset_degradations()
+
+
+@pytest.fixture(scope="module")
+def kddcup_model():
+    """A model fit on the kddcup-like fixture, with its training data."""
+    from isoforest_tpu.data import kddcup_http_hard
+
+    X, _ = kddcup_http_hard(n=20000, seed=7)
+    model = IsolationForest(num_estimators=30, random_seed=1).fit(X)
+    return model, X
+
+
+# --------------------------------------------------------------------------- #
+# PSI / KS math vs hand-computed fixtures
+# --------------------------------------------------------------------------- #
+
+
+class TestDriftMath:
+    def test_psi_identical_histograms_is_zero(self):
+        assert psi([10, 20, 30], [10, 20, 30]) == 0.0
+        assert psi([10, 20, 30], [1, 2, 3]) == 0.0  # proportions, not counts
+
+    def test_psi_hand_computed_two_bins(self):
+        # p = (0.5, 0.5), q = (0.9, 0.1):
+        # PSI = (0.9-0.5)ln(0.9/0.5) + (0.1-0.5)ln(0.1/0.5)
+        expected = 0.4 * math.log(0.9 / 0.5) + (-0.4) * math.log(0.1 / 0.5)
+        assert psi([5, 5], [9, 1]) == pytest.approx(expected, rel=1e-12)
+
+    def test_psi_empty_observed_bin_uses_eps_floor(self):
+        # q = (1, 0) floored at eps: q = (1, 1e-4) before the delta terms
+        eps = 1e-4
+        p = (0.5, 0.5)
+        q = (1.0, eps)
+        expected = (q[0] - p[0]) * math.log(q[0] / p[0]) + (
+            q[1] - p[1]
+        ) * math.log(q[1] / p[1])
+        assert psi([1, 1], [7, 0]) == pytest.approx(expected, rel=1e-12)
+
+    def test_psi_symmetry_and_positivity(self):
+        a, b = [8, 4, 2, 1], [1, 2, 4, 8]
+        assert psi(a, b) == pytest.approx(psi(b, a), rel=1e-12)
+        assert psi(a, b) > 0
+
+    def test_ks_hand_computed(self):
+        # CDFs p: (0.25, 0.75, 1.0), q: (0.5, 0.75, 1.0) -> max |diff| 0.25
+        assert ks([1, 2, 1], [2, 1, 1]) == pytest.approx(0.25, rel=1e-12)
+        assert ks([1, 1], [1, 1]) == 0.0
+        # total separation: everything in opposite end bins
+        assert ks([10, 0], [0, 10]) == pytest.approx(1.0)
+
+    def test_shape_and_empty_validation(self):
+        with pytest.raises(ValueError):
+            psi([1, 2], [1, 2, 3])
+        with pytest.raises(ValueError):
+            psi([0, 0], [1, 2])
+        with pytest.raises(ValueError):
+            ks([1, 2], [0, 0])
+
+    def test_vectorised_feature_psi_matches_scalar(self):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(4096, 5)).astype(np.float32)
+        scores = rng.random(4096).astype(np.float32)
+        base = capture_baseline(scores, X)
+        mon = ScoreMonitor(base, min_rows=1, ladder=False)
+        shifted = X + rng.normal(size=(1, 5)).astype(np.float32)
+        mon.observe(scores, shifted)
+        d = mon.drift()
+        step = max(1, -(-len(shifted) // mon.max_feature_rows_per_batch))
+        sub = shifted[::step]
+        for i in range(5):
+            ref = psi(base.features[i].counts, base.features[i].fold(sub[:, i]))
+            assert d["features"][i] == pytest.approx(ref, abs=1e-12)
+
+
+# --------------------------------------------------------------------------- #
+# baseline capture + persistence round-trip
+# --------------------------------------------------------------------------- #
+
+
+class TestBaseline:
+    def test_fit_captures_baseline(self, kddcup_model):
+        model, X = kddcup_model
+        base = model.baseline
+        assert base is not None
+        assert base.num_features == X.shape[1]
+        assert base.rows == len(X)
+        assert base.captured_rows == len(X)  # under the 65536 cap
+        assert sum(base.score.counts) == base.captured_rows
+        # score stream lives on the fixed [0, 1] codomain
+        assert base.score.lo == 0.0 and base.score.hi == 1.0
+        q = base.score_quantiles
+        assert q["p01"] <= q["p50"] <= q["p99"]
+        for i, f in enumerate(base.features):
+            assert f.min <= f.mean <= f.max
+            assert sum(f.counts) == base.captured_rows
+
+    def test_fit_baseline_flag_and_env_disable(self, tmp_path, monkeypatch):
+        X = np.random.default_rng(0).normal(size=(600, 3)).astype(np.float32)
+        m = IsolationForest(num_estimators=5, random_seed=1).fit(
+            X, baseline=False
+        )
+        assert m.baseline is None
+        with pytest.raises(ValueError, match="no drift baseline"):
+            m.enable_monitoring()
+        monkeypatch.setenv("ISOFOREST_TPU_BASELINE", "0")
+        m2 = IsolationForest(num_estimators=5, random_seed=1).fit(X)
+        assert m2.baseline is None
+
+    def test_round_trip_identical_baseline_and_bitwise_scores(
+        self, kddcup_model, tmp_path
+    ):
+        model, X = kddcup_model
+        path = str(tmp_path / "model")
+        model.save(path)
+        assert os.path.exists(os.path.join(path, BASELINE_NAME))
+        # the sidecar is manifest-sealed like every other content file
+        manifest = json.load(open(os.path.join(path, "_MANIFEST.json")))
+        assert BASELINE_NAME in manifest["files"]
+        loaded = IsolationForestModel.load(path)
+        assert loaded.baseline is not None
+        assert loaded.baseline.as_dict() == model.baseline.as_dict()
+        ref = model.score(X[:2048])
+        got = loaded.score(X[:2048])
+        assert np.array_equal(ref, got), "save->load->score must be bitwise"
+
+    def test_json_round_trip_exact(self, kddcup_model):
+        model, _ = kddcup_model
+        d = model.baseline.as_dict()
+        again = Baseline.from_dict(json.loads(json.dumps(d)))
+        assert again.as_dict() == d
+
+    def test_extended_model_round_trip(self, tmp_path):
+        X = np.random.default_rng(1).normal(size=(1500, 4)).astype(np.float32)
+        m = ExtendedIsolationForest(num_estimators=8, random_seed=2).fit(X)
+        assert m.baseline is not None
+        path = str(tmp_path / "ext")
+        m.save(path)
+        loaded = ExtendedIsolationForestModel.load(path)
+        assert loaded.baseline.as_dict() == m.baseline.as_dict()
+
+    def test_legacy_dir_without_sidecar_warns_and_loads(
+        self, tmp_path, caplog
+    ):
+        X = np.random.default_rng(2).normal(size=(800, 3)).astype(np.float32)
+        m = IsolationForest(num_estimators=5, random_seed=1).fit(
+            X, baseline=False
+        )
+        path = str(tmp_path / "legacy")
+        m.save(path)  # no baseline -> no sidecar: the legacy layout
+        assert not os.path.exists(os.path.join(path, BASELINE_NAME))
+        import logging
+
+        with caplog.at_level(logging.WARNING, logger="isoforest_tpu"):
+            loaded = IsolationForestModel.load(path)
+        assert loaded.baseline is None
+        assert any(BASELINE_NAME in r.message for r in caplog.records)
+        # scoring a legacy model still works; monitoring refuses clearly
+        loaded.score(X[:64])
+        with pytest.raises(ValueError, match="no drift baseline"):
+            loaded.enable_monitoring()
+
+    def test_unsupported_sidecar_version_rejected(self):
+        with pytest.raises(ValueError, match="baseline sidecar version"):
+            Baseline.from_dict({"baselineVersion": 999})
+
+
+# --------------------------------------------------------------------------- #
+# drift detection: in-distribution stays quiet, covariate shift alerts
+# --------------------------------------------------------------------------- #
+
+
+class TestDriftDetection:
+    def test_in_distribution_traffic_stays_below_threshold(self, kddcup_model):
+        model, X = kddcup_model
+        monitor = model.enable_monitoring(threshold=0.25)
+        try:
+            model.score(X)  # re-serve the training distribution
+            report = monitor.report()
+            assert report["rows"] == len(X)
+            assert report["score"]["psi"] < 0.25
+            assert not report["drifted"]
+            assert telemetry.get_events(kind="drift.alert") == []
+            assert degradation_report().count("drift_alert") == 0
+        finally:
+            model.disable_monitoring()
+
+    def test_covariate_shift_raises_gauge_and_lands_alert(self, kddcup_model):
+        model, X = kddcup_model
+        monitor = model.enable_monitoring(threshold=0.25)
+        try:
+            shifted = X + 3.0 * np.std(X, axis=0, keepdims=True)
+            model.score(shifted)
+            report = monitor.report()
+            assert report["score"]["psi"] > 0.25
+            assert report["drifted"]
+            # the gauge the issue names, above threshold
+            gauge = telemetry.gauge("isoforest_score_drift_psi")
+            assert gauge.value() > 0.25
+            events = telemetry.get_events(kind="drift.alert")
+            assert any(e.fields["stream"] == "score" for e in events)
+            # the ladder rung landed (log-once, counted) ...
+            assert degradation_report().count("drift_alert") >= 1
+            # ... and the degradation timeline event carries the reason
+            degr = telemetry.get_events(kind="degradation")
+            assert any(e.fields["reason"] == "drift_alert" for e in degr)
+        finally:
+            model.disable_monitoring()
+
+    def test_strict_scoring_unaffected_by_drift(self, kddcup_model):
+        model, X = kddcup_model
+        model.enable_monitoring(threshold=0.05)
+        try:
+            # drifted traffic under strict=True must NOT raise: the rung
+            # flags model-quality risk, not a compute fallback
+            scores = model.score(X + 5.0, strict=True)
+            assert np.isfinite(scores).all()
+            assert degradation_report().count("drift_alert") >= 1
+        finally:
+            model.disable_monitoring()
+
+    def test_alert_is_edge_triggered_per_stream(self):
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(4096, 2)).astype(np.float32)
+        scores = rng.random(4096).astype(np.float32)
+        base = capture_baseline(scores, X)
+        mon = ScoreMonitor(base, threshold=0.25, min_rows=64, ladder=False)
+        shifted_scores = np.clip(scores * 0.2, 0.0, 1.0)
+        mon.observe(shifted_scores)
+        mon.observe(shifted_scores)
+        events = telemetry.get_events(kind="drift.alert")
+        assert len([e for e in events if e.fields["stream"] == "score"]) == 1
+        assert len(mon.report()["alerts"]) == 1
+
+    def test_monitor_validates_feature_width(self):
+        rng = np.random.default_rng(6)
+        X = rng.normal(size=(1024, 3)).astype(np.float32)
+        base = capture_baseline(rng.random(1024), X)
+        mon = ScoreMonitor(base, min_rows=1)
+        with pytest.raises(ValueError, match=r"\[N, 3\]"):
+            mon.observe(rng.random(10), np.zeros((10, 4), np.float32))
+
+    def test_reset_rearms_and_clears(self):
+        rng = np.random.default_rng(8)
+        scores = rng.random(2048).astype(np.float32)
+        base = capture_baseline(scores, rng.normal(size=(2048, 1)))
+        mon = ScoreMonitor(base, threshold=0.1, min_rows=32, ladder=False)
+        mon.observe(np.clip(scores * 0.1, 0, 1))
+        assert mon.report()["drifted"]
+        mon.reset()
+        assert mon.rows == 0
+        assert not mon.report()["drifted"]
+
+    def test_sklearn_adapter_pass_through(self):
+        from isoforest_tpu.sklearn import TpuIsolationForest
+
+        X = np.random.default_rng(9).normal(size=(1200, 3)).astype(np.float32)
+        est = TpuIsolationForest(n_estimators=5, random_state=1).fit(X)
+        mon = est.enable_monitoring(threshold=0.25, min_rows=64)
+        est.score_samples(X)
+        assert mon.rows == len(X)
+        assert "score" in mon.report()
+        est.disable_monitoring()
+        diag = est.diagnostics()
+        assert diag["num_trees"] == 5
+
+
+# --------------------------------------------------------------------------- #
+# diagnostics golden values
+# --------------------------------------------------------------------------- #
+
+
+def _hand_built_model():
+    """One tree, three leaves, fully hand-checkable:
+
+        root: split f0            (depth 0)
+          L: leaf n=3             (depth 1)
+          R: split f2             (depth 1)
+            RL: leaf n=2          (depth 2)
+            RR: leaf n=3          (depth 2)
+    """
+    from isoforest_tpu.ops.tree_growth import StandardForest
+    from isoforest_tpu.utils.params import IsolationForestParams
+
+    feature = np.full((1, 7), -1, np.int32)
+    threshold = np.zeros((1, 7), np.float32)
+    num_instances = np.full((1, 7), -1, np.int32)
+    feature[0, 0], threshold[0, 0] = 0, 0.5
+    num_instances[0, 1] = 3
+    feature[0, 2], threshold[0, 2] = 2, 1.5
+    num_instances[0, 5] = 2
+    num_instances[0, 6] = 3
+    forest = StandardForest(
+        feature=feature, threshold=threshold, num_instances=num_instances
+    )
+    return IsolationForestModel(
+        forest=forest,
+        params=IsolationForestParams(num_estimators=1),
+        num_samples=8,
+        num_features=3,
+        total_num_features=3,
+    )
+
+
+class TestDiagnostics:
+    def test_hand_built_golden_values(self):
+        from isoforest_tpu.utils.math import avg_path_length
+
+        diag = _hand_built_model().diagnostics()
+        assert diag["model"] == "standard"
+        assert diag["num_trees"] == 1
+        assert diag["nodes"] == {
+            "internal": 2,
+            "leaves": 3,
+            "slots": 7,
+            "occupancy": round(5 / 7, 6),
+        }
+        assert diag["tree_depth"] == {
+            "min": 2, "max": 2, "mean": 2.0, "histogram": {"2": 1},
+        }
+        assert diag["feature_split_usage"] == {"0": 1, "2": 1}
+        assert diag["leaf_size"]["min"] == 2
+        assert diag["leaf_size"]["max"] == 3
+        assert diag["leaf_size"]["histogram"] == {"2-3": 3}
+        c = lambda n: float(np.asarray(avg_path_length(n)))
+        # instance-weighted realised path length over the three leaves
+        actual = (3 * (1 + c(3)) + 2 * (2 + c(2)) + 3 * (2 + c(3))) / 8
+        assert diag["path_length"]["actual_mean"] == pytest.approx(
+            actual, abs=1e-5
+        )
+        assert diag["path_length"]["expected"] == pytest.approx(
+            c(8), abs=1e-6
+        )
+        # weighted mean leaf depth: (3*1 + 2*2 + 3*2) / 8
+        assert diag["leaf_depth"]["weighted_mean"] == pytest.approx(13 / 8)
+        assert diag["imbalance"]["depth_spread_mean"] == 1.0
+
+    def test_fitted_model_invariants(self, kddcup_model):
+        model, _ = kddcup_model
+        diag = model.diagnostics()
+        # a binary tree has exactly one more leaf than internal node
+        assert (
+            diag["nodes"]["leaves"]
+            == diag["nodes"]["internal"] + diag["num_trees"]
+        )
+        assert sum(diag["feature_split_usage"].values()) == diag["nodes"]["internal"]
+        assert diag["tree_depth"]["max"] <= diag["height_limit"]
+        assert sum(diag["tree_depth"]["histogram"].values()) == diag["num_trees"]
+        assert 0 < diag["path_length"]["ratio_actual_to_expected"] < 3
+        assert json.loads(json.dumps(diag)) == diag  # plain JSON types
+
+    def test_extended_forest_diagnostics(self):
+        X = np.random.default_rng(4).normal(size=(1000, 4)).astype(np.float32)
+        model = ExtendedIsolationForest(num_estimators=6, random_seed=3).fit(X)
+        diag = model.diagnostics()
+        assert diag["model"] == "extended"
+        assert diag["nodes"]["leaves"] == diag["nodes"]["internal"] + 6
+        # every hyperplane coordinate counts toward usage
+        assert sum(diag["feature_split_usage"].values()) >= diag["nodes"]["internal"]
+
+    def test_publish_gauges(self):
+        diag = _hand_built_model().diagnostics()
+        telemetry.publish_gauges(diag)
+        body = telemetry.to_prometheus()
+        parsed = telemetry.parse_prometheus(body)
+        assert parsed["isoforest_forest_trees"][()] == 1.0
+        assert (
+            parsed["isoforest_forest_feature_split_usage"][(("feature", "0"),)]
+            == 1.0
+        )
+        assert (
+            parsed["isoforest_forest_avg_path_length"][(("kind", "actual"),)]
+            > 0
+        )
+
+
+# --------------------------------------------------------------------------- #
+# HTTP endpoint
+# --------------------------------------------------------------------------- #
+
+
+def _get(url: str):
+    try:
+        resp = urllib.request.urlopen(url, timeout=10)
+        return resp.status, resp.read().decode("utf-8")
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode("utf-8")
+
+
+class TestHttpEndpoint:
+    def test_metrics_snapshot_and_404(self):
+        telemetry.counter("monitor_http_demo_total", "demo").inc(3)
+        server = telemetry.serve(port=0)
+        try:
+            assert server.port > 0
+            status, body = _get(server.url + "/metrics")
+            assert status == 200
+            parsed = telemetry.parse_prometheus(body)
+            assert parsed["monitor_http_demo_total"][()] == 3.0
+            status, body = _get(server.url + "/snapshot")
+            assert status == 200
+            snap = json.loads(body)
+            assert snap["telemetry_enabled"] is True
+            assert "monitor_http_demo_total" in snap["metrics"]
+            status, _ = _get(server.url + "/no-such-path")
+            assert status == 404
+            status, body = _get(server.url + "/")
+            assert status == 200 and "/metrics" in body
+        finally:
+            server.stop()
+        kinds = [e.kind for e in telemetry.get_events()]
+        assert "metrics_server.start" in kinds
+        assert "metrics_server.stop" in kinds
+
+    def test_healthz_flips_on_stale_heartbeat(self, tmp_path):
+        """Zero real sleeps: heartbeat staleness is fault-injected by
+        writing timestamps in the past."""
+        import time as _time
+
+        from isoforest_tpu.resilience.watchdog import HeartbeatWriter
+
+        hb_dir = str(tmp_path / "hb")
+        os.makedirs(hb_dir)
+        server = telemetry.serve(
+            port=0, heartbeat_dir=hb_dir, stale_after_s=15.0
+        )
+        try:
+            # no heartbeats at all: plain process liveness
+            status, body = _get(server.url + "/healthz")
+            assert status == 200 and json.loads(body)["status"] == "ok"
+            # one fresh heartbeat -> still healthy
+            writer = HeartbeatWriter(hb_dir, "worker-0")
+            writer.beat()
+            status, body = _get(server.url + "/healthz")
+            payload = json.loads(body)
+            assert status == 200
+            assert payload["peers"]["worker-0"] < 15.0
+            # inject staleness: rewrite the heartbeat 100 s into the past
+            stale = HeartbeatWriter(
+                hb_dir, "worker-0", clock=lambda: _time.time() - 100.0
+            )
+            stale.beat()
+            status, body = _get(server.url + "/healthz")
+            payload = json.loads(body)
+            assert status == 503
+            assert payload["status"] == "stale"
+            assert payload["stale_peers"] == ["worker-0"]
+            # a torn heartbeat file is a dead peer too
+            with open(
+                os.path.join(hb_dir, "heartbeat-worker-1.json"), "w"
+            ) as fh:
+                fh.write("{not json")
+            status, body = _get(server.url + "/healthz")
+            payload = json.loads(body)
+            assert status == 503
+            assert "worker-1" in payload["stale_peers"]
+            assert payload["peers"]["worker-1"] is None
+        finally:
+            server.stop()
+
+    def test_serve_env_port_and_missing_port_error(self, monkeypatch):
+        from isoforest_tpu.telemetry.http import METRICS_PORT_ENV
+
+        monkeypatch.delenv(METRICS_PORT_ENV, raising=False)
+        with pytest.raises(ValueError, match=METRICS_PORT_ENV):
+            telemetry.serve()
+        monkeypatch.setenv(METRICS_PORT_ENV, "0")
+        server = telemetry.serve()
+        try:
+            assert server.port > 0
+        finally:
+            server.stop()
+
+
+# --------------------------------------------------------------------------- #
+# CLI: diagnose + monitor, both formats
+# --------------------------------------------------------------------------- #
+
+
+class TestCli:
+    @pytest.fixture(scope="class")
+    def model_and_csv(self, tmp_path_factory):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(3000, 4)).astype(np.float32)
+        X[:50] += 5.0
+        root = tmp_path_factory.mktemp("obs-cli")
+        csv = root / "data.csv"
+        np.savetxt(csv, X, delimiter=",")
+        shifted = root / "shifted.csv"
+        np.savetxt(shifted, X + 4.0, delimiter=",")
+        model_dir = root / "model"
+        model = IsolationForest(num_estimators=10, random_seed=1).fit(X)
+        model.save(str(model_dir))
+        return str(model_dir), str(csv), str(shifted)
+
+    def test_diagnose_json(self, model_and_csv, capsys):
+        from isoforest_tpu.__main__ import main
+
+        model_dir, _, _ = model_and_csv
+        assert main(["diagnose", model_dir]) == 0
+        diag = json.loads(capsys.readouterr().out)
+        assert diag["num_trees"] == 10
+        assert "feature_split_usage" in diag
+
+    def test_diagnose_prometheus(self, model_and_csv, capsys):
+        from isoforest_tpu.__main__ import main
+
+        model_dir, _, _ = model_and_csv
+        assert main(["diagnose", model_dir, "--format", "prometheus"]) == 0
+        parsed = telemetry.parse_prometheus(capsys.readouterr().out)
+        assert parsed["isoforest_forest_trees"][()] == 10.0
+
+    def test_monitor_json_in_distribution(self, model_and_csv, capsys):
+        from isoforest_tpu.__main__ import main
+
+        model_dir, csv, _ = model_and_csv
+        assert main(["monitor", model_dir, "--input", csv]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["rows"] == 3000
+        assert report["score"]["psi"] < 0.25
+        assert report["drifted"] is False
+
+    def test_monitor_detects_shift_and_prometheus_format(
+        self, model_and_csv, capsys
+    ):
+        from isoforest_tpu.__main__ import main
+
+        model_dir, _, shifted = model_and_csv
+        rc = main(
+            ["monitor", model_dir, "--input", shifted, "--format", "prometheus"]
+        )
+        assert rc == 0
+        parsed = telemetry.parse_prometheus(capsys.readouterr().out)
+        assert parsed["isoforest_score_drift_psi"][()] > 0.25
+
+    def test_monitor_refuses_legacy_model(self, tmp_path, capsys):
+        from isoforest_tpu.__main__ import main
+
+        X = np.random.default_rng(1).normal(size=(600, 3)).astype(np.float32)
+        model = IsolationForest(num_estimators=4, random_seed=1).fit(
+            X, baseline=False
+        )
+        model_dir = str(tmp_path / "legacy")
+        model.save(model_dir)
+        csv = str(tmp_path / "d.csv")
+        np.savetxt(csv, X, delimiter=",")
+        assert main(["monitor", model_dir, "--input", csv]) == 2

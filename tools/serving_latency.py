@@ -19,19 +19,43 @@ batch 1 p50 0.94 ms / p99 2.45 ms; batch 64 p50 0.98 ms; batch 1024 p50
 1.49 ms; batch 8192 p50 3.57 ms — the 16k-row thread gate keeps serving
 batches single-threaded by design. (Bucketed quantiles land within one
 bucket edge of those.)
+
+``--metrics-port N`` (0 = ephemeral) additionally serves the live
+``telemetry.serve`` HTTP endpoint for the duration of the run and
+self-checks it end-to-end: the served ``/metrics`` body must parse via
+``telemetry.export.parse_prometheus`` and contain the latency histogram the
+loop just wrote.
 """
 
+import argparse
 import json
 import pathlib
 import sys
 import time
+import urllib.request
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
 def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        help="serve the telemetry HTTP endpoint on this port during the run "
+        "and smoke-check /metrics end-to-end (0 = ephemeral port)",
+    )
+    args = ap.parse_args()
+
     from isoforest_tpu import IsolationForest, telemetry
     from isoforest_tpu.data import kddcup_http_hard
+
+    server = (
+        telemetry.serve(port=args.metrics_port)
+        if args.metrics_port is not None
+        else None
+    )
 
     # ~1.3x-geometric bounds, 50 us .. ~0.65 s: serving latencies from a
     # warm 1-row native walk up to a cold 8k-row batch all resolve
@@ -71,6 +95,40 @@ def main() -> None:
             ),
             flush=True,
         )
+
+    if server is not None:
+        # end-to-end endpoint smoke: the latencies recorded above must come
+        # back over HTTP as parseable Prometheus exposition
+        try:
+            body = (
+                urllib.request.urlopen(server.url + "/metrics", timeout=10)
+                .read()
+                .decode("utf-8")
+            )
+            parsed = telemetry.parse_prometheus(body)
+            buckets = parsed.get("isoforest_serving_latency_seconds_bucket", {})
+            served_batches = {
+                dict(labels).get("batch") for labels in buckets
+            }
+            ok = {"1", "64", "1024", "8192"} <= served_batches
+            print(
+                json.dumps(
+                    {
+                        "metric": "metrics_endpoint_smoke",
+                        "url": server.url + "/metrics",
+                        "parsed_metrics": len(parsed),
+                        "latency_batches_served": sorted(
+                            served_batches, key=int
+                        ),
+                        "pass": ok,
+                    }
+                ),
+                flush=True,
+            )
+            if not ok:
+                sys.exit(1)
+        finally:
+            server.stop()
 
 
 if __name__ == "__main__":
