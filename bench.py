@@ -441,7 +441,7 @@ def main() -> None:
     # the unified degradation ladder (docs/resilience.md): any fallback the
     # run hit — e.g. native→gather on a toolchain-less host, the EIF pallas
     # fence — is dumped so a benchmark number is never silently mislabeled
-    from isoforest_tpu import telemetry
+    from isoforest_tpu import telemetry, tuning
     from isoforest_tpu.resilience import degradations
 
     # compact telemetry roll-up (docs/observability.md): per-span phase
@@ -479,6 +479,13 @@ def main() -> None:
                 "degradations": [e.as_dict() for e in degradations()],
                 "telemetry_spans": telemetry_spans,
                 "telemetry_events": len(telemetry.get_events()),
+                # the consulted cost-model table + per-source decision
+                # counts (docs/autotune.md), so a benchmark's strategy is
+                # never ambiguous about WHICH mechanism picked it (this
+                # run pins its own measured winner, so decisions here are
+                # typically source="pin")
+                "autotune_table": tuning.table_snapshot()["entries"],
+                "autotune_decisions": tuning.decision_counts(),
             }
         )
     )

@@ -14,6 +14,9 @@ operational surface here is a small CLI over CSV files:
     python -m isoforest_tpu diagnose /tmp/model [--format json|prometheus]
     python -m isoforest_tpu monitor /tmp/model --input live.csv \\
         [--threshold 0.25] [--port 9101] [--format json|prometheus]
+    python -m isoforest_tpu autotune [--format json|table] [--clear] \\
+        [--warm --input data.csv [--model /tmp/model] \\
+         --batch-sizes 1024,65536 [--refresh]]
 
 CSV rows are feature columns; ``--labeled`` treats the last column as a label
 (excluded from features; used to report AUROC after fit/score).
@@ -269,6 +272,55 @@ def cmd_monitor(args) -> int:
     return 0
 
 
+def cmd_autotune(args) -> int:
+    """Operate the measured strategy autotuner's persisted cost model
+    (docs/autotune.md): dump the winner table (default; ``--format json``
+    round-trips the persisted file), ``--clear`` it, or ``--warm`` it by
+    probing the given workload at each batch bucket so a serving fleet
+    never pays a cold probe on a live request."""
+    from . import tuning
+
+    if args.clear:
+        existed = tuning.clear_table()
+        print(json.dumps({"cleared": str(tuning.table_path()), "existed": existed}))
+        return 0
+    if args.warm:
+        if args.input:
+            X, _ = _load(args.input, args.labeled)
+        else:
+            rng = np.random.default_rng(0)
+            X = rng.normal(size=(4096, 4)).astype(np.float32)
+            X[:40] += 4.0
+        if args.model:
+            model = _load_model(args.model)
+        else:
+            from .models import IsolationForest
+
+            model = IsolationForest(num_estimators=args.trees, random_seed=1).fit(X)
+        decisions = []
+        for b in sorted({int(s) for s in args.batch_sizes.split(",") if s}):
+            Xb = np.resize(np.asarray(X, np.float32), (max(b, 1), X.shape[1]))
+            d = tuning.resolve_decision(
+                model.forest, Xb, model.num_samples, refresh=args.refresh
+            )
+            decisions.append(
+                {"batch": b, "key": d.key, "strategy": d.strategy, "source": d.source}
+            )
+        print(json.dumps({"warmed": decisions}), file=sys.stderr)
+    doc = tuning.table_snapshot()
+    if args.format == "table":
+        print(f"# {doc['path']} (schema {doc['schema']}, ttl {doc['ttl_s']:g}s)")
+        for key, entry in doc["entries"].items():
+            timings = " ".join(
+                f"{s}={t if t is not None else 'fail'}"
+                for s, t in sorted(entry.get("timings_s", {}).items())
+            )
+            print(f"{key} -> {entry['strategy']} [{timings}]")
+    else:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="isoforest_tpu", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -364,6 +416,35 @@ def build_parser() -> argparse.ArgumentParser:
     )
     mon.add_argument("--format", choices=("json", "prometheus"), default="json")
     mon.set_defaults(func=cmd_monitor)
+
+    at = sub.add_parser(
+        "autotune",
+        help="dump/clear/pre-warm the measured strategy cost model",
+    )
+    at.add_argument("--format", choices=("json", "table"), default="json")
+    at.add_argument(
+        "--clear", action="store_true", help="delete the persisted winner table"
+    )
+    at.add_argument(
+        "--warm",
+        action="store_true",
+        help="probe the workload at each --batch-sizes bucket before dumping",
+    )
+    at.add_argument("--input", default=None, help="CSV workload (default: synthetic)")
+    at.add_argument("--model", default=None, help="probe with a saved model")
+    at.add_argument("--labeled", action="store_true")
+    at.add_argument("--trees", type=int, default=50)
+    at.add_argument(
+        "--batch-sizes",
+        default="1024,65536",
+        help="comma-separated batch sizes to pre-warm (bucketed power-of-two)",
+    )
+    at.add_argument(
+        "--refresh",
+        action="store_true",
+        help="force re-probe even for fresh table entries (--no-cache analogue)",
+    )
+    at.set_defaults(func=cmd_autotune)
     return p
 
 

@@ -503,6 +503,7 @@ class IsolationForestModel:
         strict: bool = False,
         nonfinite: str = "warn",
         timeout_s: Optional[float] = None,
+        strategy: str = "auto",
     ) -> np.ndarray:
         """Outlier scores ``2^(-E[h(x)]/c(n))`` for an ``[N, F]`` matrix.
 
@@ -515,7 +516,9 @@ class IsolationForestModel:
         deadline is abandoned and retried once on the portable gather
         kernel (rung ``scoring_timeout``; under ``strict=True`` the timeout
         raises instead). Local-strategy path only — mesh scoring runs the
-        fused sharded program without a watchdog."""
+        fused sharded program without a watchdog. ``strategy`` defaults to
+        ``"auto"``, resolved by the measured autotuner (docs/autotune.md;
+        the mesh path restricts it to the shard_map-jittable pair)."""
         X = np.asarray(X, np.float32)
         check_non_finite(X, nonfinite)
         validate_feature_vector_size(X.shape[1], self.total_num_features)
@@ -523,7 +526,9 @@ class IsolationForestModel:
             if mesh is not None:
                 from ..parallel.sharded import sharded_score
 
-                scores = sharded_score(mesh, self.forest, X, self.num_samples)
+                scores = sharded_score(
+                    mesh, self.forest, X, self.num_samples, score_strategy=strategy
+                )
             else:
                 if self._scoring_layout is None:
                     self.finalize_scoring()
@@ -536,6 +541,7 @@ class IsolationForestModel:
                     self.forest,
                     X,
                     self.num_samples,
+                    strategy=strategy,
                     layout=self._scoring_layout,
                     strict=strict,
                     expected_features=expected,
@@ -630,12 +636,9 @@ class IsolationForestModel:
                     "pass width=<serving feature count> to warmup"
                 )
             width = self.total_num_features
-        buckets = sorted(
-            {
-                max(1024, 1 << int(np.ceil(np.log2(max(int(n), 1)))))
-                for n in batch_sizes
-            }
-        )
+        from ..ops.traversal import batch_bucket
+
+        buckets = sorted({batch_bucket(n) for n in batch_sizes})
         for bucket in buckets:
             dummy = np.zeros((bucket, max(width, 1)), np.float32)
             if mesh is not None:

@@ -25,8 +25,9 @@ layout build time; score ``2^(-E[h]/c(n))``
 
 from __future__ import annotations
 
-import functools
+import contextlib
 import os
+import threading
 import time
 
 import jax
@@ -250,7 +251,10 @@ STRATEGIES = ("gather", "dense", "pallas", "walk", "native")
 # RESOLVED strategy's execution (post-ladder, so a native→gather fallback
 # times as gather) and rows scored. Module-cached metric objects: the
 # serving path calls score_matrix in a tight loop and must not pay a
-# registry lookup per batch.
+# registry lookup per batch. Autotune probes (docs/autotune.md) run real
+# strategies through score_matrix and suppress these series for their
+# thread (suppress_scoring_metrics) so probe wall-clock never pollutes a
+# serving latency histogram.
 _SCORING_SECONDS = _telemetry_histogram(
     "isoforest_scoring_seconds",
     "Wall-clock seconds per score_matrix execution, by resolved strategy",
@@ -261,6 +265,27 @@ _SCORED_ROWS_TOTAL = _telemetry_counter(
     "Rows scored by score_matrix, by resolved strategy",
     labelnames=("strategy",),
 )
+
+_METRICS_LOCAL = threading.local()
+
+
+@contextlib.contextmanager
+def suppress_scoring_metrics():
+    """Suppress the per-strategy scoring histogram/counter for the calling
+    thread — used by autotune probes so timed probe executions never land
+    in the serving latency series (docs/autotune.md)."""
+    prev = getattr(_METRICS_LOCAL, "suppress", False)
+    _METRICS_LOCAL.suppress = True
+    try:
+        yield
+    finally:
+        _METRICS_LOCAL.suppress = prev
+
+
+def _scoring_metrics_on() -> bool:
+    return _telemetry_state.enabled() and not getattr(
+        _METRICS_LOCAL, "suppress", False
+    )
 
 # Forest -> minimum input width (1 + max referenced feature id), cached by
 # array identity: serving loops score small batches in a tight loop and the
@@ -365,8 +390,7 @@ def _score_native(forest, X, num_samples: int):
     return np.exp2(-pl / c).astype(np.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("num_samples", "strategy"))
-def _score_chunk(
+def _score_chunk_impl(
     forest, layout, X, num_samples: int, strategy: str = "dense"
 ) -> jax.Array:
     if strategy == "dense":
@@ -376,6 +400,50 @@ def _score_chunk(
     else:
         pl = path_lengths(forest, X, layout)
     return score_from_path_length(pl, num_samples)
+
+
+_score_chunk = jax.jit(
+    _score_chunk_impl, static_argnames=("num_samples", "strategy")
+)
+# Donating variant (ROADMAP item 3 / ISSUE 6 satellite): steady-state
+# serving scores a fresh chunk buffer per batch; donating it lets XLA
+# reuse the allocation for intermediates/outputs instead of growing the
+# arena per call. Selected only when score_matrix OWNS the buffer (it was
+# uploaded/padded here, never the caller's array — donation deletes the
+# input) and the backend honors donation (donation_supported).
+_score_chunk_donated = jax.jit(
+    _score_chunk_impl,
+    static_argnames=("num_samples", "strategy"),
+    donate_argnums=(2,),
+)
+
+
+def donation_supported(platform: str | None = None) -> bool:
+    """XLA honors input-buffer donation on TPU/GPU; XLA:CPU silently ignores
+    it and jax warns ('Some donated buffers were not usable'), so CPU keeps
+    the non-donating programs."""
+    if platform is None:
+        platform = _live_platform()
+    return platform in ("tpu", "gpu")
+
+
+def batch_bucket(n: int) -> int:
+    """Power-of-two padding bucket (min 1024) for a row count — ONE formula
+    shared by score_matrix padding, ``model.warmup`` and the autotuner's
+    batch keys (docs/autotune.md), so tuned decisions, warmed programs and
+    actual executions always land on the same compiled shapes."""
+    return max(1024, 1 << (max(int(n), 1) - 1).bit_length())
+
+
+def _pad_buckets_enabled(override: bool | None) -> bool:
+    if override is not None:
+        return bool(override)
+    return os.environ.get("ISOFOREST_TPU_PAD_BUCKETS", "1").strip().lower() not in (
+        "0",
+        "false",
+        "off",
+        "no",
+    )
 
 
 # Measured on a live v5e (2026-07-29, 524k rows x 100 trees, dense): bigger
@@ -400,14 +468,18 @@ def score_matrix(
     strict: bool = False,
     expected_features: int | None = None,
     timeout_s: float | None = None,
+    pad_to_bucket: bool | None = None,
 ) -> np.ndarray:
     """Score a full ``[N, F]`` matrix, chunked along rows.
 
     Chunking bounds the traversal state so big-N scoring streams through a
     fixed working set; ``chunk_size=None`` resolves the measured per-backend
-    default (:data:`PLATFORM_DEFAULT_CHUNK`). Row counts are always padded
-    up to a power-of-two bucket (min 1024) so varying batch sizes reuse a
-    handful of compiled programs instead of recompiling per distinct ``n``.
+    default (:data:`PLATFORM_DEFAULT_CHUNK`). Row counts are padded up to a
+    power-of-two bucket (min 1024, :func:`batch_bucket` — the same buckets
+    the autotuner keys on) so varying batch sizes reuse a handful of
+    compiled programs instead of recompiling per distinct ``n``;
+    ``pad_to_bucket=False`` (or ``ISOFOREST_TPU_PAD_BUCKETS=0``) opts out
+    and compiles per exact row count.
 
     ``strategy``:
       * ``"gather"`` — pointer-walk formulation, ``O(C * h)`` gathers.
@@ -425,14 +497,16 @@ def score_matrix(
         wider than 16 coordinates.
       * ``"native"`` — hand-scheduled C++ walker (:mod:`..native` scorer),
         the CPU fast path; no jax involvement at all.
-      * ``"auto"`` — ``ISOFOREST_TPU_STRATEGY`` env var if set, else the
-        per-backend, batch-regime-aware default (:func:`default_strategy`:
-        native C++ on CPU; on TPU, pallas for standard-forest batches up
-        to :data:`PALLAS_MAX_ROWS` and dense above — both crossovers
-        measured on a live v5e) — a fresh process on each backend picks
-        its measured/predicted winner with no env var and no bench run.
-        ``bench.py`` measures all strategies on the live backend and
-        reports the ranking.
+      * ``"auto"`` — resolved by the measured autotuner
+        (:mod:`~isoforest_tpu.tuning`, docs/autotune.md): an
+        ``ISOFOREST_TPU_STRATEGY`` pin always wins; else the persisted
+        cost-model table for this (backend, model-shape, batch-bucket) key;
+        a cold/stale key runs a short warmed probe of every eligible
+        strategy and persists the winner; with the tuner disabled
+        (``ISOFOREST_TPU_AUTOTUNE=0``) or a failed probe, the static
+        per-backend preference table (:func:`default_strategy`) stands.
+        Every resolution emits one ``autotune.decision`` telemetry event
+        with ``source ∈ {table, probe, pin, fallback}``.
 
     ``layout``: prebuilt finalized scoring layout
     (:func:`~isoforest_tpu.ops.scoring_layout.pack_forest`); ``None``
@@ -463,20 +537,16 @@ def score_matrix(
     _validate_width(forest, int(X.shape[1]), expected_features)
     extended = not isinstance(forest, StandardForest)
     if strategy == "auto":
-        strategy = os.environ.get("ISOFOREST_TPU_STRATEGY") or default_strategy(
-            num_rows=n, extended=extended
-        )
-        if strategy not in STRATEGIES:
-            strategy = degrade(
-                "env_strategy_unknown",
-                repr(strategy),
-                default_strategy(num_rows=n, extended=extended),
-                detail=(
-                    f"ISOFOREST_TPU_STRATEGY={strategy!r} is not one of "
-                    f"{'/'.join(STRATEGIES)}; using the per-backend default"
-                ),
-                strict=strict,
-            )
+        from ..tuning import resolve_decision
+
+        strategy = resolve_decision(
+            forest,
+            X,
+            num_samples,
+            platform=_live_platform(),
+            strict=strict,
+            layout=layout,
+        ).strategy
     if strategy not in STRATEGIES:
         raise ValueError(
             f"unknown scoring strategy {strategy!r}; expected one of "
@@ -549,7 +619,7 @@ def score_matrix(
     if strategy == "native":
         faults.check_strategy("native")
         timed_out = False
-        t0 = time.perf_counter() if _telemetry_state.enabled() else 0.0
+        t0 = time.perf_counter() if _scoring_metrics_on() else 0.0
         if timeout_s is None:
             out = _score_native(forest, X, num_samples)
         else:
@@ -571,7 +641,7 @@ def score_matrix(
                 timed_out = True
                 out = None
         if out is not None:
-            if _telemetry_state.enabled():
+            if _scoring_metrics_on():
                 _SCORING_SECONDS.observe(
                     time.perf_counter() - t0, strategy="native"
                 )
@@ -606,7 +676,7 @@ def score_matrix(
 
         interpret = _live_platform() != "tpu"
 
-        def run_chunk(chunk):
+        def run_chunk(chunk, owned=False):
             pl_len = path_lengths_pallas(forest, chunk, interpret=interpret)
             return score_from_path_length(pl_len, num_samples)
 
@@ -615,16 +685,21 @@ def score_matrix(
 
         interpret = _live_platform() != "tpu"
 
-        def run_chunk(chunk):
+        def run_chunk(chunk, owned=False):
             pl_len = path_lengths_walk(forest, chunk, interpret=interpret)
             return score_from_path_length(pl_len, num_samples)
 
     else:
         if layout is None:
             layout = get_layout(forest, num_features=int(X.shape[1]))
+        donate_ok = donation_supported()
 
-        def run_chunk(chunk):
-            return _score_chunk(forest, layout, chunk, num_samples, strategy)
+        def run_chunk(chunk, owned=False):
+            # donate the chunk buffer back to XLA whenever WE materialised
+            # it (upload/pad/slice) — steady-state serving then reuses the
+            # allocation instead of growing the device arena per batch
+            fn = _score_chunk_donated if (owned and donate_ok) else _score_chunk
+            return fn(forest, layout, chunk, num_samples, strategy)
 
     if chunk_size is None:
         chunk_size = _default_chunk_size()
@@ -637,11 +712,13 @@ def score_matrix(
         faults.maybe_slow_collective(strategy)
         if n <= chunk_size:
             Xc = jnp.asarray(X, jnp.float32)
-            bucket = max(1024, 1 << int(np.ceil(np.log2(n))))
+            owned = Xc is not X
+            bucket = batch_bucket(n) if _pad_buckets_enabled(pad_to_bucket) else n
             pad = bucket - n
             if pad:
                 Xc = jnp.pad(Xc, ((0, pad), (0, 0)))
-            return np.asarray(run_chunk(Xc)[:n])
+                owned = True
+            return np.asarray(run_chunk(Xc, owned)[:n])
 
         # Multi-chunk: (a) host-resident inputs are uploaded PER CHUNK inside
         # the loop — async dispatch overlaps chunk k+1's host->device transfer
@@ -660,12 +737,14 @@ def score_matrix(
             pad = chunk_size - chunk.shape[0]
             if pad:
                 chunk = jnp.pad(chunk, ((0, pad), (0, 0)))
-            scores = run_chunk(chunk)
+            # every multi-chunk buffer is a fresh slice/upload/pad — safe
+            # to donate on backends that honor it
+            scores = run_chunk(chunk, True)
             outs.append(scores[: chunk_size - pad] if pad else scores)
         return np.concatenate([np.asarray(o) for o in outs])
 
     def _execute_timed() -> np.ndarray:
-        if not _telemetry_state.enabled():
+        if not _scoring_metrics_on():
             return _execute()
         t0 = time.perf_counter()
         out = _execute()
@@ -710,4 +789,5 @@ def score_matrix(
             strict=strict,
             expected_features=expected_features,
             timeout_s=timeout_s,
+            pad_to_bucket=pad_to_bucket,
         )

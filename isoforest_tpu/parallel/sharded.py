@@ -17,14 +17,21 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..ops.ext_growth import ExtendedForest, grow_extended_forest
-from ..ops.traversal import path_lengths
+from ..ops.traversal import donation_supported, path_lengths
 from ..ops.tree_growth import StandardForest, grow_forest
 from ..resilience.degradation import degrade
 from ..utils.math import score_from_path_length
 from .mesh import DATA_AXIS, TREES_AXIS, shard_map_compat
 
 
-def resolve_jittable_strategy(mesh, score_strategy: str = "auto"):
+def resolve_jittable_strategy(
+    mesh,
+    score_strategy: str = "auto",
+    forest=None,
+    X=None,
+    num_samples: int | None = None,
+    num_rows: int | None = None,
+):
     """Resolve the path-length formulation used INSIDE shard_map programs;
     returns ``(name, path_lengths_fn)``.
 
@@ -35,34 +42,59 @@ def resolve_jittable_strategy(mesh, score_strategy: str = "auto"):
     rows, benchmarks/README.md). ``"auto"`` honors an eligible
     ``ISOFOREST_TPU_STRATEGY`` pin — an INELIGIBLE pin is warned about once
     and ignored, so a pinned measurement is never silently mislabeled —
-    else resolves from the MESH's platform (a host-CPU mesh on a TPU VM
-    keeps the CPU winner). Shared by :func:`sharded_score`,
+    else consults the measured autotuner RESTRICTED to the jittable pair
+    (:mod:`~isoforest_tpu.tuning`, docs/autotune.md) when the caller passes
+    ``forest``/``X``/``num_samples`` (``num_rows`` keys the batch bucket on
+    the per-device row count the shard_map body actually scores); without
+    shape information (the fused train step builds its program before data
+    exists) the mesh-platform static default stands, emitted as a
+    ``fallback`` decision. Shared by :func:`sharded_score`,
     :func:`sharded_score_2d` and
     :func:`~isoforest_tpu.parallel.train_step.make_train_step`.
     """
     import os
 
     if score_strategy == "auto":
-        pinned = os.environ.get("ISOFOREST_TPU_STRATEGY")
-        if pinned in ("gather", "dense"):
-            score_strategy = pinned
+        platform = next(iter(mesh.devices.flat)).platform
+        static = "dense" if platform == "tpu" else "gather"
+        from ..tuning import JITTABLE_STRATEGIES, emit_decision, resolve_decision, unkeyed
+
+        if forest is not None and X is not None and num_samples is not None:
+            score_strategy = resolve_decision(
+                forest,
+                X,
+                num_samples,
+                platform=platform,
+                restrict=JITTABLE_STRATEGIES,
+                static_default=static,
+                num_rows=num_rows,
+                site="sharded",
+                pin_rung="shard_pin_ineligible",
+            ).strategy
         else:
-            platform = next(iter(mesh.devices.flat)).platform
-            default = "dense" if platform == "tpu" else "gather"
-            if pinned:
-                # ineligible pin: warned once + recorded through the ladder,
-                # so a pinned measurement is never silently mislabeled
-                degrade(
-                    "shard_pin_ineligible",
-                    repr(pinned),
-                    default,
-                    detail=(
-                        f"ISOFOREST_TPU_STRATEGY={pinned!r} is not eligible "
-                        "inside shard_map programs (gather/dense only); "
-                        "sharded scoring resolves its own per-platform default"
-                    ),
+            pinned = os.environ.get("ISOFOREST_TPU_STRATEGY") or None
+            if pinned in JITTABLE_STRATEGIES:
+                score_strategy = pinned
+                emit_decision(pinned, "pin", unkeyed(platform, "sharded"), "sharded")
+            else:
+                if pinned:
+                    # ineligible pin: warned once + recorded through the
+                    # ladder, so a pinned measurement is never silently
+                    # mislabeled
+                    degrade(
+                        "shard_pin_ineligible",
+                        repr(pinned),
+                        static,
+                        detail=(
+                            f"ISOFOREST_TPU_STRATEGY={pinned!r} is not eligible "
+                            "inside shard_map programs (gather/dense only); "
+                            "sharded scoring resolves its own per-platform default"
+                        ),
+                    )
+                score_strategy = static
+                emit_decision(
+                    static, "fallback", unkeyed(platform, "sharded"), "sharded"
                 )
-            score_strategy = default
     if score_strategy not in ("gather", "dense"):
         raise ValueError(
             f"score_strategy must be 'auto', 'gather' or 'dense' (jittable "
@@ -185,7 +217,12 @@ def _pad_trees_neutral(forest, multiple: int):
 
 @functools.lru_cache(maxsize=64)
 def _score_2d_program(
-    mesh, is_standard: bool, num_samples: int, num_trees: int, strategy: str
+    mesh,
+    is_standard: bool,
+    num_samples: int,
+    num_trees: int,
+    strategy: str,
+    donate: bool = False,
 ):
     forest_cls = StandardForest if is_standard else ExtendedForest
     n_fields = len(forest_cls._fields)
@@ -211,11 +248,16 @@ def _score_2d_program(
             in_specs=(forest_spec, P(DATA_AXIS, None)),
             out_specs=P(DATA_AXIS),
             check_vma=False,
-        )
+        ),
+        # donated input rows (ROADMAP item 3): steady-state repeated scoring
+        # reuses the batch allocation instead of growing the arena per call
+        donate_argnums=(1,) if donate else (),
     )
 
 
-def sharded_score_2d(mesh, forest, X, num_samples: int) -> np.ndarray:
+def sharded_score_2d(
+    mesh, forest, X, num_samples: int, score_strategy: str = "auto"
+) -> np.ndarray:
     """2-D (tree x row) sharded scoring (VERDICT r2 item 8).
 
     The forest STAYS sharded over the ``trees`` axis — no all-gather, and
@@ -227,23 +269,37 @@ def sharded_score_2d(mesh, forest, X, num_samples: int) -> np.ndarray:
     path up to float summation order (the psum adds per-shard partial sums
     instead of one long mean).
     """
+    X0 = X
     X = jnp.asarray(X, jnp.float32)
     n = X.shape[0]
     Xp, _ = _pad_axis(X, 0, mesh.shape[DATA_AXIS])
     forest_p, _ = _pad_trees_neutral(forest, mesh.shape[TREES_AXIS])
-    strategy, _ = resolve_jittable_strategy(mesh)
+    strategy, _ = resolve_jittable_strategy(
+        mesh,
+        score_strategy,
+        forest=forest,
+        X=X0,
+        num_samples=num_samples,
+        num_rows=Xp.shape[0] // mesh.shape[DATA_AXIS],
+    )
+    donate = Xp is not X0 and donation_supported(
+        next(iter(mesh.devices.flat)).platform
+    )
     f = _score_2d_program(
         mesh,
         isinstance(forest, StandardForest),
         num_samples,
         forest.num_trees,
         strategy,
+        donate,
     )
     return np.asarray(f(forest_p, Xp)[:n])
 
 
 @functools.lru_cache(maxsize=64)
-def _score_replicated_program(mesh, is_standard: bool, num_samples: int, strategy: str):
+def _score_replicated_program(
+    mesh, is_standard: bool, num_samples: int, strategy: str, donate: bool = False
+):
     forest_cls = StandardForest if is_standard else ExtendedForest
     forest_spec = forest_cls(*([P()] * len(forest_cls._fields)))
     pl_fn = _path_lengths_fn(strategy)
@@ -258,22 +314,41 @@ def _score_replicated_program(mesh, is_standard: bool, num_samples: int, strateg
             in_specs=(forest_spec, P((DATA_AXIS, TREES_AXIS), None)),
             out_specs=P((DATA_AXIS, TREES_AXIS)),
             check_vma=False,
-        )
+        ),
+        # donated input rows (ROADMAP item 3): selected only when the
+        # caller's buffer was re-materialised here (upload or pad), so a
+        # user-held jax array is never invalidated, and only on backends
+        # that honor donation (XLA:CPU ignores it with a warning)
+        donate_argnums=(1,) if donate else (),
     )
 
 
-def sharded_score(mesh, forest, X, num_samples: int) -> np.ndarray:
+def sharded_score(
+    mesh, forest, X, num_samples: int, score_strategy: str = "auto"
+) -> np.ndarray:
     """Row-parallel scoring: rows sharded over *all* mesh devices, forest
     replicated (the broadcast analogue). Returns host scores ``f32[N]``."""
     n_devices = mesh.shape[DATA_AXIS] * mesh.shape[TREES_AXIS]
+    X0 = X
     X = jnp.asarray(X, jnp.float32)
     n = X.shape[0]
     Xp, _ = _pad_axis(X, 0, n_devices)
-    strategy, _ = resolve_jittable_strategy(mesh)
+    strategy, _ = resolve_jittable_strategy(
+        mesh,
+        score_strategy,
+        forest=forest,
+        X=X0,
+        num_samples=num_samples,
+        num_rows=Xp.shape[0] // n_devices,
+    )
+    donate = Xp is not X0 and donation_supported(
+        next(iter(mesh.devices.flat)).platform
+    )
     f = _score_replicated_program(
         mesh,
         isinstance(forest, StandardForest),
         num_samples,
         strategy,
+        donate,
     )
     return np.asarray(f(forest, Xp)[:n])

@@ -68,6 +68,15 @@ LADDER: Dict[str, str] = {
         "scores are the default strategy's, within cross-strategy f32 "
         "tolerance of any valid pin"
     ),
+    # autotuner rung (tuning/autotuner.py, docs/autotune.md)
+    "autotune_probe_failed": (
+        "strategy='auto' probe produced no measurement over the eligible "
+        "strategies -> static per-backend preference table: the fallback is "
+        "a fully supported strategy (scores within cross-strategy f32 "
+        "tolerance of any tuned pick), so — like drift_alert — this rung is "
+        "deliberately strict-exempt; the decision is mirrored as an "
+        "autotune.decision event with source='fallback'"
+    ),
     # shard_map rung (parallel/sharded.py)
     "shard_pin_ineligible": (
         "ineligible ISOFOREST_TPU_STRATEGY pin inside shard_map -> "

@@ -1,0 +1,57 @@
+"""Measured strategy autotuning: probe once per regime, persist the winner.
+
+``strategy="auto"`` resolutions consult a persisted cost model keyed on
+``(backend, model-shape-bucket, batch-size-bucket, extended?)``; cold keys
+run a short warmed best-of-k probe of every eligible strategy and the
+winner table is cached as schema-versioned JSON with a TTL, FastForest-style
+(PAPERS.md, arxiv 2004.02423). See docs/autotune.md and
+:mod:`.autotuner` / :mod:`.cost_model`.
+"""
+
+from .autotuner import (
+    DECISION_SOURCES,
+    JITTABLE_STRATEGIES,
+    Decision,
+    autotune_enabled,
+    clear_table,
+    decision_counts,
+    decision_key,
+    eligible_strategies,
+    emit_decision,
+    model_bucket,
+    resolve_decision,
+    table_snapshot,
+    unkeyed,
+)
+from .cost_model import (
+    DEFAULT_TTL_S,
+    SCHEMA_VERSION,
+    CostModel,
+    cost_model,
+    reset_cost_model,
+    table_path,
+    ttl_s,
+)
+
+__all__ = [
+    "DECISION_SOURCES",
+    "DEFAULT_TTL_S",
+    "JITTABLE_STRATEGIES",
+    "SCHEMA_VERSION",
+    "CostModel",
+    "Decision",
+    "autotune_enabled",
+    "clear_table",
+    "cost_model",
+    "decision_counts",
+    "decision_key",
+    "eligible_strategies",
+    "emit_decision",
+    "model_bucket",
+    "reset_cost_model",
+    "resolve_decision",
+    "table_path",
+    "table_snapshot",
+    "ttl_s",
+    "unkeyed",
+]

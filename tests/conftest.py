@@ -9,8 +9,24 @@ CPU devices for mesh/sharding tests), so re-pin the config to cpu here.
 
 import os
 import pathlib
+import tempfile
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Strategy autotuner (docs/autotune.md): cold probes time every eligible
+# strategy per (shape, batch) key — a production cold-start cost that,
+# repeated across the suite's hundreds of distinct model shapes, would
+# dominate tier-1 runtime. Tests run with the tuner bypassed (auto resolves
+# the static preference table exactly as before ISSUE 6, emitted as
+# source="fallback" decisions) and the winner table pointed at a throwaway
+# path so a developer's real /tmp table is never read or clobbered.
+# tests/test_autotune.py re-enables the tuner per test via monkeypatch.
+os.environ.setdefault("ISOFOREST_TPU_AUTOTUNE", "0")
+os.environ.setdefault(
+    "ISOFOREST_TPU_AUTOTUNE_PATH",
+    os.path.join(
+        tempfile.mkdtemp(prefix="isoforest-autotune-test-"), "table.json"
+    ),
+)
 # The suite's kernel-equivalence tests deliberately run the Pallas kernels
 # in interpret mode on this CPU host; production score_matrix would instead
 # fall back walk->gather off-TPU (with a one-shot warning). The fallback

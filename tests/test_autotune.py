@@ -1,0 +1,445 @@
+"""Measured strategy autotuner + persistent cost model (ISSUE 6).
+
+Proof obligations (docs/autotune.md):
+  * decision keys split at exactly the documented bucket edges (batch
+    power-of-two buckets, the i8/i16 feature-id boundaries);
+  * autotuning NEVER changes scores — strategy="auto" is bitwise-identical
+    to the explicitly named winning strategy (std + extended), under every
+    decision source including probe failure;
+  * TTL expiry and forced refresh re-probe; corrupt/old-schema table files
+    are refused with a clean rebuild; an env pin beats the table;
+  * every auto resolution emits exactly one autotune.decision event and
+    one isoforest_autotune_decisions_total{source=} tick;
+  * the autotune CLI round-trips the persisted table;
+  * donated chunk buffers score identically, are only selected when the
+    backend honors donation, and (where supported) are actually released.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import isoforest_tpu.tuning as tuning
+import isoforest_tpu.tuning.autotuner as autotuner
+from isoforest_tpu import ExtendedIsolationForest, IsolationForest, telemetry
+from isoforest_tpu.ops.traversal import batch_bucket, donation_supported, score_matrix
+from isoforest_tpu.resilience import reset_degradations
+from isoforest_tpu.resilience.degradation import degradation_report
+
+
+@pytest.fixture(scope="module")
+def models():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(700, 5)).astype(np.float32)
+    X[:20] += 3.5
+    std = IsolationForest(
+        num_estimators=12, max_samples=64.0, random_seed=7
+    ).fit(X)
+    ext = ExtendedIsolationForest(
+        num_estimators=12, max_samples=64.0, random_seed=7, extension_level=1
+    ).fit(X)
+    return X, std, ext
+
+
+@pytest.fixture
+def autotune(tmp_path, monkeypatch):
+    """Enable the tuner against an isolated table with cheap probes."""
+    path = tmp_path / "table.json"
+    monkeypatch.setenv("ISOFOREST_TPU_AUTOTUNE", "1")
+    monkeypatch.setenv("ISOFOREST_TPU_AUTOTUNE_PATH", str(path))
+    monkeypatch.setenv("ISOFOREST_TPU_AUTOTUNE_REPS", "1")
+    monkeypatch.setenv("ISOFOREST_TPU_AUTOTUNE_PROBE_ROWS", "512")
+    monkeypatch.delenv("ISOFOREST_TPU_STRATEGY", raising=False)
+    tuning.reset_cost_model()
+    yield path
+    tuning.reset_cost_model()
+
+
+def _decision_events():
+    return [e for e in telemetry.get_events() if e.kind == "autotune.decision"]
+
+
+class TestKeys:
+    def test_batch_bucket_edges(self):
+        assert batch_bucket(1) == 1024
+        assert batch_bucket(1024) == 1024
+        assert batch_bucket(1025) == 2048
+        assert batch_bucket(2048) == 2048
+        assert batch_bucket(2048 + 1) == 4096
+
+    def test_batch_bucket_keys_split_at_pow2(self, models):
+        X, std, _ = models
+        k = lambda n: tuning.decision_key("cpu", std.forest, n, 5)  # noqa: E731
+        assert k(1) == k(1024)  # min bucket
+        assert k(2048) != k(2049)
+        assert "b2048" in k(2048) and "b4096" in k(2049)
+
+    def test_feature_dtype_boundary_keys(self, models):
+        # the i8/i16 feature-id narrowing boundaries of the packed layout
+        # (F <= 128 / F <= 32768) must split keys: the gathered bytes per
+        # traversal step change exactly there
+        _, std, _ = models
+        k = lambda f: tuning.decision_key("cpu", std.forest, 1024, f)  # noqa: E731
+        assert "i8" in k(128) and "i16" in k(129)
+        assert k(128) != k(129)
+        assert "i16" in k(32768) and "i32" in k(32769)
+        assert k(32768) != k(32769)
+
+    def test_extended_and_restricted_key_separation(self, models):
+        _, std, ext = models
+        k_std = tuning.decision_key("cpu", std.forest, 1024, 5)
+        k_ext = tuning.decision_key("cpu", ext.forest, 1024, 5)
+        assert k_std.endswith("|std") and k_ext.endswith("|ext")
+        k_jit = tuning.decision_key(
+            "cpu", std.forest, 1024, 5, restrict=tuning.JITTABLE_STRATEGIES
+        )
+        assert k_jit == k_std + "|jittable"
+
+
+class TestEligibility:
+    def test_off_tpu_excludes_interpret_kernels(self, models):
+        _, std, ext = models
+        elig = tuning.eligible_strategies(std.forest, "cpu")
+        assert "pallas" not in elig and "walk" not in elig
+        assert "gather" in elig and "dense" in elig
+        # extended on TPU: the EIF pallas precision fence applies up front
+        elig_tpu_ext = tuning.eligible_strategies(ext.forest, "tpu")
+        assert "pallas" not in elig_tpu_ext
+
+    def test_native_gated_on_availability(self, models, monkeypatch):
+        import isoforest_tpu.native as native
+
+        _, std, _ = models
+        monkeypatch.setattr(native, "available", lambda: False)
+        assert "native" not in tuning.eligible_strategies(std.forest, "cpu")
+
+    def test_restrict_narrows_pool(self, models):
+        _, std, _ = models
+        elig = tuning.eligible_strategies(
+            std.forest, "cpu", restrict=tuning.JITTABLE_STRATEGIES
+        )
+        assert set(elig) <= {"gather", "dense"}
+
+
+class TestResolutionAndParity:
+    def test_probe_then_table_and_bitwise_parity(self, models, autotune):
+        X, std, ext = models
+        for model in (std, ext):
+            d1 = tuning.resolve_decision(model.forest, X, model.num_samples)
+            assert d1.source == "probe"
+            d2 = tuning.resolve_decision(model.forest, X, model.num_samples)
+            assert d2.source == "table" and d2.strategy == d1.strategy
+            # acceptance: autotuning never changes scores — bitwise parity
+            # between auto (tuned) and the explicitly named winner
+            s_auto = score_matrix(
+                model.forest, X, model.num_samples, strategy="auto"
+            )
+            s_win = score_matrix(
+                model.forest, X, model.num_samples, strategy=d1.strategy
+            )
+            np.testing.assert_array_equal(s_auto, s_win)
+
+    def test_table_persisted_and_valid(self, models, autotune):
+        X, std, _ = models
+        d = tuning.resolve_decision(std.forest, X, std.num_samples)
+        doc = json.loads(autotune.read_text())
+        assert doc["schema"] == tuning.SCHEMA_VERSION
+        assert doc["entries"][d.key]["strategy"] == d.strategy
+        assert d.strategy in doc["entries"][d.key]["timings_s"]
+
+    def test_ttl_expiry_reprobes(self, models, autotune):
+        X, std, _ = models
+        d1 = tuning.resolve_decision(std.forest, X, std.num_samples)
+        # age the persisted entry past the TTL on disk, then reload
+        doc = json.loads(autotune.read_text())
+        doc["entries"][d1.key]["unix_s"] -= tuning.ttl_s() + 10
+        autotune.write_text(json.dumps(doc))
+        tuning.reset_cost_model()
+        d2 = tuning.resolve_decision(std.forest, X, std.num_samples)
+        assert d2.source == "probe" and d2.refresh  # stale-table refresh
+        ev = _decision_events()[-1]
+        assert ev.fields["source"] == "probe" and ev.fields.get("refresh") is True
+
+    def test_forced_refresh_reprobes(self, models, autotune):
+        X, std, _ = models
+        tuning.resolve_decision(std.forest, X, std.num_samples)
+        d = tuning.resolve_decision(std.forest, X, std.num_samples, refresh=True)
+        assert d.source == "probe" and d.refresh
+
+    def test_pin_beats_table(self, models, autotune, monkeypatch):
+        X, std, _ = models
+        d0 = tuning.resolve_decision(std.forest, X, std.num_samples)
+        assert d0.source == "probe"
+        monkeypatch.setenv("ISOFOREST_TPU_STRATEGY", "dense")
+        d = tuning.resolve_decision(std.forest, X, std.num_samples)
+        assert (d.strategy, d.source) == ("dense", "pin")
+        s_auto = score_matrix(std.forest, X, std.num_samples, strategy="auto")
+        s_pin = score_matrix(std.forest, X, std.num_samples, strategy="dense")
+        np.testing.assert_array_equal(s_auto, s_pin)
+
+    def test_disabled_resolves_static_default(self, models, autotune, monkeypatch):
+        from isoforest_tpu.ops.traversal import default_strategy
+
+        X, std, _ = models
+        monkeypatch.setenv("ISOFOREST_TPU_AUTOTUNE", "0")
+        d = tuning.resolve_decision(std.forest, X, std.num_samples)
+        assert d.source == "fallback"
+        assert d.strategy == default_strategy(num_rows=len(X), extended=False)
+        assert not autotune.exists()  # no probe ran, nothing persisted
+
+    def test_probe_failure_takes_rung_with_score_parity(
+        self, models, autotune, monkeypatch
+    ):
+        from isoforest_tpu.ops.traversal import default_strategy
+
+        X, std, _ = models
+        reset_degradations("autotune_probe_failed")
+        monkeypatch.setattr(autotuner, "_probe", lambda *a, **k: {})
+        d = tuning.resolve_decision(std.forest, X, std.num_samples)
+        static = default_strategy(num_rows=len(X), extended=False)
+        assert (d.strategy, d.source) == (static, "fallback")
+        assert degradation_report().count("autotune_probe_failed") == 1
+        # rung parity: scores bitwise-unchanged by the autotune outcome
+        s_auto = score_matrix(std.forest, X, std.num_samples, strategy="auto")
+        s_static = score_matrix(std.forest, X, std.num_samples, strategy=static)
+        np.testing.assert_array_equal(s_auto, s_static)
+        reset_degradations("autotune_probe_failed")
+
+    def test_probe_failure_rung_is_strict_exempt(
+        self, models, autotune, monkeypatch
+    ):
+        # like drift_alert: the fallback is a fully supported strategy, so
+        # strict scoring must not raise on this rung
+        X, std, _ = models
+        monkeypatch.setattr(autotuner, "_probe", lambda *a, **k: {})
+        scores = score_matrix(
+            std.forest, X, std.num_samples, strategy="auto", strict=True
+        )
+        assert scores.shape == (len(X),)
+        reset_degradations("autotune_probe_failed")
+
+
+class TestCorruptTable:
+    @pytest.mark.parametrize(
+        "payload",
+        [
+            "{not json",
+            json.dumps({"schema": 0, "entries": {}}),  # old schema
+            json.dumps([1, 2, 3]),  # non-dict document
+            json.dumps({"schema": 1}),  # no entries mapping
+        ],
+    )
+    def test_refused_with_clean_rebuild(self, models, autotune, payload):
+        X, std, _ = models
+        autotune.write_text(payload)
+        tuning.reset_cost_model()
+        d = tuning.resolve_decision(std.forest, X, std.num_samples)
+        assert d.source == "probe"  # bad table read as empty, never trusted
+        doc = json.loads(autotune.read_text())  # rebuilt valid
+        assert doc["schema"] == tuning.SCHEMA_VERSION
+        assert doc["entries"][d.key]["strategy"] == d.strategy
+
+    def test_invalid_entries_dropped(self, models, autotune):
+        X, std, _ = models
+        key = tuning.decision_key("cpu", std.forest, len(X), 5)
+        autotune.write_text(
+            json.dumps(
+                {"schema": 1, "entries": {key: {"strategy": 123}}}
+            )
+        )
+        tuning.reset_cost_model()
+        entry, _ = tuning.cost_model().lookup(key)
+        assert entry is None
+
+
+class TestDecisionTelemetry:
+    def test_exactly_one_event_and_tick_per_resolution(self, models, autotune):
+        X, std, _ = models
+        before_ev = len(_decision_events())
+        before = tuning.decision_counts()
+        score_matrix(std.forest, X, std.num_samples, strategy="auto")  # probe
+        score_matrix(std.forest, X, std.num_samples, strategy="auto")  # table
+        events = _decision_events()[before_ev:]
+        assert [e.fields["source"] for e in events] == ["probe", "table"]
+        assert all(
+            e.fields["source"] in tuning.DECISION_SOURCES
+            and e.fields["site"] == "score_matrix"
+            for e in events
+        )
+        after = tuning.decision_counts()
+        assert after["probe"] - before["probe"] == 1
+        assert after["table"] - before["table"] == 1
+
+    def test_explicit_strategy_emits_no_decision(self, models, autotune):
+        X, std, _ = models
+        before = len(_decision_events())
+        score_matrix(std.forest, X, std.num_samples, strategy="gather")
+        assert len(_decision_events()) == before
+
+    def test_probe_timings_suppressed_from_scoring_series(
+        self, models, autotune
+    ):
+        from isoforest_tpu.ops.traversal import _SCORED_ROWS_TOTAL
+
+        X, std, _ = models
+        probed = tuning.eligible_strategies(std.forest, "cpu")
+        before = {s: _SCORED_ROWS_TOTAL.value(strategy=s) for s in probed}
+        d = tuning.resolve_decision(std.forest, X, std.num_samples)
+        after = {s: _SCORED_ROWS_TOTAL.value(strategy=s) for s in probed}
+        assert d.source == "probe"
+        assert after == before  # probe executions never count as servings
+
+
+class TestShardedResolution:
+    def test_sharded_site_restricted_and_emitting(self, models, autotune):
+        from isoforest_tpu.parallel.mesh import create_mesh
+        from isoforest_tpu.parallel.sharded import resolve_jittable_strategy
+
+        X, std, _ = models
+        mesh = create_mesh()
+        before = len(_decision_events())
+        name, fn = resolve_jittable_strategy(
+            mesh, "auto", forest=std.forest, X=X, num_samples=std.num_samples,
+            num_rows=len(X),
+        )
+        assert name in tuning.JITTABLE_STRATEGIES
+        events = _decision_events()[before:]
+        assert len(events) == 1 and events[0].fields["site"] == "sharded"
+        assert events[0].fields["key"].endswith("|jittable")
+
+    def test_trainstep_site_without_shape_falls_back(self, autotune):
+        from isoforest_tpu.parallel.mesh import create_mesh
+        from isoforest_tpu.parallel.sharded import resolve_jittable_strategy
+
+        mesh = create_mesh()
+        before = len(_decision_events())
+        name, _ = resolve_jittable_strategy(mesh)
+        assert name == "gather"  # CPU mesh static default
+        events = _decision_events()[before:]
+        assert len(events) == 1 and events[0].fields["source"] == "fallback"
+
+
+class TestCLI:
+    def test_json_round_trips_persisted_table(
+        self, models, autotune, capsys
+    ):
+        from isoforest_tpu.__main__ import main
+
+        X, std, _ = models
+        tuning.resolve_decision(std.forest, X, std.num_samples)
+        assert main(["autotune", "--format", "json"]) == 0
+        printed = json.loads(capsys.readouterr().out)
+        on_disk = json.loads(autotune.read_text())
+        assert printed["entries"] == on_disk["entries"]
+        assert printed["schema"] == on_disk["schema"]
+
+    def test_warm_then_clear(self, autotune, capsys, monkeypatch):
+        from isoforest_tpu.__main__ import main
+
+        rc = main(
+            [
+                "autotune",
+                "--warm",
+                "--trees",
+                "5",
+                "--batch-sizes",
+                "1024",
+                "--format",
+                "table",
+            ]
+        )
+        assert rc == 0
+        assert autotune.exists()
+        out = capsys.readouterr().out
+        assert "->" in out  # human table lists the warmed entry
+        assert main(["autotune", "--clear"]) == 0
+        assert not autotune.exists()
+        cleared = json.loads(capsys.readouterr().out)
+        assert cleared["existed"] is True
+
+
+class TestPadBuckets:
+    def test_opt_out_matches_default_scores(self, models, monkeypatch):
+        X, std, _ = models
+        base = score_matrix(std.forest, X, std.num_samples, strategy="gather")
+        unpadded = score_matrix(
+            std.forest, X, std.num_samples, strategy="gather", pad_to_bucket=False
+        )
+        np.testing.assert_allclose(unpadded, base, atol=3e-6)
+        monkeypatch.setenv("ISOFOREST_TPU_PAD_BUCKETS", "0")
+        via_env = score_matrix(std.forest, X, std.num_samples, strategy="gather")
+        np.testing.assert_array_equal(via_env, unpadded)
+
+
+class TestDonation:
+    def test_donating_chunk_program_parity(self, models):
+        """The donating jit variant scores identically; where the backend
+        honors donation the input buffer is actually released (no-realloc:
+        the allocation is returned to XLA for reuse)."""
+        import warnings
+
+        import jax.numpy as jnp
+
+        import isoforest_tpu.ops.traversal as tv
+        from isoforest_tpu.ops.scoring_layout import get_layout
+
+        X, std, _ = models
+        layout = get_layout(std.forest, num_features=5)
+        Xn = np.resize(X, (1024, 5)).astype(np.float32)
+        base = np.asarray(
+            tv._score_chunk(
+                std.forest, layout, jnp.asarray(Xn), std.num_samples, "gather"
+            )
+        )
+        Xd = jnp.asarray(Xn)
+        with warnings.catch_warnings():
+            # XLA:CPU ignores donation with a UserWarning; the program must
+            # still produce identical scores
+            warnings.simplefilter("ignore")
+            out = np.asarray(
+                tv._score_chunk_donated(
+                    std.forest, layout, Xd, std.num_samples, "gather"
+                )
+            )
+        np.testing.assert_array_equal(out, base)
+        if tv.donation_supported():
+            assert Xd.is_deleted()
+
+    def test_donation_never_selected_on_unsupporting_backend(self, models):
+        # score_matrix with a caller-held jax array must leave it intact
+        import jax.numpy as jnp
+
+        import isoforest_tpu.ops.traversal as tv
+
+        X, std, _ = models
+        Xd = jnp.asarray(X, jnp.float32)
+        score_matrix(std.forest, Xd, std.num_samples, strategy="gather")
+        assert not Xd.is_deleted()
+        assert tv.donation_supported("cpu") is False
+        assert tv.donation_supported("tpu") is True
+
+    @pytest.mark.skipif(
+        not donation_supported(),
+        reason="buffer-id reuse check needs a donation-capable backend (TPU/GPU)",
+    )
+    def test_steady_state_no_realloc(self, models):
+        """On TPU/GPU: repeated donated uploads reuse the freed allocation
+        (bounded distinct buffer ids across iterations)."""
+        import jax.numpy as jnp
+
+        import isoforest_tpu.ops.traversal as tv
+        from isoforest_tpu.ops.scoring_layout import get_layout
+
+        X, std, _ = models
+        layout = get_layout(std.forest, num_features=5)
+        Xn = np.resize(X, (1024, 5)).astype(np.float32)
+        ptrs = set()
+        for _ in range(8):
+            Xd = jnp.asarray(Xn)
+            ptrs.add(Xd.unsafe_buffer_pointer())
+            tv._score_chunk_donated(
+                std.forest, layout, Xd, std.num_samples, "gather"
+            ).block_until_ready()
+            assert Xd.is_deleted()
+        assert len(ptrs) <= 2  # steady state reuses the donated block

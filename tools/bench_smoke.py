@@ -20,6 +20,13 @@ Third gate (docs/observability.md §8): MONITOR-enabled ``model.score``
 shape) must stay within :data:`MONITOR_MARGIN` (3%) of monitor-off scoring
 — same best-of-5 protocol as the telemetry gate, ISSUE 5 acceptance.
 
+Fourth gate (docs/autotune.md, ISSUE 6 acceptance): with the cost-model
+table warm, autotuned ``strategy="auto"`` must be at least
+:data:`AUTOTUNE_MIN_RATIO` (0.95x) as fast as the static-default pick on
+the same smoke workload, AND the tuner must select the measured r05 winner
+(``native``) for the CPU 1M-row regime — probed against an isolated table
+so a developer's real /tmp table is never touched.
+
 Timing asserts in shared CI runners are noisy, so both gates are best-of-N
 against a margin, not an exact comparison; the JSON line it prints records
 every timing for trend tracking.
@@ -54,6 +61,14 @@ TELEMETRY_MARGIN = 1.03
 # monitor-off (ISSUE 5 acceptance); same best-of-5 protocol
 MONITOR_REPS = 5
 MONITOR_MARGIN = 1.03
+
+# autotune gate: warm-table strategy="auto" must reach >= 0.95x the speed
+# of the static-default pick (ISSUE 6 acceptance — the resolve path adds a
+# key build + dict hit + one telemetry event per call, which must stay
+# inside 5% even on the ~100 ms smoke workload)
+AUTOTUNE_REPS = 5
+AUTOTUNE_MIN_RATIO = 0.95
+AUTOTUNE_REGIME_ROWS = 1 << 20
 
 
 def _unpacked_baseline():
@@ -174,6 +189,54 @@ def main() -> int:
     monitor_overhead = t_mon_on / t_mon_off - 1.0
     ok_monitor = t_mon_on <= t_mon_off * MONITOR_MARGIN
 
+    # autotune gate (docs/autotune.md): measured auto vs the static pick on
+    # the same workload, against an ISOLATED table file (never the
+    # operator's real one); the first auto call pays the cold probe, then
+    # both sides time warm best-of-5. Second half: the tuner must resolve
+    # the measured r05 winner for the CPU 1M-row regime (native — skipped
+    # with a note when no C++ toolchain is available: an absent strategy
+    # cannot be selected, and eligibility fences it out up front).
+    import tempfile
+
+    from isoforest_tpu import native, tuning
+    from isoforest_tpu.ops.traversal import default_strategy
+
+    autotune_dir = tempfile.mkdtemp(prefix="isoforest-autotune-smoke-")
+    os.environ["ISOFOREST_TPU_AUTOTUNE"] = "1"
+    os.environ["ISOFOREST_TPU_AUTOTUNE_PATH"] = f"{autotune_dir}/table.json"
+    tuning.reset_cost_model()
+    try:
+        static_pick = default_strategy(num_rows=ROWS, extended=False)
+
+        def run_static():
+            return score_matrix(forest, X, model.num_samples, strategy=static_pick)
+
+        def run_auto():
+            return score_matrix(forest, X, model.num_samples, strategy="auto")
+
+        run_static()  # warm the static program
+        run_auto()  # cold probe fills the table; later calls are table hits
+        t_static = best_of(run_static, AUTOTUNE_REPS)
+        t_auto = best_of(run_auto, AUTOTUNE_REPS)
+        ok_autotune_speed = t_auto * AUTOTUNE_MIN_RATIO <= t_static
+        auto_decision = tuning.resolve_decision(forest, X, model.num_samples)
+
+        regime_pick = None
+        regime_expected = None
+        ok_regime = True
+        if jax.devices()[0].platform == "cpu":
+            X_1m = np.resize(X, (AUTOTUNE_REGIME_ROWS, FEATURES))
+            regime_pick = tuning.resolve_decision(
+                forest, X_1m, model.num_samples
+            ).strategy
+            regime_expected = "native" if native.available() else "gather"
+            ok_regime = regime_pick == regime_expected
+    finally:
+        os.environ.pop("ISOFOREST_TPU_AUTOTUNE", None)
+        os.environ.pop("ISOFOREST_TPU_AUTOTUNE_PATH", None)
+        tuning.reset_cost_model()
+    autotune_ratio = t_static / t_auto  # >= AUTOTUNE_MIN_RATIO to pass
+
     # correctness guard alongside the timing gate: packed scores must match
     # the unpacked baseline's scores to float32 tolerance
     from isoforest_tpu.utils.math import avg_path_length
@@ -187,6 +250,8 @@ def main() -> int:
         and max_dev <= 1e-6
         and ok_telemetry
         and ok_monitor
+        and ok_autotune_speed
+        and ok_regime
     )
     print(
         json.dumps(
@@ -207,6 +272,15 @@ def main() -> int:
                 "monitor_disabled_s": round(t_mon_off, 4),
                 "monitor_overhead_pct": round(monitor_overhead * 100, 2),
                 "monitor_margin": MONITOR_MARGIN,
+                "autotune_auto_s": round(t_auto, 4),
+                "autotune_static_s": round(t_static, 4),
+                "autotune_ratio": round(autotune_ratio, 3),
+                "autotune_min_ratio": AUTOTUNE_MIN_RATIO,
+                "autotune_pick": auto_decision.strategy,
+                "autotune_source": auto_decision.source,
+                "autotune_static_pick": static_pick,
+                "autotune_regime_pick": regime_pick,
+                "autotune_regime_expected": regime_expected,
                 "backend": jax.devices()[0].platform,
                 "pass": ok,
             }
@@ -218,7 +292,10 @@ def main() -> int:
             f"{t_unpacked:.4f}s (margin {MARGIN}x), max_dev {max_dev:g}, "
             f"telemetry on/off {t_tel_on:.4f}/{t_tel_off:.4f}s "
             f"(margin {TELEMETRY_MARGIN}x), monitor on/off "
-            f"{t_mon_on:.4f}/{t_mon_off:.4f}s (margin {MONITOR_MARGIN}x)",
+            f"{t_mon_on:.4f}/{t_mon_off:.4f}s (margin {MONITOR_MARGIN}x), "
+            f"autotuned auto {t_auto:.4f}s vs static {t_static:.4f}s "
+            f"(min ratio {AUTOTUNE_MIN_RATIO}), 1M-regime pick "
+            f"{regime_pick!r} (expected {regime_expected!r})",
             file=sys.stderr,
         )
         return 1
