@@ -9,7 +9,7 @@ sharding over a `jax.sharding.Mesh`.
 
 __version__ = "0.6.0"
 
-from . import ops, parallel, resilience, telemetry, utils  # noqa: F401
+from . import lifecycle, ops, parallel, resilience, telemetry, utils  # noqa: F401
 from .models import (
     ExtendedIsolationForest,
     ExtendedIsolationForestModel,
@@ -18,6 +18,7 @@ from .models import (
 )
 
 __all__ = [
+    "lifecycle",
     "ops",
     "parallel",
     "resilience",

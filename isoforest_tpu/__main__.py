@@ -14,6 +14,9 @@ operational surface here is a small CLI over CSV files:
     python -m isoforest_tpu diagnose /tmp/model [--format json|prometheus]
     python -m isoforest_tpu monitor /tmp/model --input live.csv \\
         [--threshold 0.25] [--port 9101] [--format json|prometheus]
+    python -m isoforest_tpu manage /tmp/model --input live.csv \\
+        [--work-dir /tmp/model.lifecycle] [--debounce 3] [--window-rows N] \\
+        [--mode full|sliding] [--threshold 0.25] [--port 9101]
     python -m isoforest_tpu autotune [--format json|table] [--clear] \\
         [--warm --input data.csv [--model /tmp/model] \\
          --batch-sizes 1024,65536 [--refresh]]
@@ -272,6 +275,61 @@ def cmd_monitor(args) -> int:
     return 0
 
 
+def cmd_manage(args) -> int:
+    """Serve a CSV through the model lifecycle manager
+    (docs/resilience.md §8): score with drift monitoring, and on sustained
+    drift retrain on the recent window, validate the candidate against the
+    incumbent, and atomically hot-swap generations — synchronously, so the
+    command's exit state is deterministic. Prints the lifecycle summary
+    (generation, retrain outcomes, drift report) as JSON. ``--port`` serves
+    the live /metrics + /healthz endpoint (with the lifecycle section)
+    while scoring."""
+    from . import telemetry
+    from .lifecycle import ModelManager
+
+    model = _load_model(args.model_dir)
+    if model.baseline is None:
+        print(
+            "error: this model directory has no _BASELINE.json sidecar "
+            "(legacy save, or fit with baseline capture disabled) — the "
+            "lifecycle manager needs the drift baseline; refit and re-save",
+            file=sys.stderr,
+        )
+        return 2
+    manager = ModelManager(
+        model,
+        work_dir=args.work_dir or args.model_dir + ".lifecycle",
+        monitor_threshold=args.threshold,
+        drift_debounce=args.debounce,
+        window_rows=args.window_rows,
+        min_window_rows=args.min_window_rows,
+        mode=args.mode,
+        checkpoint_every=args.checkpoint_every,
+        background=False,  # retrains run inline: the CLI is deterministic
+        monitor_kwargs={"min_rows": args.min_rows},
+    )
+    server = telemetry.serve(port=args.port) if args.port is not None else None
+    try:
+        rows = 0
+        with open(args.input) as in_fh:
+            for X, y in _iter_csv_chunks(in_fh, args.labeled, args.chunk_rows):
+                manager.score(X, y=y)
+                rows += len(X)
+    finally:
+        if server is not None:
+            server.stop()
+    summary = manager.state()
+    summary["rows"] = rows
+    summary["model"] = args.model_dir
+    summary["input"] = args.input
+    summary["drift"] = manager.monitor.report()
+    if manager.last_validation is not None:
+        summary["last_validation"] = manager.last_validation.as_dict()
+    manager.close()
+    print(json.dumps(summary, indent=1, sort_keys=True))
+    return 0
+
+
 def cmd_autotune(args) -> int:
     """Operate the measured strategy autotuner's persisted cost model
     (docs/autotune.md): dump the winner table (default; ``--format json``
@@ -416,6 +474,72 @@ def build_parser() -> argparse.ArgumentParser:
     )
     mon.add_argument("--format", choices=("json", "prometheus"), default="json")
     mon.set_defaults(func=cmd_monitor)
+
+    man = sub.add_parser(
+        "manage",
+        help="serve a CSV under the drift-triggered retraining lifecycle",
+    )
+    man.add_argument("model_dir")
+    man.add_argument("--input", required=True, help="CSV of serving traffic")
+    man.add_argument("--labeled", action="store_true")
+    man.add_argument(
+        "--work-dir",
+        default=None,
+        help="lifecycle artifact dir: swapped generations + refit "
+        "checkpoints (default: <model_dir>.lifecycle)",
+    )
+    man.add_argument(
+        "--threshold",
+        type=float,
+        default=None,
+        help="PSI alert threshold (default 0.25, the 'major shift' band)",
+    )
+    man.add_argument(
+        "--debounce",
+        type=int,
+        default=3,
+        help="consecutive over-threshold drift evaluations before a retrain",
+    )
+    man.add_argument(
+        "--window-rows",
+        type=int,
+        default=65536,
+        help="recent-data reservoir capacity the refit trains on",
+    )
+    man.add_argument(
+        "--min-window-rows",
+        type=int,
+        default=1024,
+        help="refuse to retrain on a window smaller than this",
+    )
+    man.add_argument(
+        "--min-rows",
+        type=int,
+        default=512,
+        help="rows to fold before drift is evaluated",
+    )
+    man.add_argument(
+        "--mode",
+        choices=("full", "sliding"),
+        default="full",
+        help="full refit, or sliding-window tree refresh (retire oldest "
+        "trees, grow replacements on the window)",
+    )
+    man.add_argument(
+        "--checkpoint-every",
+        type=int,
+        default=None,
+        help="trees per refit checkpoint block (default 32)",
+    )
+    man.add_argument("--chunk-rows", type=int, default=1 << 16)
+    man.add_argument(
+        "--port",
+        type=int,
+        default=None,
+        help="serve the live /metrics + /healthz endpoint on this port "
+        "while scoring (0 = ephemeral)",
+    )
+    man.set_defaults(func=cmd_manage)
 
     at = sub.add_parser(
         "autotune",

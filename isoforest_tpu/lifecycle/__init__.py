@@ -1,0 +1,47 @@
+"""Model lifecycle: drift-triggered retraining with validation-gated swaps.
+
+The layer that makes the library a *system* (ROADMAP item 2): PR 5's drift
+monitoring detects that serving traffic has walked away from the training
+baseline; this package acts on it. A :class:`ModelManager` owns the active
+model, its score monitor and a recent-data reservoir; on sustained
+(debounced) drift it launches a preemption-safe checkpointed refit on the
+windowed data, validates the candidate against the incumbent
+(:mod:`.validation`), persists it through the atomic manifest-sealed
+writer, and hot-swaps it into the scoring path under a swap lock — with a
+typed event trail and rollback on any failed gate or mid-swap fault.
+
+State machine, gate semantics, rollback rules and fault seams:
+``docs/resilience.md`` §8. Events/metrics rows: ``docs/observability.md``.
+"""
+
+from .manager import (
+    OUTCOME_ERROR,
+    OUTCOME_SWAPPED,
+    OUTCOME_SWAP_FAILED,
+    OUTCOME_VALIDATION_FAILED,
+    ModelManager,
+    retrain_seed,
+    state_snapshot,
+)
+from .validation import (
+    GateResult,
+    ValidationGates,
+    ValidationResult,
+    validate_candidate,
+)
+from .window import DataReservoir
+
+__all__ = [
+    "DataReservoir",
+    "GateResult",
+    "ModelManager",
+    "OUTCOME_ERROR",
+    "OUTCOME_SWAPPED",
+    "OUTCOME_SWAP_FAILED",
+    "OUTCOME_VALIDATION_FAILED",
+    "ValidationGates",
+    "ValidationResult",
+    "retrain_seed",
+    "state_snapshot",
+    "validate_candidate",
+]

@@ -1,0 +1,732 @@
+"""ModelManager: close the loop from drift detection to a validated swap.
+
+PR 5 made the model *see* drift (PSI/KS gauges, ``drift.alert`` events, the
+``drift_alert`` rung); nothing acted on it. The manager owns the active
+model, its :class:`~isoforest_tpu.telemetry.monitor.ScoreMonitor` and a
+recent-data reservoir, and runs the state machine documented in
+``docs/resilience.md`` §8::
+
+    SERVING --sustained drift (debounced)--> RETRAINING
+    RETRAINING --checkpointed refit (killed? resumes)--> VALIDATING
+    VALIDATING --gates pass--> SWAPPING --atomic flip--> SERVING (gen+1)
+    VALIDATING --gates fail--> SERVING (incumbent untouched, rollback event)
+    SWAPPING   --fault------>  SERVING (incumbent untouched, rollback event)
+
+Design points, each proven in ``tests/test_lifecycle.py``:
+
+* **Debounce, not edge.** A single ``drift.alert`` is an edge; retraining
+  on it would thrash on bursts. The manager counts *consecutive*
+  over-threshold drift evaluations (one per scored batch once the monitor
+  has ``min_rows``) and triggers only at ``drift_debounce`` in a row.
+* **Preemption-safe refit.** The candidate trains through the block-wise
+  checkpointed fit (``resilience/checkpoint.py``) under
+  ``retry_call`` backoff: a killed attempt resumes from its last sealed
+  block (never restarts), and the finished candidate is **bitwise
+  identical** to an uninterrupted refit on the same window — the
+  checkpoint layer's invariant, inherited wholesale.
+* **Validation-gated.** ``validation.validate_candidate`` compares the
+  candidate against the incumbent on a held reference slice (score
+  parity, baseline-quantile sanity, self-PSI, optional AUROC); a failing
+  candidate is discarded and the incumbent keeps serving untouched.
+* **Atomic swap.** The candidate is saved via the manifest-sealed atomic
+  writer (a durable ``gen-<N>`` directory), then the in-memory model
+  reference flips under the swap lock: readers in flight hold the OLD
+  model reference and finish on it — a scorer never observes a torn mix
+  of two forests. The monitor *object* survives the swap:
+  :meth:`ScoreMonitor.rebind` re-targets it at the new ``_BASELINE.json``
+  and re-arms the edge-triggered alerts.
+* **Sliding-window variant.** ``mode="sliding"`` retires the oldest trees
+  and grows replacements on the window instead of refitting from scratch
+  — sound for the same reason ``on_corrupt="drop"`` partial-forest
+  rescaling is: the score is a mean over trees with a shared ``c(n)``
+  normalisation, so any tree subset (or mix of vintages grown at the same
+  ``num_samples``) is a valid forest.
+
+Every transition leaves a typed event trail (``retrain.start`` /
+``retrain.block`` / ``retrain.validate`` / ``retrain.swap`` /
+``retrain.rollback``), the ``isoforest_model_generation`` /
+``isoforest_retrain_in_progress`` gauges and the
+``isoforest_retrain_total{outcome=}`` counter.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+import weakref
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..resilience import faults
+from ..resilience.retry import RetryError, RetryPolicy, retry_call
+from ..telemetry.events import record_event
+from ..telemetry.metrics import counter as _counter, gauge as _gauge
+from ..utils.logging import logger
+from .validation import ValidationGates, ValidationResult, validate_candidate
+from .window import DataReservoir
+
+CURRENT_NAME = "CURRENT.json"
+
+_GENERATION = _gauge(
+    "isoforest_model_generation",
+    "Active model generation under the lifecycle manager "
+    "(1 = the incumbent the manager started with)",
+)
+_RETRAIN_IN_PROGRESS = _gauge(
+    "isoforest_retrain_in_progress",
+    "1 while a drift-triggered refit is running, else 0",
+)
+_RETRAIN_TOTAL = _counter(
+    "isoforest_retrain_total",
+    "Drift-triggered retrain attempts, by terminal outcome "
+    "(swapped | validation_failed | swap_failed | error)",
+    labelnames=("outcome",),
+)
+
+# terminal retrain outcomes (the {outcome=} label values)
+OUTCOME_SWAPPED = "swapped"
+OUTCOME_VALIDATION_FAILED = "validation_failed"
+OUTCOME_SWAP_FAILED = "swap_failed"
+OUTCOME_ERROR = "error"
+
+
+def retrain_seed(base_seed: int, generation: int) -> int:
+    """Deterministic per-generation refit seed: reproducible (the bitwise
+    refit-equivalence proof depends on it) yet distinct from the incumbent's
+    stream so a refit is a fresh ensemble, not a re-roll of the same one."""
+    return int((int(base_seed) + 7919 * int(generation)) & 0x7FFFFFFF)
+
+
+# the most recently constructed (not yet closed) manager; the telemetry HTTP
+# endpoint surfaces its state() on /healthz and /snapshot
+_ACTIVE_REF: Optional["weakref.ref[ModelManager]"] = None
+
+
+def state_snapshot() -> Optional[dict]:
+    """The active manager's :meth:`ModelManager.state`, or None when no
+    manager is live in this process — consumed by ``telemetry/http.py``."""
+    manager = _ACTIVE_REF() if _ACTIVE_REF is not None else None
+    if manager is None or manager.closed:
+        return None
+    return manager.state()
+
+
+class ModelManager:
+    """Owns the scoring path of one model lineage: serve, watch, retrain,
+    validate, swap (docs/resilience.md §8).
+
+    ``model`` must carry a drift baseline (fit with capture enabled, or a
+    model dir with the ``_BASELINE.json`` sidecar). ``work_dir`` hosts the
+    durable artifacts: swapped generations (``gen-<N>``, each a sealed
+    model directory) plus in-flight refit checkpoints (``retrain/r<seq>``).
+
+    Knobs: ``monitor_threshold``/``monitor_kwargs`` configure the attached
+    :class:`ScoreMonitor`; ``drift_debounce`` is the consecutive
+    over-threshold evaluations required to trigger; ``window_rows`` bounds
+    the recent-data reservoir and ``min_window_rows`` refuses to retrain on
+    a sliver; ``mode`` picks the full refit or the sliding-window tree
+    refresh (``sliding_fraction`` of the oldest trees retired per swap);
+    ``gates`` bounds validation; ``background=False`` runs the refit
+    synchronously inside the triggering ``score`` call (the CLI and
+    deterministic tests use this). ``clock``/``sleep`` are injectable for
+    the retry schedule — tests drive them with
+    :class:`~isoforest_tpu.resilience.faults.FakeClock` so the whole loop
+    is provable with zero real sleeps. ``hooks`` is a test seam: a
+    ``"mid_swap"`` callable runs after the candidate's durable save but
+    before the in-memory flip (the slow-swap injection point for the
+    swap-under-load proof).
+    """
+
+    def __init__(
+        self,
+        model,
+        work_dir: str,
+        *,
+        monitor_threshold: Optional[float] = None,
+        drift_debounce: int = 3,
+        window_rows: int = 65536,
+        min_window_rows: int = 1024,
+        mode: str = "full",
+        sliding_fraction: float = 0.5,
+        checkpoint_every: Optional[int] = None,
+        gates: Optional[ValidationGates] = None,
+        auto_retrain: bool = True,
+        background: bool = True,
+        retry_policy: Optional[RetryPolicy] = None,
+        clock: Callable[[], float] = time.time,
+        sleep: Callable[[float], None] = time.sleep,
+        monitor_kwargs: Optional[dict] = None,
+        hooks: Optional[Dict[str, Callable[[], None]]] = None,
+    ) -> None:
+        if model.baseline is None:
+            raise ValueError(
+                "lifecycle management requires a drift baseline: fit with "
+                "baseline capture enabled, or load a model dir carrying the "
+                "_BASELINE.json sidecar"
+            )
+        if mode not in ("full", "sliding"):
+            raise ValueError(f"mode must be 'full' or 'sliding', got {mode!r}")
+        if drift_debounce < 1:
+            raise ValueError(f"drift_debounce must be >= 1, got {drift_debounce}")
+        if not 0.0 < sliding_fraction <= 1.0:
+            raise ValueError(
+                f"sliding_fraction must be in (0, 1], got {sliding_fraction}"
+            )
+        self.work_dir = str(work_dir)
+        os.makedirs(self.work_dir, exist_ok=True)
+        self.mode = mode
+        self.sliding_fraction = float(sliding_fraction)
+        self.drift_debounce = int(drift_debounce)
+        self.min_window_rows = int(min_window_rows)
+        self.checkpoint_every = checkpoint_every
+        self.gates = gates or ValidationGates()
+        self.auto_retrain = bool(auto_retrain)
+        self.background = bool(background)
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=3, base_delay_s=0.5, max_delay_s=10.0
+        )
+        self.reservoir = DataReservoir(window_rows)
+        self.generation = 1
+        self.model_path: Optional[str] = None
+        self.last_swap_unix_s: Optional[float] = None
+        self.last_retrain: Optional[dict] = None
+        self.last_validation: Optional[ValidationResult] = None
+        self.last_error: Optional[BaseException] = None
+        self.closed = False
+        self._clock = clock
+        self._sleep = sleep
+        self._hooks = dict(hooks or {})
+        self._lock = threading.Lock()
+        self._model = model
+        self._consecutive = 0
+        self._retrain_seq = 0
+        self._retraining = False
+        self._retrain_thread: Optional[threading.Thread] = None
+        self._outcomes: Dict[str, int] = {}
+        kwargs = dict(monitor_kwargs or {})
+        if monitor_threshold is not None:
+            kwargs["threshold"] = monitor_threshold
+        self._monitor = model.enable_monitoring(**kwargs)
+        _GENERATION.set(self.generation)
+        _RETRAIN_IN_PROGRESS.set(0)
+        global _ACTIVE_REF
+        _ACTIVE_REF = weakref.ref(self)
+
+    # ------------------------------------------------------------------ #
+    # serving path
+    # ------------------------------------------------------------------ #
+
+    @property
+    def model(self):
+        """The active model (a point-in-time reference: keep scoring on it
+        even if a swap lands mid-request — that is the no-torn-read
+        guarantee)."""
+        with self._lock:
+            return self._model
+
+    @property
+    def monitor(self):
+        return self._monitor
+
+    def score(self, X, y: Optional[np.ndarray] = None) -> np.ndarray:
+        """Score a served batch through the active model (folding the drift
+        monitor), remember the rows in the retrain reservoir (labels too,
+        when given — they arm the AUROC validation gate), and run the
+        debounced drift trigger."""
+        model = self.model
+        scores = model.score(X)
+        self.reservoir.fold(X, y)
+        self._maybe_trigger()
+        return scores
+
+    def _maybe_trigger(self) -> None:
+        drift = self._monitor.drift()
+        if "score" not in drift:
+            return  # below min_rows: not a drift evaluation yet
+        over = drift["score"]["psi"] > self._monitor.threshold
+        if not over:
+            features = drift.get("features") or {}
+            over = any(
+                v > self._monitor.feature_threshold for v in features.values()
+            )
+        start = False
+        with self._lock:
+            self._consecutive = self._consecutive + 1 if over else 0
+            if (
+                self.auto_retrain
+                and not self._retraining
+                and self._consecutive >= self.drift_debounce
+                and self.reservoir.rows >= self.min_window_rows
+            ):
+                self._consecutive = 0
+                start = True
+        if start:
+            self._start_retrain(reason="sustained_drift")
+
+    # ------------------------------------------------------------------ #
+    # retrain orchestration
+    # ------------------------------------------------------------------ #
+
+    def retrain(self, reason: str = "manual", wait: bool = True) -> Optional[str]:
+        """Force a retrain now (regardless of drift). Returns the terminal
+        outcome when ``wait`` (or the manager is synchronous), the marker
+        ``"started"`` when a background retrain was launched without
+        waiting, and None when nothing started (one already in flight,
+        empty reservoir, or closed)."""
+        started = self._start_retrain(reason=reason)
+        if not started:
+            return None
+        if not wait:
+            return "started"
+        self.wait_retrain()
+        return self.last_retrain.get("outcome") if self.last_retrain else None
+
+    def wait_retrain(self, timeout_s: Optional[float] = None) -> bool:
+        """Join any in-flight background retrain; True once idle."""
+        with self._lock:
+            thread = self._retrain_thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout_s)
+        with self._lock:
+            return not self._retraining
+
+    def _start_retrain(self, reason: str) -> bool:
+        with self._lock:
+            if self._retraining or self.closed:
+                return False
+            window_X, window_y = self.reservoir.snapshot()
+            if window_X.shape[0] < 1:
+                logger.warning(
+                    "lifecycle: retrain requested (%s) but the reservoir is "
+                    "empty; serve traffic through manager.score first",
+                    reason,
+                )
+                return False
+            self._retraining = True
+            self._retrain_seq += 1
+            seq = self._retrain_seq
+            incumbent = self._model
+        _RETRAIN_IN_PROGRESS.set(1)
+        target = self.generation + 1
+        seed = retrain_seed(incumbent.params.random_seed, target)
+        self.last_retrain = {
+            "seq": seq,
+            "generation": target,
+            "reason": reason,
+            "mode": self.mode,
+            "rows": int(window_X.shape[0]),
+            "seed": seed,
+            "window": window_X,
+            "outcome": None,
+        }
+        record_event(
+            "retrain.start",
+            seq=seq,
+            generation=target,
+            reason=reason,
+            mode=self.mode,
+            rows=int(window_X.shape[0]),
+            seed=seed,
+        )
+        if self.background:
+            thread = threading.Thread(
+                target=self._retrain_body,
+                args=(incumbent, window_X, window_y, seq, target, seed),
+                daemon=True,
+                name=f"isoforest-retrain[r{seq}]",
+            )
+            with self._lock:
+                self._retrain_thread = thread
+            thread.start()
+        else:
+            self._retrain_body(incumbent, window_X, window_y, seq, target, seed)
+        return True
+
+    def _finish(self, outcome: str) -> None:
+        with self._lock:
+            self._retraining = False
+            self._outcomes[outcome] = self._outcomes.get(outcome, 0) + 1
+            if self.last_retrain is not None:
+                self.last_retrain["outcome"] = outcome
+        _RETRAIN_IN_PROGRESS.set(0)
+        _RETRAIN_TOTAL.inc(outcome=outcome)
+
+    def _checkpoint_dir(self, seq: int) -> str:
+        return os.path.join(self.work_dir, "retrain", f"r{seq:04d}")
+
+    def _retrain_body(
+        self, incumbent, window_X, window_y, seq: int, target: int, seed: int
+    ) -> None:
+        ckpt_dir = self._checkpoint_dir(seq)
+        try:
+            try:
+                candidate = retry_call(
+                    lambda: self._fit_candidate(
+                        incumbent, window_X, seq, target, seed, ckpt_dir
+                    ),
+                    policy=self.retry_policy,
+                    retry_on=(Exception,),
+                    describe=f"lifecycle refit r{seq} (gen {target})",
+                    clock=self._clock,
+                    sleep=self._sleep,
+                    seed=seed,
+                )
+            except RetryError as exc:
+                self.last_error = exc
+                record_event(
+                    "retrain.rollback",
+                    seq=seq,
+                    generation=target,
+                    reason="retrain_error",
+                    error=repr(exc),
+                )
+                logger.error("lifecycle refit r%d failed every attempt: %s", seq, exc)
+                self._finish(OUTCOME_ERROR)
+                return
+            self._maybe_poison_candidate(candidate)
+            result = validate_candidate(
+                incumbent, candidate, window_X, window_y, gates=self.gates
+            )
+            self.last_validation = result
+            record_event(
+                "retrain.validate",
+                seq=seq,
+                generation=target,
+                passed=result.passed,
+                reference_rows=result.reference_rows,
+                gates=json.dumps(result.as_dict()["gates"]),
+            )
+            if not result.passed:
+                record_event(
+                    "retrain.rollback",
+                    seq=seq,
+                    generation=target,
+                    reason="validation_failed",
+                    failed_gates=",".join(result.failed_gates()),
+                )
+                logger.warning(
+                    "lifecycle: candidate gen %d failed validation (%s); the "
+                    "incumbent keeps serving untouched",
+                    target,
+                    ", ".join(result.failed_gates()),
+                )
+                self._finish(OUTCOME_VALIDATION_FAILED)
+                return
+            try:
+                self._swap(candidate, seq, target)
+            except Exception as exc:
+                self.last_error = exc
+                record_event(
+                    "retrain.rollback",
+                    seq=seq,
+                    generation=target,
+                    reason="swap_failed",
+                    error=repr(exc),
+                )
+                logger.error(
+                    "lifecycle: swap to gen %d failed mid-flight (%s); the "
+                    "incumbent keeps serving untouched",
+                    target,
+                    exc,
+                )
+                self._finish(OUTCOME_SWAP_FAILED)
+                return
+            self._finish(OUTCOME_SWAPPED)
+        finally:
+            # terminal outcome either way: the per-attempt checkpoint dir is
+            # spent (a new retrain snapshots a new window -> new fingerprint)
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    # ------------------------------------------------------------------ #
+    # candidate construction
+    # ------------------------------------------------------------------ #
+
+    def _fit_candidate(
+        self, incumbent, window_X, seq: int, target: int, seed: int, ckpt_dir: str
+    ):
+        sliding = self.mode == "sliding"
+        if sliding and (
+            window_X.shape[0] < incumbent.num_samples
+            and not incumbent.params.bootstrap
+        ):
+            # without-replacement bagging cannot draw num_samples from a
+            # smaller window; a full refit re-resolves num_samples instead
+            logger.warning(
+                "lifecycle: window of %d rows is smaller than numSamples=%d; "
+                "falling back from sliding refresh to a full refit",
+                window_X.shape[0],
+                incumbent.num_samples,
+            )
+            sliding = False
+        if sliding:
+            return self._sliding_candidate(incumbent, window_X, seq, target, seed)
+        return self._full_candidate(incumbent, window_X, seq, target, seed, ckpt_dir)
+
+    def _full_candidate(
+        self, incumbent, window_X, seq: int, target: int, seed: int, ckpt_dir: str
+    ):
+        from ..models.extended import (
+            ExtendedIsolationForest,
+            ExtendedIsolationForestModel,
+        )
+        from ..models.isolation_forest import IsolationForest
+
+        params = incumbent.params.replace(random_seed=seed)
+        if isinstance(incumbent, ExtendedIsolationForestModel):
+            estimator = ExtendedIsolationForest(params=params)
+        else:
+            estimator = IsolationForest(params=params)
+
+        def on_block(index: int, start: int, stop: int, resumed: bool) -> None:
+            record_event(
+                "retrain.block",
+                seq=seq,
+                generation=target,
+                index=index,
+                start=start,
+                stop=stop,
+                resumed=bool(resumed),
+            )
+            faults.take_retrain_kill(index)
+
+        return estimator.fit(
+            window_X,
+            nonfinite="allow",  # the serving path already applied the policy
+            checkpoint_dir=ckpt_dir,
+            checkpoint_every=self.checkpoint_every,
+            resume=True,
+            block_callback=on_block,
+        )
+
+    def _sliding_candidate(self, incumbent, window_X, seq: int, target: int, seed: int):
+        """Retire the oldest trees, grow replacements on the window: the
+        streaming-adaptation variant. Sound because the score is a mean over
+        trees normalised by a shared ``c(num_samples)`` — the same property
+        the ``on_corrupt="drop"`` partial-forest rescaling already relies on
+        — so a forest mixing tree vintages grown at the SAME ``num_samples``
+        (and height) is exactly as valid as any bootstrap ensemble."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.extended import ExtendedIsolationForestModel
+        from ..models.isolation_forest import (
+            _capture_fit_baseline,
+            _baseline_env_enabled,
+            _compute_and_set_threshold,
+        )
+        from ..ops.bagging import bagged_indices, feature_subsets, per_tree_keys
+        from ..utils import height_limit
+
+        forest = incumbent.forest
+        num_trees = forest.num_trees
+        replace = min(num_trees, max(1, int(round(num_trees * self.sliding_fraction))))
+        num_samples = incumbent.num_samples
+        height = height_limit(num_samples)
+        extended = isinstance(incumbent, ExtendedIsolationForestModel)
+
+        key = jax.random.PRNGKey(np.uint32(seed & 0xFFFFFFFF))
+        k_bag, k_feat, k_grow = jax.random.split(key, 3)
+        Xd = jnp.asarray(window_X, jnp.float32)
+        bag = bagged_indices(
+            k_bag,
+            int(window_X.shape[0]),
+            num_samples,
+            replace,
+            incumbent.params.bootstrap,
+        )
+        fidx = feature_subsets(
+            k_feat, int(window_X.shape[1]), incumbent.num_features, replace
+        )
+        tree_keys = per_tree_keys(k_grow, replace)
+        if extended:
+            from ..ops.ext_growth import grow_extended_forest_block
+
+            block = grow_extended_forest_block(
+                tree_keys,
+                Xd,
+                bag,
+                fidx,
+                height=height,
+                extension_level=incumbent.extension_level,
+            )
+        else:
+            from ..ops.tree_growth import grow_forest_block
+
+            block = grow_forest_block(tree_keys, Xd, bag, fidx, height=height)
+        block = jax.tree_util.tree_map(jax.block_until_ready, block)
+
+        cls = type(forest)
+        merged = {}
+        for field in forest._fields:
+            old = np.asarray(getattr(forest, field))
+            new = np.asarray(getattr(block, field))
+            if old.shape[1:] != new.shape[1:]:
+                raise ValueError(
+                    f"sliding refresh produced a mismatched {field!r} plane "
+                    f"({new.shape[1:]} vs incumbent {old.shape[1:]}); the "
+                    "window cannot be grown at the incumbent's geometry"
+                )
+            merged[field] = jnp.asarray(np.concatenate([old[replace:], new]))
+        record_event(
+            "retrain.block",
+            seq=seq,
+            generation=target,
+            index=0,
+            start=0,
+            stop=replace,
+            resumed=False,
+            sliding=True,
+            retired_trees=replace,
+        )
+
+        model_cls = type(incumbent)
+        common = dict(
+            forest=cls(**merged),
+            params=incumbent.params,
+            num_samples=num_samples,
+            num_features=incumbent.num_features,
+            total_num_features=incumbent.total_num_features,
+        )
+        if extended:
+            candidate = model_cls(extension_level=incumbent.extension_level, **common)
+        else:
+            candidate = model_cls(**common)
+        candidate.finalize_scoring()
+        _compute_and_set_threshold(candidate, Xd)
+        if _baseline_env_enabled():
+            _capture_fit_baseline(candidate, window_X)
+        return candidate
+
+    def _maybe_poison_candidate(self, candidate) -> None:
+        """``corrupt_candidate`` fault seam: poison the candidate's first
+        float plane with NaN before validation — the gates, not luck, must
+        keep a torn refit off the scoring path."""
+        if not faults.candidate_corrupted():
+            return
+        import jax.numpy as jnp
+
+        forest = candidate.forest
+        for field in forest._fields:
+            arr = np.asarray(getattr(forest, field))
+            if arr.dtype.kind == "f":
+                candidate.forest = forest._replace(
+                    **{field: jnp.asarray(np.full_like(arr, np.nan))}
+                )
+                candidate._scoring_layout = None
+                candidate.finalize_scoring()
+                logger.warning(
+                    "lifecycle: injected corrupt_candidate fault poisoned the "
+                    "candidate's %r plane before validation",
+                    field,
+                )
+                return
+
+    # ------------------------------------------------------------------ #
+    # swap
+    # ------------------------------------------------------------------ #
+
+    def _generation_dir(self, generation: int) -> str:
+        return os.path.join(self.work_dir, f"gen-{generation:05d}")
+
+    def _swap(self, candidate, seq: int, target: int) -> None:
+        gen_dir = self._generation_dir(target)
+        try:
+            # durable first: the atomic manifest-sealed writer is the swap
+            # primitive — a crash after this line loses nothing
+            candidate.save(gen_dir, overwrite=True)
+            faults.check_swap()
+            hook = self._hooks.get("mid_swap")
+            if hook is not None:
+                hook()
+        except BaseException:
+            shutil.rmtree(gen_dir, ignore_errors=True)
+            raise
+        with self._lock:
+            old = self._model
+            # the monitor object survives the swap: rebind re-targets it at
+            # the candidate's baseline and re-arms the edge-triggered alerts
+            self._monitor.rebind(candidate.baseline)
+            candidate._monitor = self._monitor
+            old._monitor = None
+            self._model = candidate
+            self.generation = target
+            self.model_path = gen_dir
+            self.last_swap_unix_s = float(self._clock())
+            self._consecutive = 0
+        _GENERATION.set(target)
+        self._write_current(target, gen_dir)
+        record_event(
+            "retrain.swap",
+            seq=seq,
+            generation=target,
+            path=gen_dir,
+            trees=candidate.forest.num_trees,
+        )
+        logger.info(
+            "lifecycle: generation %d swapped in from %s (monitor rebound, "
+            "incumbent released)",
+            target,
+            gen_dir,
+        )
+
+    def _write_current(self, generation: int, path: str) -> None:
+        """Atomic CURRENT pointer (tmp + ``os.replace``): an operator or a
+        restarted process reads which sealed generation dir is live."""
+        current = os.path.join(self.work_dir, CURRENT_NAME)
+        tmp = f"{current}.tmp-{os.getpid()}"
+        payload = {
+            "generation": generation,
+            "path": path,
+            "swapped_unix_s": self.last_swap_unix_s,
+        }
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        os.replace(tmp, current)
+
+    # ------------------------------------------------------------------ #
+    # observability / teardown
+    # ------------------------------------------------------------------ #
+
+    def state(self) -> dict:
+        """Operator-facing lifecycle state (plain JSON types): surfaced on
+        ``/healthz`` and ``/snapshot`` (docs/observability.md §8)."""
+        with self._lock:
+            retraining = self._retraining
+            consecutive = self._consecutive
+            outcomes = dict(self._outcomes)
+            uid = self._model.uid
+        last = self.last_retrain
+        return {
+            "generation": self.generation,
+            "mode": self.mode,
+            "model_uid": uid,
+            "model_path": self.model_path,
+            "last_swap_unix_s": self.last_swap_unix_s,
+            "retrain_in_progress": retraining,
+            "drift_debounce": self.drift_debounce,
+            "consecutive_over_threshold": consecutive,
+            "window_rows": self.reservoir.rows,
+            "window_capacity": self.reservoir.capacity,
+            "retrains": outcomes,
+            "last_outcome": None if last is None else last.get("outcome"),
+            "last_error": None if self.last_error is None else repr(self.last_error),
+        }
+
+    def close(self) -> None:
+        """Detach: stop auto-retraining, wait out any in-flight refit,
+        release the monitor and drop this manager from the HTTP state
+        endpoint. Idempotent."""
+        if self.closed:
+            return
+        self.auto_retrain = False
+        self.wait_retrain()
+        self.closed = True
+        self.model.disable_monitoring()
+        global _ACTIVE_REF
+        if _ACTIVE_REF is not None and _ACTIVE_REF() is self:
+            _ACTIVE_REF = None

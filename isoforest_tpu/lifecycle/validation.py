@@ -1,0 +1,233 @@
+"""Candidate-validation gates: never swap a refit in on faith.
+
+The inductive-bias analysis of isolation forests (arXiv 2505.12825) is the
+motivation for gating: a refit on a drifted window can land in a genuinely
+different bias regime, so the candidate is validated AGAINST THE INCUMBENT
+on a held reference slice of the very window it trained on, not trusted
+because training succeeded. Four gates, each a plain measurable predicate
+(``docs/resilience.md`` §8 documents the semantics and defaults):
+
+* ``finite`` — every candidate score on the reference slice is finite and
+  inside the ``[0, 1]`` score codomain (a poisoned/torn candidate fails
+  here or at the PSI gate before anything subtler is consulted);
+* ``score_parity`` — mean ``|candidate - incumbent|`` on the reference
+  slice is bounded. Under real drift the two models *should* disagree
+  (the incumbent calls the whole drifted window anomalous; the candidate
+  has adapted — measured deltas reach ~0.3 on a 3-sigma covariate
+  shift), so the bound is deliberately loose (default 0.4 of the [0, 1]
+  codomain) and exists to catch a candidate whose scores are
+  structurally broken, not merely adapted — degenerate candidates are
+  primarily the PSI gate's job;
+* ``baseline_sanity`` — the candidate carries a fresh drift baseline whose
+  quantiles are ordered and whose median training score sits in a sane
+  band (a forest that scores its own training data near 0 or 1 is
+  degenerate), and the candidate's own scores on the reference slice show
+  PSI below the alert threshold against that baseline — the direct
+  predictor that the drift gauges fall back below threshold post-swap;
+* ``auroc`` — only when the window carries labels: candidate AUROC on the
+  reference slice must not trail the incumbent's by more than a margin.
+
+``validate_candidate`` returns a :class:`ValidationResult` with one
+:class:`GateResult` per gate; the ``fail_validation`` fault seam
+(``resilience/faults.py``) forces the run to fail for rollback drills.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..resilience import faults
+from ..telemetry.monitor import DEFAULT_PSI_THRESHOLD, psi
+
+
+@dataclasses.dataclass(frozen=True)
+class ValidationGates:
+    """Gate bounds for :func:`validate_candidate`; the defaults pass a
+    healthy refit on drifted traffic and fail poisoned/degenerate ones
+    (tests/test_lifecycle.py proves both directions)."""
+
+    max_score_delta: float = 0.4
+    max_candidate_psi: float = DEFAULT_PSI_THRESHOLD
+    median_band: Tuple[float, float] = (0.05, 0.95)
+    auroc_margin: float = 0.02
+    max_reference_rows: int = 8192
+
+    def __post_init__(self) -> None:
+        if self.max_score_delta <= 0 or self.max_candidate_psi <= 0:
+            raise ValueError("gate bounds must be positive")
+        lo, hi = self.median_band
+        if not 0.0 <= lo < hi <= 1.0:
+            raise ValueError(f"median_band must be within [0, 1], got {self.median_band}")
+        if self.max_reference_rows < 1:
+            raise ValueError("max_reference_rows must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class GateResult:
+    """One gate's verdict: the measured value against its bound."""
+
+    name: str
+    passed: bool
+    value: Optional[float]
+    bound: Optional[float]
+    detail: str = ""
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "passed": self.passed,
+            "value": self.value,
+            "bound": self.bound,
+            "detail": self.detail,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class ValidationResult:
+    passed: bool
+    gates: Tuple[GateResult, ...]
+    reference_rows: int
+
+    def failed_gates(self) -> Tuple[str, ...]:
+        return tuple(g.name for g in self.gates if not g.passed)
+
+    def as_dict(self) -> dict:
+        return {
+            "passed": self.passed,
+            "reference_rows": self.reference_rows,
+            "gates": [g.as_dict() for g in self.gates],
+        }
+
+
+def _auroc(scores: np.ndarray, labels: np.ndarray) -> float:
+    order = np.argsort(scores, kind="stable")
+    ranks = np.empty(len(scores))
+    ranks[order] = np.arange(1, len(scores) + 1)
+    pos = labels == 1
+    n1, n0 = int(pos.sum()), int((~pos).sum())
+    if n1 == 0 or n0 == 0:
+        return float("nan")
+    return float((ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0))
+
+
+def validate_candidate(
+    incumbent,
+    candidate,
+    X: np.ndarray,
+    y: Optional[np.ndarray] = None,
+    gates: Optional[ValidationGates] = None,
+) -> ValidationResult:
+    """Run every gate for ``candidate`` vs ``incumbent`` on a deterministic
+    stride sample of ``X`` (the held reference slice — the same windowed
+    traffic the candidate trained on). Returns the full per-gate verdict;
+    never raises on a failing gate (the caller decides rollback)."""
+    gates = gates or ValidationGates()
+    X = np.asarray(X, np.float32)
+    if X.ndim != 2 or X.shape[0] == 0:
+        raise ValueError(f"reference data must be non-empty [N, F]; got {X.shape}")
+    step = max(1, -(-X.shape[0] // gates.max_reference_rows))
+    ref = np.ascontiguousarray(X[::step])
+    ref_y = None if y is None else np.asarray(y, np.float64).reshape(-1)[::step]
+
+    results = []
+    # scores computed nonfinite="allow": the gates exist precisely to judge
+    # a candidate on data the input policy already admitted once
+    cand = np.asarray(candidate.score(ref, nonfinite="allow"), np.float64)
+    inc = np.asarray(incumbent.score(ref, nonfinite="allow"), np.float64)
+
+    finite = bool(np.isfinite(cand).all() and (cand >= 0.0).all() and (cand <= 1.0).all())
+    results.append(
+        GateResult(
+            name="finite",
+            passed=finite,
+            value=float(np.isfinite(cand).mean()),
+            bound=1.0,
+            detail="all candidate scores finite and in [0, 1]",
+        )
+    )
+
+    if finite:
+        delta = float(np.mean(np.abs(cand - inc)))
+    else:
+        delta = float("inf")
+    results.append(
+        GateResult(
+            name="score_parity",
+            passed=delta <= gates.max_score_delta,
+            value=round(delta, 6) if np.isfinite(delta) else delta,
+            bound=gates.max_score_delta,
+            detail="mean |candidate - incumbent| on the reference slice",
+        )
+    )
+
+    baseline = getattr(candidate, "baseline", None)
+    if baseline is None:
+        results.append(
+            GateResult(
+                name="baseline_sanity",
+                passed=False,
+                value=None,
+                bound=None,
+                detail="candidate carries no drift baseline — the monitor "
+                "could not rebind after a swap",
+            )
+        )
+    else:
+        q = baseline.score_quantiles
+        lo, hi = gates.median_band
+        ordered = q["p01"] <= q["p50"] <= q["p99"]
+        in_band = lo <= q["p50"] <= hi
+        self_psi = (
+            psi(baseline.score.counts, baseline.score.fold(cand))
+            if finite
+            else float("inf")
+        )
+        ok = bool(ordered and in_band and self_psi <= gates.max_candidate_psi)
+        results.append(
+            GateResult(
+                name="baseline_sanity",
+                passed=ok,
+                value=round(self_psi, 6) if np.isfinite(self_psi) else self_psi,
+                bound=gates.max_candidate_psi,
+                detail=(
+                    f"median {q['p50']:.4f} in [{lo:g}, {hi:g}]={in_band}, "
+                    f"quantiles ordered={ordered}, reference-slice PSI vs "
+                    "own baseline"
+                ),
+            )
+        )
+
+    if ref_y is not None and 0 < int((ref_y == 1).sum()) < ref_y.shape[0]:
+        cand_auroc = _auroc(cand, ref_y)
+        inc_auroc = _auroc(inc, ref_y)
+        results.append(
+            GateResult(
+                name="auroc",
+                passed=bool(cand_auroc >= inc_auroc - gates.auroc_margin),
+                value=round(cand_auroc, 6),
+                bound=round(inc_auroc - gates.auroc_margin, 6),
+                detail=f"incumbent AUROC {inc_auroc:.4f}, margin {gates.auroc_margin:g}",
+            )
+        )
+
+    try:
+        faults.check_validation()
+    except faults.FaultInjectedError as exc:
+        results.append(
+            GateResult(
+                name="fault_injected",
+                passed=False,
+                value=None,
+                bound=None,
+                detail=str(exc),
+            )
+        )
+
+    return ValidationResult(
+        passed=all(g.passed for g in results),
+        gates=tuple(results),
+        reference_rows=int(ref.shape[0]),
+    )

@@ -1,0 +1,92 @@
+"""Recent-data reservoir: the sliding window a drift-triggered refit trains on.
+
+Large-scale isolation-tree deployments are sensitive to the sampling-window
+choice (arXiv 2004.04512 frames window selection as a first-order knob for
+nonstationary traffic): a refit on *all* history re-learns the drifted-away
+past, a refit on one batch overfits a burst. The reservoir keeps the most
+recent ``capacity`` served rows (and their labels, when the caller has
+them), in arrival order, so a retrain always sees "the last N rows of
+traffic" — a deterministic, reproducible window rather than a random sample,
+which is what keeps the lifecycle's bitwise refit-equivalence proof
+(tests/test_lifecycle.py) possible.
+
+Thread-safe: serving stacks fold from scorer worker pools while the
+retrain thread snapshots.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class DataReservoir:
+    """Bounded FIFO of recently served rows (and optional labels).
+
+    ``fold`` appends a batch and evicts the oldest rows past ``capacity``;
+    ``snapshot`` returns a contiguous copy in arrival order (oldest first).
+    Labels are kept row-aligned only while EVERY folded batch carries them
+    — one unlabeled batch drops the label track for the window (a partial
+    label track would silently misalign the AUROC validation gate).
+    """
+
+    def __init__(self, capacity: int) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._X: Optional[np.ndarray] = None
+        self._y: Optional[np.ndarray] = None
+        self._labeled = True  # until proven otherwise
+
+    @property
+    def rows(self) -> int:
+        with self._lock:
+            return 0 if self._X is None else int(self._X.shape[0])
+
+    def fold(self, X: np.ndarray, y: Optional[np.ndarray] = None) -> None:
+        X = np.asarray(X, np.float32)
+        if X.ndim != 2 or X.shape[0] == 0:
+            raise ValueError(f"reservoir batches must be non-empty [N, F]; got {X.shape}")
+        if y is not None:
+            y = np.asarray(y, np.float64).reshape(-1)
+            if y.shape[0] != X.shape[0]:
+                raise ValueError(
+                    f"labels must align with rows; got {y.shape[0]} labels "
+                    f"for {X.shape[0]} rows"
+                )
+        with self._lock:
+            if self._X is not None and X.shape[1] != self._X.shape[1]:
+                raise ValueError(
+                    f"reservoir feature width is {self._X.shape[1]}; got a "
+                    f"batch of width {X.shape[1]}"
+                )
+            if y is None:
+                self._labeled = False
+                self._y = None
+            if self._X is None:
+                self._X = X[-self.capacity :].copy()
+                if self._labeled and y is not None:
+                    self._y = y[-self.capacity :].copy()
+                return
+            self._X = np.concatenate([self._X, X])[-self.capacity :]
+            if self._labeled and y is not None:
+                base = self._y if self._y is not None else np.empty((0,), np.float64)
+                self._y = np.concatenate([base, y])[-self.capacity :]
+
+    def snapshot(self) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """``(X, y_or_None)`` — copies, oldest row first."""
+        with self._lock:
+            if self._X is None:
+                return np.empty((0, 0), np.float32), None
+            X = self._X.copy()
+            y = self._y.copy() if (self._labeled and self._y is not None) else None
+        return X, y
+
+    def clear(self) -> None:
+        with self._lock:
+            self._X = None
+            self._y = None
+            self._labeled = True

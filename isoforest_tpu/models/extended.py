@@ -74,6 +74,7 @@ class ExtendedIsolationForest(_ParamSetters):
         checkpoint_every=None,
         resume: bool = False,
         baseline: bool = True,
+        block_callback=None,
     ) -> "ExtendedIsolationForestModel":
         """Train; same knobs as :meth:`IsolationForest.fit`, including the
         preemption-safe ``checkpoint_dir``/``checkpoint_every``/``resume``
@@ -121,6 +122,7 @@ class ExtendedIsolationForest(_ParamSetters):
                     resolved=resolved,
                     height=h,
                     extension_level=ext_level,
+                    on_block=block_callback,
                 )
             elif mesh is not None:
                 from ..parallel.sharded import sharded_grow_extended_forest

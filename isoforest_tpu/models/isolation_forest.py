@@ -116,6 +116,7 @@ def _blockwise_grow(
     resolved,
     height: int,
     extension_level=None,
+    on_block=None,
 ):
     """Preemption-safe growth shared by both estimators: grow the forest in
     checkpointed blocks of trees (docs/resilience.md §5).
@@ -169,6 +170,7 @@ def _blockwise_grow(
     parts = []
     for index, start, stop in ckpt.block_ranges(num_trees, block_trees):
         arrays = state.load_block(index, start, stop)
+        resumed = arrays is not None
         if arrays is None:
             with _telemetry_span("fit.grow_block", block=index, trees=stop - start):
                 block = grow_block(
@@ -183,6 +185,11 @@ def _blockwise_grow(
             # preemption seam: fires AFTER the seal, like a real kill
             # landing between blocks (tests/test_checkpoint.py)
             faults.check_fit_block(index)
+        if on_block is not None:
+            # progress hook consumed by the lifecycle manager: it observes
+            # durable state only (the seal already happened), and a raise
+            # here aborts the fit exactly like a between-block preemption
+            on_block(index, start, stop, resumed)
         parts.append(arrays)
     logger.info(
         "checkpointed fit: %d/%d block(s) grown this session, %d resumed "
@@ -260,6 +267,7 @@ class IsolationForest(_ParamSetters):
         checkpoint_every: Optional[int] = None,
         resume: bool = False,
         baseline: bool = True,
+        block_callback=None,
     ) -> "IsolationForestModel":
         """Train. With ``mesh`` (a `jax.sharding.Mesh` with a ``'trees'`` axis),
         tree growth is sharded across devices (SURVEY.md §2.4 tree parallelism);
@@ -283,7 +291,13 @@ class IsolationForest(_ParamSetters):
         captures the drift-monitoring baseline — training-score histogram +
         quantiles and per-feature stats from a capped deterministic
         subsample — persisted with the model as a ``_BASELINE.json``
-        sidecar (docs/observability.md §8)."""
+        sidecar (docs/observability.md §8).
+
+        ``block_callback`` (checkpointed fits only) is a progress hook
+        called as ``callback(index, start, stop, resumed)`` after each tree
+        block becomes durable (freshly sealed, or loaded from a previous
+        session's seal) — the lifecycle manager uses it to emit
+        ``retrain.block`` events live (docs/resilience.md §8)."""
         p = self.params
         X, _ = extract_features(data, p.features_col, nonfinite=nonfinite)
         total_rows, total_feats = int(X.shape[0]), int(X.shape[1])
@@ -324,6 +338,7 @@ class IsolationForest(_ParamSetters):
                     params=p,
                     resolved=resolved,
                     height=h,
+                    on_block=block_callback,
                 )
             elif mesh is not None:
                 from ..parallel.sharded import sharded_grow_forest
@@ -602,6 +617,29 @@ class IsolationForestModel:
             kwargs["threshold"] = threshold
         self._monitor = ScoreMonitor(self.baseline, **kwargs)
         return self._monitor
+
+    def rebind_monitoring(self, baseline=None):
+        """Re-arm the attached drift monitor against ``baseline`` (default:
+        this model's own) via :meth:`ScoreMonitor.rebind`: folded counts and
+        fired alerts are dropped and the edge-triggered ``drift.alert``
+        re-arms, so a drift episode against the NEW baseline fires again
+        instead of staying latched on the old one. The monitor *object*
+        survives — operator handles from :meth:`enable_monitoring` stay
+        valid across a lifecycle hot-swap (docs/resilience.md §8). Returns
+        the monitor."""
+        monitor = self._monitor
+        if monitor is None:
+            raise ValueError(
+                "no drift monitor attached; call enable_monitoring() first"
+            )
+        target = baseline if baseline is not None else self.baseline
+        if target is None:
+            raise ValueError(
+                "no baseline to rebind to: this model carries none and no "
+                "explicit baseline was given"
+            )
+        monitor.rebind(target)
+        return monitor
 
     def disable_monitoring(self) -> None:
         """Detach the drift monitor (its folded state is discarded)."""

@@ -20,6 +20,18 @@ single dict lookup when no fault is armed):
   :func:`take_distributed_init_failure` — ``fail_distributed_init=<n>``
   makes the first ``n`` bring-up attempts raise (coordinator not up yet /
   port race), proving the retry/backoff schedule end to end;
+* the model lifecycle manager (``lifecycle/manager.py``) -> four seams:
+  :func:`take_retrain_kill` — ``kill_retrain_after_block=<k>`` aborts the
+  background refit once, immediately after refit block ``k`` seals (the
+  preemption-mid-retrain case; one-shot, so the manager's retry/resume
+  loop proves the recovery rather than dying again);
+  :func:`candidate_corrupted` — ``corrupt_candidate`` poisons the refit
+  candidate's float plane before validation (the torn-refit case the
+  gates exist to catch); :func:`check_validation` — ``fail_validation``
+  forces every validation gate run to fail while armed (rollback drill);
+  :func:`check_swap` — ``fail_swap`` raises mid-swap, after the candidate
+  is durably saved but before it reaches the scoring path (the
+  crash-between-save-and-flip case rollback must survive);
 * scoring execution (``ops.traversal.score_matrix``) and the multihost
   worker body -> :func:`maybe_slow_collective` — ``slow_collective`` (all
   strategies), ``slow_collective=<seconds>`` (stall cap) or
@@ -59,6 +71,10 @@ KNOWN_FAULTS = frozenset(
         "hide_native",
         "raise_strategy",
         "kill_fit_after_block",
+        "kill_retrain_after_block",
+        "corrupt_candidate",
+        "fail_validation",
+        "fail_swap",
         "fail_distributed_init",
         "slow_collective",
     }
@@ -182,6 +198,72 @@ def check_fit_block(block_index: int) -> None:
             f"injected fault: fit killed after sealing block {block_index} "
             f"(kill_fit_after_block={value!r}) — resume with "
             "fit(..., resume=True)"
+        )
+
+
+def take_retrain_kill(block_index: int) -> None:
+    """Consume a ``kill_retrain_after_block`` token when it names the refit
+    block that just sealed. ONE-SHOT, unlike :func:`check_fit_block`: a real
+    preemption does not recur deterministically on every retry, and the
+    lifecycle manager's retry/resume loop is exactly what the seam exists to
+    prove — a recurring kill would only prove the retry budget exhausts.
+    Frame-armed values disarm in place; the env form consumes once per
+    process."""
+    for frame in reversed(_STACK):
+        if "kill_retrain_after_block" in frame:
+            value = frame["kill_retrain_after_block"]
+            if value is None or value is False:
+                # consumed (or never-armed) frame: fall through to any outer
+                # armed frame — stacked injects model back-to-back kills
+                continue
+            if int(value) == int(block_index):
+                frame["kill_retrain_after_block"] = False
+                raise FaultInjectedError(
+                    "injected fault: background refit killed after sealing "
+                    f"block {block_index} (kill_retrain_after_block={value!r})"
+                    " — the sealed blocks resume on the next attempt"
+                )
+            return
+    global _ENV_RETRAIN_KILL_CONSUMED
+    value = _parse_env().get("kill_retrain_after_block")
+    if value is None or value is False or _ENV_RETRAIN_KILL_CONSUMED:
+        return
+    if int(value) == int(block_index):
+        _ENV_RETRAIN_KILL_CONSUMED = True
+        raise FaultInjectedError(
+            "injected fault: background refit killed after sealing block "
+            f"{block_index} (kill_retrain_after_block={value!r})"
+        )
+
+
+_ENV_RETRAIN_KILL_CONSUMED = False
+
+
+def candidate_corrupted() -> bool:
+    """True while ``corrupt_candidate`` is armed — the lifecycle manager
+    then poisons the refit candidate's float plane before validation, so
+    the gates (not luck) decide whether garbage reaches the scoring path."""
+    return active("corrupt_candidate")
+
+
+def check_validation() -> None:
+    """Raise :class:`FaultInjectedError` while ``fail_validation`` is armed
+    — forces the candidate-validation gates to fail (the rollback drill)."""
+    if active("fail_validation"):
+        raise FaultInjectedError(
+            "injected fault: candidate validation forced to fail "
+            "(fail_validation) — the manager must roll back to the incumbent"
+        )
+
+
+def check_swap() -> None:
+    """Raise :class:`FaultInjectedError` while ``fail_swap`` is armed — a
+    mid-swap fault landing after the candidate's durable save but before
+    the in-memory flip; the incumbent must keep serving."""
+    if active("fail_swap"):
+        raise FaultInjectedError(
+            "injected fault: model hot-swap forced to fail mid-swap "
+            "(fail_swap) — rolling back to the incumbent"
         )
 
 

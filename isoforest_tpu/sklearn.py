@@ -155,6 +155,52 @@ class TpuIsolationForest(BaseEstimator, OutlierMixin):
         self._check_fitted()
         self.model_.disable_monitoring()
 
+    def rebind_monitoring(self, baseline=None):
+        """Re-arm the attached drift monitor against a (possibly new)
+        baseline — see ``IsolationForestModel.rebind_monitoring``."""
+        self._check_fitted()
+        return self.model_.rebind_monitoring(baseline=baseline)
+
+    def manage(
+        self,
+        work_dir,
+        drift_debounce=3,
+        window_rows=65536,
+        gates=None,
+        **manager_kwargs,
+    ):
+        """Wrap the fitted model in a lifecycle
+        :class:`~isoforest_tpu.lifecycle.ModelManager` (drift-triggered
+        retraining with validation-gated atomic hot-swap,
+        docs/resilience.md §8). The manager knobs pass straight through,
+        mirroring the ``checkpoint_dir``/``nonfinite`` pattern:
+        ``drift_debounce`` (consecutive over-threshold evaluations before a
+        retrain), ``window_rows`` (recent-data reservoir size), ``gates``
+        (a :class:`~isoforest_tpu.lifecycle.ValidationGates`), plus any
+        other ``ModelManager`` keyword. Score through the returned
+        manager (``manager.score``) — after a swap, ``self.model_``
+        tracks the active generation."""
+        self._check_fitted()
+        from .lifecycle import ModelManager
+
+        adapter = self
+
+        class _AdapterTrackingManager(ModelManager):
+            # keep the sklearn facade pointing at the live generation so
+            # score_samples/predict stay coherent after a hot-swap
+            def _swap(self, candidate, seq, target):
+                super()._swap(candidate, seq, target)
+                adapter.model_ = candidate
+
+        return _AdapterTrackingManager(
+            self.model_,
+            work_dir,
+            drift_debounce=drift_debounce,
+            window_rows=window_rows,
+            gates=gates,
+            **manager_kwargs,
+        )
+
     def _check_fitted(self):
         if not hasattr(self, "model_"):
             raise NotFittedError(

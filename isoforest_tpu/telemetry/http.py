@@ -14,9 +14,14 @@ only *reads* registries that are already thread-safe):
   (:func:`~isoforest_tpu.resilience.watchdog.peer_heartbeat_ages`): 200
   while every peer's last heartbeat is younger than ``stale_after_s``,
   503 (with the stale peers named) once any goes quiet. With no heartbeat
-  directory configured it reports plain process liveness (200).
+  directory configured it reports plain process liveness (200). When a
+  :class:`~isoforest_tpu.lifecycle.ModelManager` is live in the process,
+  the payload carries a ``lifecycle`` section — model generation,
+  last-swap timestamp, retrain-in-progress — so an operator can tell a
+  freshly swapped model from a stale one without a Python prompt.
 * ``GET /snapshot`` — the full JSON snapshot (:func:`..export.snapshot`):
-  spans, metrics, the event timeline.
+  spans, metrics, the event timeline, plus the same ``lifecycle`` section
+  when a manager is live.
 
 Start with ``telemetry.serve(port=...)`` (``port=0`` picks an ephemeral
 port, reported on the returned handle) or by exporting
@@ -46,9 +51,21 @@ DEFAULT_STALE_AFTER_S = 15.0
 _INDEX = (
     "isoforest_tpu telemetry endpoint\n"
     "  /metrics   Prometheus text exposition\n"
-    "  /healthz   liveness (heartbeat ages when configured)\n"
+    "  /healthz   liveness (heartbeat ages + lifecycle state when configured)\n"
     "  /snapshot  full JSON telemetry snapshot\n"
 )
+
+
+def _lifecycle_state():
+    """The live ModelManager's state, or None (no manager / import issue —
+    the endpoint must keep serving telemetry either way)."""
+    try:
+        # lazy import: lifecycle imports telemetry at module load
+        from ..lifecycle import state_snapshot
+
+        return state_snapshot()
+    except Exception:
+        return None
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -64,7 +81,15 @@ class _Handler(BaseHTTPRequestHandler):
                 export.to_prometheus(),
             )
         elif path == "/snapshot":
-            self._reply(200, "application/json", export.snapshot_json() + "\n")
+            doc = export.snapshot()
+            state = _lifecycle_state()
+            if state is not None:
+                doc["lifecycle"] = state
+            self._reply(
+                200,
+                "application/json",
+                json.dumps(doc, sort_keys=True) + "\n",
+            )
         elif path in ("/healthz", "/health"):
             payload, healthy = owner.health()
             self._reply(
@@ -159,6 +184,11 @@ class MetricsServer:
             "stale_after_s": self.stale_after_s,
             "heartbeat_dir": self.heartbeat_dir,
         }
+        lifecycle = _lifecycle_state()
+        if lifecycle is not None:
+            # model generation / last-swap timestamp / retrain-in-progress:
+            # a swapped model and a stale one answer /healthz differently
+            payload["lifecycle"] = lifecycle
         return payload, not stale
 
     def stop(self) -> None:

@@ -321,7 +321,6 @@ class ScoreMonitor:
     ) -> None:
         if threshold <= 0:
             raise ValueError(f"threshold must be positive, got {threshold}")
-        self.baseline = baseline
         self.threshold = float(threshold)
         self.feature_threshold = float(
             feature_threshold if feature_threshold is not None else threshold
@@ -331,6 +330,13 @@ class ScoreMonitor:
         self.max_score_rows_per_batch = int(max_score_rows_per_batch)
         self.max_feature_rows_per_batch = int(max_feature_rows_per_batch)
         self._lock = threading.Lock()
+        self._bind(baseline)
+
+    def _bind(self, baseline: Baseline) -> None:
+        """(Re)target the monitor at ``baseline``: fresh fold state, every
+        alert re-armed, per-stream fold/PSI precomputation rebuilt. Callers
+        other than ``__init__`` must hold ``self._lock``."""
+        self.baseline = baseline
         self._score_counts = np.zeros(len(baseline.score.counts), np.int64)
         self._rows = 0
         self._feature_rows = 0
@@ -511,18 +517,26 @@ class ScoreMonitor:
     def reset(self) -> None:
         """Drop folded counts and re-arm every alert (the baseline stays)."""
         with self._lock:
-            self._score_counts[:] = 0
-            if self._uniform:
-                self._feature_counts[:] = 0
-            else:
-                for acc in self._feature_counts:
-                    acc[:] = 0
-            self._rows = 0
-            self._feature_rows = 0
-            self._rows_at_eval = 0
-            self._feature_rows_at_eval = 0
-            self._alerted.clear()
-            self._alerts.clear()
+            self._bind(self.baseline)
+
+    def rebind(self, baseline: Baseline) -> None:
+        """Re-target the monitor at a NEW baseline — the hot-swap companion
+        to :meth:`reset`: after the lifecycle manager replaces the
+        underlying model, the same monitor object keeps serving but
+        compares traffic against the replacement's ``_BASELINE.json``.
+        Folded counts are dropped (they histogram the OLD model's score
+        codomain) and every edge-triggered alert re-arms, so a post-swap
+        drift episode fires a fresh ``drift.alert`` instead of staying
+        latched on the pre-swap one (docs/resilience.md §8)."""
+        if baseline.num_features != self.baseline.num_features:
+            raise ValueError(
+                "rebind baseline has "
+                f"{baseline.num_features} features, monitor was built for "
+                f"{self.baseline.num_features} — a swap may not change the "
+                "serving feature width"
+            )
+        with self._lock:
+            self._bind(baseline)
 
     # ------------------------------------------------------------------ #
 

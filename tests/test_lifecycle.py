@@ -1,0 +1,819 @@
+"""Model lifecycle: drift-triggered retraining with validation-gated atomic
+hot-swap (ISSUE 7, docs/resilience.md §8).
+
+Acceptance matrix:
+  * end-to-end chaos proof on the kddcup covariate-shift fixture: sustained
+    drift triggers a background refit that is killed mid-block, resumes from
+    the sealed blocks, passes validation and atomically swaps — post-swap
+    scores are **bitwise identical** to an uninterrupted refit, and the
+    drift gauges fall back below threshold on re-served traffic;
+  * a forced validation failure rolls back to the incumbent with scores
+    untouched; a mid-swap fault likewise;
+  * swap-under-load: concurrent ``score`` threads during a (deliberately
+    stalled) hot-swap each observe a complete forest — bitwise the old or
+    the new model, never a torn mix;
+  * sliding-window refresh retires the oldest trees and keeps the rest
+    bitwise; validation gates pass/fail the right candidates;
+  * monitor rebind re-arms the edge-triggered alert; HTTP lifecycle state;
+    sklearn + CLI pass-throughs.
+
+Zero real sleeps anywhere: retry backoff runs on FakeClock, the stalled
+swap is event-gated, thread joins are event-based.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from isoforest_tpu import IsolationForest, telemetry
+from isoforest_tpu.lifecycle import (
+    DataReservoir,
+    ModelManager,
+    ValidationGates,
+    retrain_seed,
+    validate_candidate,
+)
+from isoforest_tpu.models.extended import ExtendedIsolationForest
+from isoforest_tpu.resilience import faults
+from isoforest_tpu.resilience.degradation import reset_degradations
+from isoforest_tpu.resilience.retry import RetryPolicy
+
+N_TREES = 12
+BLOCK = 4  # -> 3 refit blocks: the kill can land mid-refit
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    reset_degradations()
+    yield
+    telemetry.reset()
+    reset_degradations()
+
+
+@pytest.fixture(scope="module")
+def kddcup():
+    """kddcup-like training data + a 3-sigma covariate-shifted serving
+    stream (the same shift test_monitor.py proves fires the drift alert)."""
+    from isoforest_tpu.data import kddcup_http_hard
+
+    X, y = kddcup_http_hard(n=20000, seed=7)
+    shifted = X + 3.0 * np.std(X, axis=0, keepdims=True)
+    return X, y, shifted
+
+
+def _fit_incumbent(X):
+    return IsolationForest(
+        num_estimators=N_TREES, max_samples=64.0, random_seed=1
+    ).fit(X)
+
+
+def _manager(model, tmp_path, clock=None, **kw):
+    fc = faults.FakeClock()
+    kw.setdefault("drift_debounce", 2)
+    kw.setdefault("window_rows", 6144)
+    kw.setdefault("min_window_rows", 1024)
+    kw.setdefault("checkpoint_every", BLOCK)
+    kw.setdefault("retry_policy", RetryPolicy(max_attempts=3, base_delay_s=0.25))
+    mgr = ModelManager(
+        model,
+        work_dir=str(tmp_path / "lifecycle"),
+        clock=clock or fc.now,
+        sleep=fc.sleep,
+        **kw,
+    )
+    mgr._fake_clock = fc  # test handle
+    return mgr
+
+
+# --------------------------------------------------------------------------- #
+# end-to-end chaos proof (the acceptance scenario)
+# --------------------------------------------------------------------------- #
+
+
+class TestChaos:
+    def test_drift_kill_resume_validate_swap_bitwise(self, kddcup, tmp_path):
+        X, _, shifted = kddcup
+        model = _fit_incumbent(X)
+        # window = 2 batches: by the time the debounce trips, the reservoir
+        # holds ONLY post-shift traffic, so the refit (and its baseline)
+        # learn the new regime rather than a prelude/shift mixture
+        mgr = _manager(model, tmp_path, background=True, window_rows=2048)
+        try:
+            # in-distribution traffic: no trigger, generation stays 1
+            for i in range(3):
+                mgr.score(X[i * 1024 : (i + 1) * 1024])
+            assert mgr.generation == 1
+            assert mgr.state()["retrains"] == {}
+
+            # sustained covariate shift with a mid-refit kill armed: the
+            # background refit dies after sealing block 1, the retry loop
+            # (FakeClock backoff, zero real sleeps) resumes from the seals
+            with faults.inject(kill_retrain_after_block=1):
+                for i in range(8):
+                    mgr.score(shifted[i * 1024 : (i + 1) * 1024])
+                    if mgr.generation > 1:
+                        break
+                assert mgr.wait_retrain(timeout_s=300)
+            assert mgr.generation == 2
+            assert mgr.state()["retrains"] == {"swapped": 1}
+            # the kill really happened and really resumed: block trail shows
+            # blocks 0/1 grown, then resumed, then block 2 grown fresh
+            trail = [
+                (e.fields["index"], e.fields["resumed"])
+                for e in telemetry.get_events(kind="retrain.block")
+            ]
+            assert (0, False) in trail and (1, False) in trail
+            assert (0, True) in trail and (1, True) in trail
+            assert (2, False) in trail
+            assert [e.kind for e in telemetry.get_events(kind="retry.attempt")]
+            assert mgr._fake_clock.sleeps, "backoff must run on the FakeClock"
+
+            # typed event trail, in causal order
+            kinds = [
+                e.kind
+                for e in telemetry.get_events()
+                if e.kind.startswith("retrain.")
+            ]
+            assert kinds[0] == "retrain.start" and kinds[-1] == "retrain.swap"
+            assert "retrain.validate" in kinds
+            validate = telemetry.get_events(kind="retrain.validate")[-1]
+            assert validate.fields["passed"] is True
+
+            # post-swap scores bitwise-match an UNINTERRUPTED refit on the
+            # same window + per-generation seed
+            info = mgr.last_retrain
+            assert info["outcome"] == "swapped"
+            assert info["seed"] == retrain_seed(model.params.random_seed, 2)
+            comparator = IsolationForest(
+                params=model.params.replace(random_seed=info["seed"])
+            ).fit(info["window"])
+            probe = shifted[:2048]
+            assert np.array_equal(
+                mgr.model.score(probe), comparator.score(probe)
+            ), "killed+resumed refit must be bitwise-identical to uninterrupted"
+
+            # gauges: generation bumped, drift falls back below threshold on
+            # re-served post-shift traffic (the monitor rebound to the new
+            # _BASELINE.json)
+            assert telemetry.gauge("isoforest_model_generation").value() == 2.0
+            for i in range(4):
+                mgr.score(shifted[i * 1024 : (i + 1) * 1024])
+            psi = mgr.monitor.drift()["score"]["psi"]
+            assert psi < mgr.monitor.threshold
+            assert (
+                telemetry.gauge("isoforest_score_drift_psi").value()
+                < mgr.monitor.threshold
+            )
+
+            # the swap is durable: gen dir sealed + CURRENT pointer flipped
+            current = json.load(
+                open(os.path.join(mgr.work_dir, "CURRENT.json"))
+            )
+            assert current["generation"] == 2
+            assert os.path.exists(
+                os.path.join(current["path"], "_MANIFEST.json")
+            )
+            from isoforest_tpu import IsolationForestModel
+
+            reloaded = IsolationForestModel.load(current["path"])
+            assert np.array_equal(reloaded.score(probe), mgr.model.score(probe))
+
+            counter = telemetry.counter(
+                "isoforest_retrain_total", labelnames=("outcome",)
+            )
+            assert counter.value(outcome="swapped") == 1.0
+        finally:
+            mgr.close()
+
+    def test_forced_validation_failure_rolls_back(self, kddcup, tmp_path):
+        X, _, shifted = kddcup
+        model = _fit_incumbent(X)
+        mgr = _manager(model, tmp_path, background=False)
+        try:
+            probe = shifted[:2048]
+            before = model.score(probe)
+            with faults.inject(fail_validation=True):
+                for i in range(8):
+                    mgr.score(shifted[i * 1024 : (i + 1) * 1024])
+                    if mgr.state()["retrains"]:
+                        break
+            state = mgr.state()
+            assert state["generation"] == 1
+            assert state["retrains"] == {"validation_failed": 1}
+            assert mgr.model is model, "incumbent must keep serving"
+            assert np.array_equal(model.score(probe), before), "scores untouched"
+            rollback = telemetry.get_events(kind="retrain.rollback")[-1]
+            assert rollback.fields["reason"] == "validation_failed"
+            assert "fault_injected" in rollback.fields["failed_gates"]
+            assert not os.path.exists(
+                os.path.join(mgr.work_dir, "gen-00002")
+            ), "a rejected candidate must not leave a generation dir"
+            counter = telemetry.counter(
+                "isoforest_retrain_total", labelnames=("outcome",)
+            )
+            assert counter.value(outcome="validation_failed") == 1.0
+        finally:
+            mgr.close()
+
+    def test_corrupt_candidate_is_caught_by_gates(self, kddcup, tmp_path):
+        X, _, shifted = kddcup
+        model = _fit_incumbent(X)
+        mgr = _manager(model, tmp_path, background=False)
+        try:
+            with faults.inject(corrupt_candidate=True):
+                for i in range(8):
+                    mgr.score(shifted[i * 1024 : (i + 1) * 1024])
+                    if mgr.state()["retrains"]:
+                        break
+            assert mgr.generation == 1
+            assert mgr.state()["retrains"] == {"validation_failed": 1}
+            failed = mgr.last_validation.failed_gates()
+            assert "baseline_sanity" in failed or "finite" in failed
+        finally:
+            mgr.close()
+
+    def test_mid_swap_fault_rolls_back(self, kddcup, tmp_path):
+        X, _, shifted = kddcup
+        model = _fit_incumbent(X)
+        mgr = _manager(model, tmp_path, background=False)
+        try:
+            probe = shifted[:1024]
+            before = model.score(probe)
+            with faults.inject(fail_swap=True):
+                for i in range(8):
+                    mgr.score(shifted[i * 1024 : (i + 1) * 1024])
+                    if mgr.state()["retrains"]:
+                        break
+            assert mgr.generation == 1
+            assert mgr.state()["retrains"] == {"swap_failed": 1}
+            assert mgr.model is model
+            assert np.array_equal(model.score(probe), before)
+            assert not os.path.exists(os.path.join(mgr.work_dir, "gen-00002"))
+            rollback = telemetry.get_events(kind="retrain.rollback")[-1]
+            assert rollback.fields["reason"] == "swap_failed"
+            # the next episode is not poisoned: with the fault gone a manual
+            # retrain swaps cleanly
+            assert mgr.retrain(reason="after_fault") == "swapped"
+            assert mgr.generation == 2
+        finally:
+            mgr.close()
+
+    def test_retrain_error_after_exhausted_retries(self, kddcup, tmp_path):
+        """A kill that recurs on EVERY attempt (the env/manual analogue of a
+        persistently failing refit) exhausts the retry budget and lands the
+        error outcome — still with zero real sleeps."""
+        X, _, shifted = kddcup
+        model = _fit_incumbent(X)
+        mgr = _manager(
+            model,
+            tmp_path,
+            background=False,
+            auto_retrain=False,
+            retry_policy=RetryPolicy(max_attempts=2, base_delay_s=0.25),
+        )
+        try:
+            for i in range(6):
+                mgr.score(shifted[i * 1024 : (i + 1) * 1024])
+            # arm two one-shot kills back to back: each attempt consumes one
+            with faults.inject(kill_retrain_after_block=0):
+                with faults.inject(kill_retrain_after_block=0):
+                    # inner frame consumed by attempt 1, outer by attempt 2
+                    assert mgr.retrain(reason="doomed") == "error"
+            assert mgr.generation == 1
+            assert mgr.state()["retrains"] == {"error": 1}
+            assert mgr.state()["last_error"] is not None
+            assert telemetry.get_events(kind="retry.exhausted")
+            assert mgr._fake_clock.sleeps  # backoff ran virtually
+            # recovery: next manual retrain succeeds
+            assert mgr.retrain(reason="recovery") == "swapped"
+        finally:
+            mgr.close()
+
+
+# --------------------------------------------------------------------------- #
+# swap under load: no torn forests, ever
+# --------------------------------------------------------------------------- #
+
+
+class TestSwapUnderLoad:
+    def test_concurrent_scores_see_old_or_new_never_torn(self, kddcup, tmp_path):
+        """8 scorer threads hammer ``manager.score`` while a hot-swap is
+        stalled mid-flight (fault-injected slow swap via the ``mid_swap``
+        hook): every result must be bitwise one of the two complete models'
+        outputs. Event-gated — zero real sleeps."""
+        X, _, shifted = kddcup
+        model = _fit_incumbent(X)
+        swap_entered = threading.Event()
+        swap_release = threading.Event()
+
+        def slow_swap():
+            swap_entered.set()
+            assert swap_release.wait(timeout=300)
+
+        mgr = _manager(
+            model,
+            tmp_path,
+            background=True,
+            auto_retrain=False,
+            hooks={"mid_swap": slow_swap},
+        )
+        try:
+            probe = np.ascontiguousarray(shifted[:512])
+            old_scores = model.score(probe)
+            for i in range(6):
+                mgr.score(shifted[i * 1024 : (i + 1) * 1024])
+            assert mgr.retrain(reason="load_test", wait=False)
+
+            assert swap_entered.wait(timeout=300)
+            # the swap is now stalled between its durable save and the flip
+            results = []
+            errors = []
+            go = threading.Barrier(9)
+
+            def scorer():
+                try:
+                    go.wait(timeout=300)
+                    for _ in range(4):
+                        results.append(mgr.score(probe))
+                except Exception as exc:  # surfaced below
+                    errors.append(exc)
+
+            threads = [threading.Thread(target=scorer) for _ in range(8)]
+            for t in threads:
+                t.start()
+            go.wait(timeout=300)
+            swap_release.set()
+            for t in threads:
+                t.join(timeout=300)
+            assert mgr.wait_retrain(timeout_s=300)
+            assert not errors, errors
+            assert mgr.generation == 2
+
+            new_scores = mgr.model.score(probe)
+            assert not np.array_equal(old_scores, new_scores)
+            torn = [
+                r
+                for r in results
+                if not (
+                    np.array_equal(r, old_scores) or np.array_equal(r, new_scores)
+                )
+            ]
+            assert len(results) == 32
+            assert not torn, f"{len(torn)} scorer result(s) saw a torn forest"
+        finally:
+            swap_release.set()
+            mgr.close()
+
+
+# --------------------------------------------------------------------------- #
+# sliding-window refresh
+# --------------------------------------------------------------------------- #
+
+
+class TestSlidingWindow:
+    @pytest.mark.parametrize("kind", ["std", "ext"])
+    def test_refresh_retires_oldest_and_keeps_rest_bitwise(
+        self, kddcup, tmp_path, kind
+    ):
+        X, _, shifted = kddcup
+        if kind == "ext":
+            model = ExtendedIsolationForest(
+                num_estimators=N_TREES,
+                max_samples=64.0,
+                extension_level=2,
+                random_seed=1,
+            ).fit(X)
+        else:
+            model = _fit_incumbent(X)
+        before = {
+            f: np.asarray(getattr(model.forest, f)).copy()
+            for f in model.forest._fields
+        }
+        mgr = _manager(
+            model,
+            tmp_path,
+            background=False,
+            mode="sliding",
+            sliding_fraction=0.5,
+        )
+        try:
+            for i in range(6):
+                mgr.score(shifted[i * 1024 : (i + 1) * 1024])
+            assert mgr.generation == 2, mgr.state()
+            swapped = mgr.model
+            assert swapped.forest.num_trees == N_TREES
+            replaced = N_TREES // 2
+            for f in before:
+                after = np.asarray(getattr(swapped.forest, f))
+                # surviving trees are the incumbent's NEWEST, bitwise
+                assert np.array_equal(after[: N_TREES - replaced], before[f][replaced:]), f
+                if f in ("threshold", "weights", "offset"):
+                    # the refreshed tail is genuinely new growth
+                    assert not np.array_equal(
+                        after[N_TREES - replaced :], before[f][:replaced]
+                    )
+            # normalisation stayed coherent: same num_samples, sane scores
+            assert swapped.num_samples == model.num_samples
+            scores = mgr.model.score(shifted[:1024])
+            assert np.isfinite(scores).all()
+            assert (scores >= 0).all() and (scores <= 1).all()
+            # drift vs the refreshed baseline is back under threshold
+            for i in range(4):
+                mgr.score(shifted[i * 1024 : (i + 1) * 1024])
+            assert mgr.monitor.drift()["score"]["psi"] < mgr.monitor.threshold
+            block = telemetry.get_events(kind="retrain.block")[-1]
+            assert block.fields.get("sliding") is True
+            assert block.fields["retired_trees"] == replaced
+        finally:
+            mgr.close()
+
+    def test_small_window_falls_back_to_full_refit(self, tmp_path):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(4000, 3)).astype(np.float32)
+        model = IsolationForest(
+            num_estimators=8, max_samples=256.0, random_seed=1
+        ).fit(X)
+        mgr = _manager(
+            model,
+            tmp_path,
+            background=False,
+            mode="sliding",
+            window_rows=128,  # < num_samples=256: sliding cannot bag
+            min_window_rows=64,
+        )
+        try:
+            shifted = X + 4.0
+            for i in range(30):
+                mgr.score(shifted[i * 128 : (i + 1) * 128])
+                if mgr.generation > 1:
+                    break
+            assert mgr.generation == 2
+            # full-refit fallback re-resolved numSamples to the window
+            assert mgr.model.num_samples <= 128
+        finally:
+            mgr.close()
+
+
+# --------------------------------------------------------------------------- #
+# debounce, reservoir, validation units
+# --------------------------------------------------------------------------- #
+
+
+class TestDebounce:
+    def test_single_alert_edge_does_not_trigger(self, kddcup, tmp_path):
+        """One over-threshold evaluation is an edge, not sustained drift."""
+        X, _, shifted = kddcup
+        model = _fit_incumbent(X)
+        mgr = _manager(model, tmp_path, background=False, drift_debounce=4)
+        try:
+            mgr.score(shifted[:1024])  # alert fires, debounce at 1/4
+            assert telemetry.get_events(kind="drift.alert")
+            assert mgr.state()["consecutive_over_threshold"] == 1
+            assert mgr.generation == 1 and not mgr.state()["retrains"]
+        finally:
+            mgr.close()
+
+    def test_recovered_drift_resets_the_count(self, kddcup, tmp_path):
+        X, _, shifted = kddcup
+        model = _fit_incumbent(X)
+        mgr = _manager(
+            model, tmp_path, background=False, drift_debounce=3, auto_retrain=False
+        )
+        try:
+            mgr.score(shifted[:1024])
+            assert mgr.state()["consecutive_over_threshold"] == 1
+            # flood with in-distribution traffic until PSI recovers
+            for i in range(12):
+                mgr.score(X[i * 1024 : (i + 1) * 1024])
+            assert mgr.state()["consecutive_over_threshold"] == 0
+            assert not mgr.state()["retrains"]
+        finally:
+            mgr.close()
+
+    def test_manager_requires_baseline(self, tmp_path):
+        X = np.random.default_rng(0).normal(size=(600, 3)).astype(np.float32)
+        model = IsolationForest(num_estimators=4, random_seed=1).fit(
+            X, baseline=False
+        )
+        with pytest.raises(ValueError, match="baseline"):
+            ModelManager(model, str(tmp_path / "lc"))
+
+    def test_knob_validation(self, kddcup, tmp_path):
+        X, _, _ = kddcup
+        model = _fit_incumbent(X)
+        with pytest.raises(ValueError, match="mode"):
+            ModelManager(model, str(tmp_path / "a"), mode="weekly")
+        with pytest.raises(ValueError, match="drift_debounce"):
+            ModelManager(model, str(tmp_path / "b"), drift_debounce=0)
+        with pytest.raises(ValueError, match="sliding_fraction"):
+            ModelManager(model, str(tmp_path / "c"), sliding_fraction=0.0)
+        model.disable_monitoring()
+
+
+class TestReservoir:
+    def test_fifo_window_and_width_checks(self):
+        r = DataReservoir(capacity=5)
+        r.fold(np.arange(8, dtype=np.float32).reshape(4, 2))
+        r.fold(np.arange(8, 16, dtype=np.float32).reshape(4, 2))
+        X, y = r.snapshot()
+        assert X.shape == (5, 2) and y is None
+        assert np.array_equal(X[-1], [14.0, 15.0])  # newest kept
+        assert np.array_equal(X[0], [6.0, 7.0])  # oldest evicted
+        with pytest.raises(ValueError, match="width"):
+            r.fold(np.zeros((2, 3), np.float32))
+        with pytest.raises(ValueError, match="capacity"):
+            DataReservoir(0)
+
+    def test_labels_ride_along_until_an_unlabeled_batch(self):
+        r = DataReservoir(capacity=10)
+        r.fold(np.zeros((4, 2), np.float32), np.array([0, 1, 0, 1]))
+        _, y = r.snapshot()
+        assert np.array_equal(y, [0, 1, 0, 1])
+        r.fold(np.zeros((2, 2), np.float32))  # unlabeled batch
+        _, y = r.snapshot()
+        assert y is None  # a partial label track would misalign AUROC
+
+
+class TestValidation:
+    def test_identical_model_passes_all_gates(self, kddcup):
+        X, y, _ = kddcup
+        model = _fit_incumbent(X)
+        result = validate_candidate(model, model, X[:4096], y[:4096])
+        assert result.passed
+        names = [g.name for g in result.gates]
+        assert names == ["finite", "score_parity", "baseline_sanity", "auroc"]
+        parity = result.gates[1]
+        assert parity.value == 0.0
+        model.disable_monitoring()
+
+    def test_unlabeled_window_skips_auroc(self, kddcup):
+        X, _, _ = kddcup
+        model = _fit_incumbent(X)
+        result = validate_candidate(model, model, X[:2048], None)
+        assert "auroc" not in [g.name for g in result.gates]
+
+    def test_baselineless_candidate_fails(self, kddcup):
+        X, _, _ = kddcup
+        incumbent = _fit_incumbent(X)
+        candidate = IsolationForest(
+            num_estimators=N_TREES, max_samples=64.0, random_seed=2
+        ).fit(X, baseline=False)
+        result = validate_candidate(incumbent, candidate, X[:2048])
+        assert not result.passed
+        assert result.failed_gates() == ("baseline_sanity",)
+
+    def test_degenerate_candidate_fails_psi_gate(self, kddcup):
+        """A poisoned candidate (constant scores) slips the loose parity
+        bound but cannot slip the PSI-vs-own-baseline gate."""
+        import jax.numpy as jnp
+
+        X, _, _ = kddcup
+        incumbent = _fit_incumbent(X)
+        candidate = IsolationForest(
+            num_estimators=N_TREES, max_samples=64.0, random_seed=2
+        ).fit(X)
+        nan_thr = np.full_like(np.asarray(candidate.forest.threshold), np.nan)
+        candidate.forest = candidate.forest._replace(threshold=jnp.asarray(nan_thr))
+        candidate._scoring_layout = None
+        candidate.finalize_scoring()
+        result = validate_candidate(incumbent, candidate, X[:2048])
+        assert not result.passed
+        assert "baseline_sanity" in result.failed_gates()
+
+    def test_gate_bounds_validate(self):
+        with pytest.raises(ValueError, match="positive"):
+            ValidationGates(max_score_delta=0.0)
+        with pytest.raises(ValueError, match="median_band"):
+            ValidationGates(median_band=(0.9, 0.1))
+
+
+# --------------------------------------------------------------------------- #
+# monitor rebind (the satellite fix)
+# --------------------------------------------------------------------------- #
+
+
+class TestMonitorRebind:
+    def test_rebind_rearms_edge_triggered_alert(self, kddcup):
+        X, _, shifted = kddcup
+        model = _fit_incumbent(X)
+        monitor = model.enable_monitoring(threshold=0.25, min_rows=256)
+        try:
+            model.score(shifted[:2048])
+            assert len(telemetry.get_events(kind="drift.alert")) >= 1
+            first_alerts = len(monitor.report()["alerts"])
+            model.score(shifted[:2048])  # latched: no second event
+            assert len(monitor.report()["alerts"]) == first_alerts
+
+            # refit on the shifted regime, rebind the SAME monitor object to
+            # the refit's baseline and ride it over to the refit model (the
+            # lifecycle hot-swap pattern)
+            refit = IsolationForest(
+                num_estimators=N_TREES, max_samples=64.0, random_seed=5
+            ).fit(shifted)
+            rebound = model.rebind_monitoring(refit.baseline)
+            assert rebound is monitor
+            assert monitor.rows == 0 and not monitor.report()["drifted"]
+            batch = shifted[:2048]
+            monitor.observe(refit.score(batch), batch)  # in-dist vs NEW baseline
+            assert not monitor.report()["drifted"]
+
+            # a fresh episode vs the new baseline fires AGAIN (not latched)
+            before = len(telemetry.get_events(kind="drift.alert"))
+            again = batch + 4.0 * np.std(shifted, axis=0)
+            monitor.observe(refit.score(again), again)
+            assert len(telemetry.get_events(kind="drift.alert")) > before
+        finally:
+            model.disable_monitoring()
+
+    def test_rebind_requires_attached_monitor_and_width_match(self, kddcup):
+        X, _, _ = kddcup
+        model = _fit_incumbent(X)
+        with pytest.raises(ValueError, match="enable_monitoring"):
+            model.rebind_monitoring()
+        monitor = model.enable_monitoring()
+        try:
+            from isoforest_tpu.telemetry.monitor import capture_baseline
+
+            rng = np.random.default_rng(0)
+            narrow = capture_baseline(rng.random(600), rng.normal(size=(600, 2)))
+            with pytest.raises(ValueError, match="feature"):
+                monitor.rebind(narrow)
+        finally:
+            model.disable_monitoring()
+
+
+# --------------------------------------------------------------------------- #
+# HTTP lifecycle state + sklearn + CLI pass-throughs
+# --------------------------------------------------------------------------- #
+
+
+def _get(url: str):
+    try:
+        resp = urllib.request.urlopen(url, timeout=10)
+        return resp.status, resp.read().decode("utf-8")
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode("utf-8")
+
+
+class TestHttpState:
+    def test_healthz_and_snapshot_carry_lifecycle_state(self, kddcup, tmp_path):
+        X, _, shifted = kddcup
+        model = _fit_incumbent(X)
+        mgr = _manager(model, tmp_path, background=False)
+        server = telemetry.serve(port=0)
+        try:
+            status, body = _get(server.url + "/healthz")
+            assert status == 200
+            state = json.loads(body)["lifecycle"]
+            assert state["generation"] == 1
+            assert state["retrain_in_progress"] is False
+            assert state["last_swap_unix_s"] is None
+
+            for i in range(6):
+                mgr.score(shifted[i * 1024 : (i + 1) * 1024])
+                if mgr.generation > 1:
+                    break
+            assert mgr.generation == 2
+            status, body = _get(server.url + "/healthz")
+            state = json.loads(body)["lifecycle"]
+            assert state["generation"] == 2
+            assert state["last_swap_unix_s"] is not None
+            assert state["retrains"] == {"swapped": 1}
+
+            status, body = _get(server.url + "/snapshot")
+            snap = json.loads(body)
+            assert snap["lifecycle"]["generation"] == 2
+            assert "isoforest_model_generation" in snap["metrics"]
+
+            mgr.close()
+            status, body = _get(server.url + "/healthz")
+            assert "lifecycle" not in json.loads(body)
+        finally:
+            server.stop()
+            mgr.close()
+
+
+class TestSklearnAdapter:
+    def test_manage_pass_through_tracks_swaps(self, kddcup, tmp_path):
+        from isoforest_tpu.sklearn import TpuIsolationForest
+
+        X, _, shifted = kddcup
+        est = TpuIsolationForest(
+            n_estimators=N_TREES, max_samples=64.0, random_state=1
+        ).fit(X)
+        fc = faults.FakeClock()
+        mgr = est.manage(
+            str(tmp_path / "lc"),
+            drift_debounce=2,
+            window_rows=6144,
+            gates=ValidationGates(max_score_delta=0.5),
+            min_window_rows=1024,
+            checkpoint_every=BLOCK,
+            background=False,
+            clock=fc.now,
+            sleep=fc.sleep,
+        )
+        try:
+            assert mgr.gates.max_score_delta == 0.5
+            assert mgr.drift_debounce == 2
+            incumbent = est.model_
+            for i in range(6):
+                mgr.score(shifted[i * 1024 : (i + 1) * 1024])
+                if mgr.generation > 1:
+                    break
+            assert mgr.generation == 2
+            # the sklearn facade follows the active generation
+            assert est.model_ is mgr.model and est.model_ is not incumbent
+            assert np.isfinite(est.score_samples(shifted[:256])).all()
+        finally:
+            mgr.close()
+
+
+class TestCli:
+    @pytest.fixture(scope="class")
+    def model_and_csv(self, tmp_path_factory):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(4000, 3)).astype(np.float32)
+        X[:60] += 5.0
+        root = tmp_path_factory.mktemp("lifecycle-cli")
+        csv = root / "data.csv"
+        np.savetxt(csv, X, delimiter=",")
+        shifted = root / "shifted.csv"
+        np.savetxt(shifted, X + 3.0 * np.std(X, axis=0, keepdims=True), delimiter=",")
+        model_dir = root / "model"
+        IsolationForest(num_estimators=N_TREES, random_seed=1).fit(X).save(
+            str(model_dir)
+        )
+        return str(model_dir), str(csv), str(shifted), str(root)
+
+    def test_manage_swaps_on_drifted_csv(self, model_and_csv, capsys):
+        from isoforest_tpu.__main__ import main
+
+        model_dir, _, shifted, root = model_and_csv
+        rc = main(
+            [
+                "manage",
+                model_dir,
+                "--input",
+                shifted,
+                "--work-dir",
+                os.path.join(root, "lc"),
+                "--debounce",
+                "1",
+                "--chunk-rows",
+                "2000",
+                "--min-window-rows",
+                "512",
+                "--window-rows",
+                "4096",
+            ]
+        )
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["generation"] == 2
+        assert summary["retrains"] == {"swapped": 1}
+        assert summary["rows"] == 4000
+        assert summary["drift"]["score"]["psi"] < 0.25
+        assert summary["last_validation"]["passed"] is True
+        current = json.load(open(os.path.join(root, "lc", "CURRENT.json")))
+        assert current["generation"] == 2
+
+    def test_manage_stays_quiet_in_distribution(self, model_and_csv, capsys):
+        from isoforest_tpu.__main__ import main
+
+        model_dir, csv, _, root = model_and_csv
+        rc = main(
+            [
+                "manage",
+                model_dir,
+                "--input",
+                csv,
+                "--work-dir",
+                os.path.join(root, "lc-quiet"),
+                "--chunk-rows",
+                "1000",
+            ]
+        )
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["generation"] == 1
+        assert summary["retrains"] == {}
+
+    def test_manage_refuses_legacy_model(self, tmp_path, capsys):
+        from isoforest_tpu.__main__ import main
+
+        X = np.random.default_rng(1).normal(size=(600, 3)).astype(np.float32)
+        model = IsolationForest(num_estimators=4, random_seed=1).fit(
+            X, baseline=False
+        )
+        model_dir = str(tmp_path / "legacy")
+        model.save(model_dir)
+        csv = str(tmp_path / "d.csv")
+        np.savetxt(csv, X, delimiter=",")
+        assert main(["manage", model_dir, "--input", csv]) == 2
