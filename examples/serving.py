@@ -66,6 +66,26 @@ def main() -> None:
             f"({batch / per_batch_ms * 1e3:,.0f} rows/s)"
         )
 
+    # --- the real thing: POST /score with micro-batch coalescing ---
+    # (docs/serving.md; `python -m isoforest_tpu serve` is the CLI form)
+    import json
+    import urllib.request
+
+    from isoforest_tpu.serving import serve_model
+
+    with serve_model(model_dir, port=0, lifecycle=False) as handle:
+        req = urllib.request.Request(
+            handle.url + "/score",
+            data=json.dumps({"row": [float(v) for v in X[0]]}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        doc = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        assert doc["scores"][0] == float(model.score(X[:1])[0])  # bitwise
+        print(
+            f"live endpoint {handle.url}/score: score={doc['scores'][0]:.6f} "
+            f"(bitwise-equal to model.score)"
+        )
+
     # --- portable artifact: ONNX export + independent structural check ---
     from isoforest_tpu.onnx import check_model, convert_and_save
     from isoforest_tpu.onnx.runtime import run_model

@@ -9,7 +9,7 @@ sharding over a `jax.sharding.Mesh`.
 
 __version__ = "0.6.0"
 
-from . import lifecycle, ops, parallel, resilience, telemetry, utils  # noqa: F401
+from . import lifecycle, ops, parallel, resilience, serving, telemetry, utils  # noqa: F401
 from .models import (
     ExtendedIsolationForest,
     ExtendedIsolationForestModel,

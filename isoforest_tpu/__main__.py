@@ -20,6 +20,9 @@ operational surface here is a small CLI over CSV files:
     python -m isoforest_tpu autotune [--format json|table] [--clear] \\
         [--warm --input data.csv [--model /tmp/model] \\
          --batch-sizes 1024,65536 [--refresh]]
+    python -m isoforest_tpu serve /tmp/model --port 9100 \\
+        [--batch-rows 1024] [--linger-ms 2] [--max-queue-rows 8192] \\
+        [--queue-deadline-ms 2000] [--no-lifecycle] [--max-seconds N]
 
 CSV rows are feature columns; ``--labeled`` treats the last column as a label
 (excluded from features; used to report AUROC after fit/score).
@@ -61,12 +64,9 @@ def _auroc(scores, labels) -> float:
 
 
 def _load_model(path: str):
-    from .io.persistence import EXTENDED_MODEL_CLASS, _read_metadata
-    from .models import ExtendedIsolationForestModel, IsolationForestModel
+    from .io.persistence import load_model
 
-    if _read_metadata(path).get("class") == EXTENDED_MODEL_CLASS:
-        return ExtendedIsolationForestModel.load(path)
-    return IsolationForestModel.load(path)
+    return load_model(path)
 
 
 def cmd_fit(args) -> int:
@@ -330,6 +330,77 @@ def cmd_manage(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Serve ``POST /score`` (docs/serving.md): load the model, wrap it in
+    the lifecycle manager when it carries a drift baseline (resuming the
+    last swapped generation from ``CURRENT.json``), mount the scoring
+    endpoint with dynamic micro-batch coalescing on the telemetry HTTP
+    server, pre-warm the autotuned batch buckets, print one JSON ready
+    line, and serve until SIGTERM/SIGINT (or ``--max-seconds``)."""
+    import signal
+    import threading
+
+    from .serving import ServingConfig, serve_model
+
+    config = ServingConfig(
+        batch_rows=args.batch_rows,
+        linger_ms=args.linger_ms,
+        max_queue_rows=args.max_queue_rows,
+        queue_deadline_ms=args.queue_deadline_ms,
+        request_timeout_s=args.request_timeout_s,
+        score_timeout_s=args.score_timeout_s,
+    )
+    warm = sorted({int(s) for s in args.warm_batch_sizes.split(",") if s})
+    manager_kwargs = {
+        "drift_debounce": args.debounce,
+        "window_rows": args.window_rows,
+        "min_window_rows": args.min_window_rows,
+        "mode": args.mode,
+        "monitor_kwargs": {"min_rows": args.min_rows},
+    }
+    if args.threshold is not None:
+        manager_kwargs["monitor_threshold"] = args.threshold
+    handle = serve_model(
+        args.model_dir,
+        port=args.port,
+        host=args.host,
+        config=config,
+        lifecycle=not args.no_lifecycle,
+        work_dir=args.work_dir,
+        warm_batch_sizes=warm or (1,),
+        manager_kwargs=manager_kwargs,
+    )
+    stop = threading.Event()
+    try:
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    except ValueError:
+        pass  # not the main thread (in-process tests drive stop themselves)
+    print(
+        json.dumps(
+            {
+                "serving": True,
+                "url": handle.url,
+                "endpoint": handle.url + "/score",
+                "model": args.model_dir,
+                "lifecycle": handle.manager is not None,
+                "generation": (
+                    handle.manager.generation if handle.manager is not None else None
+                ),
+                "batch_rows": config.batch_rows,
+                "linger_ms": config.linger_ms,
+            }
+        ),
+        flush=True,
+    )
+    try:
+        stop.wait(args.max_seconds)  # None waits until SIGTERM/SIGINT
+    except KeyboardInterrupt:
+        pass
+    finally:
+        handle.close()
+    return 0
+
+
 def cmd_autotune(args) -> int:
     """Operate the measured strategy autotuner's persisted cost model
     (docs/autotune.md): dump the winner table (default; ``--format json``
@@ -540,6 +611,92 @@ def build_parser() -> argparse.ArgumentParser:
         "while scoring (0 = ephemeral)",
     )
     man.set_defaults(func=cmd_manage)
+
+    srv = sub.add_parser(
+        "serve",
+        help="serve POST /score with dynamic micro-batch coalescing",
+    )
+    srv.add_argument("model_dir")
+    srv.add_argument("--host", default="127.0.0.1")
+    srv.add_argument(
+        "--port",
+        type=int,
+        default=0,
+        help="HTTP port for /score + /metrics + /healthz (0 = ephemeral, "
+        "reported on the ready line)",
+    )
+    srv.add_argument(
+        "--batch-rows",
+        type=int,
+        default=1024,
+        help="coalescer flush size — keep it a power-of-two batch bucket "
+        "so flushes land on the pre-warmed autotuned shapes",
+    )
+    srv.add_argument(
+        "--linger-ms",
+        type=float,
+        default=2.0,
+        help="max time the oldest queued request waits for company before "
+        "its flush goes out (the tail-latency bound)",
+    )
+    srv.add_argument(
+        "--max-queue-rows",
+        type=int,
+        default=8192,
+        help="admission queue bound; a request past it gets HTTP 429",
+    )
+    srv.add_argument(
+        "--queue-deadline-ms",
+        type=float,
+        default=2000.0,
+        help="once the oldest queued request is older than this the "
+        "service answers HTTP 503 (not draining)",
+    )
+    srv.add_argument(
+        "--request-timeout-s",
+        type=float,
+        default=30.0,
+        help="per-request wait budget (queue + scoring) before a 503",
+    )
+    srv.add_argument(
+        "--score-timeout-s",
+        type=float,
+        default=None,
+        help="arm the scoring watchdog per coalesced flush "
+        "(docs/resilience.md §6 degradation ladder)",
+    )
+    srv.add_argument(
+        "--warm-batch-sizes",
+        default="1",
+        help="comma-separated batch sizes to pre-warm at startup (always "
+        "includes --batch-rows; bucketed power-of-two)",
+    )
+    srv.add_argument(
+        "--no-lifecycle",
+        action="store_true",
+        help="serve the bare model even when it carries a drift baseline "
+        "(no monitoring, no retraining, no hot-swap)",
+    )
+    srv.add_argument(
+        "--work-dir",
+        default=None,
+        help="lifecycle artifact dir (default: <model_dir>.lifecycle); "
+        "CURRENT.json there resumes the last swapped generation",
+    )
+    srv.add_argument("--threshold", type=float, default=None)
+    srv.add_argument("--debounce", type=int, default=3)
+    srv.add_argument("--window-rows", type=int, default=65536)
+    srv.add_argument("--min-window-rows", type=int, default=1024)
+    srv.add_argument("--min-rows", type=int, default=512)
+    srv.add_argument("--mode", choices=("full", "sliding"), default="full")
+    srv.add_argument(
+        "--max-seconds",
+        type=float,
+        default=None,
+        help="exit after this many seconds (default: serve until "
+        "SIGTERM/SIGINT) — CI smoke runs use it with `timeout`",
+    )
+    srv.set_defaults(func=cmd_serve)
 
     at = sub.add_parser(
         "autotune",

@@ -479,6 +479,22 @@ def _read_metadata(path: str) -> dict:
         return json.loads(fh.readline())
 
 
+def load_model(path: str, **load_kwargs):
+    """Load a saved model directory as the right model class, dispatched on
+    the metadata ``class`` field (standard vs extended) — the one loader
+    every operational entry point (CLI, serving, lifecycle resume) shares.
+    ``load_kwargs`` forward to the class ``load`` (``verify``,
+    ``on_corrupt``, ``require_success``)."""
+    from ..models import ExtendedIsolationForestModel, IsolationForestModel
+
+    cls = (
+        ExtendedIsolationForestModel
+        if _read_metadata(path).get("class") == EXTENDED_MODEL_CLASS
+        else IsolationForestModel
+    )
+    return cls.load(path, **load_kwargs)
+
+
 def _data_part_path(path: str) -> str:
     """Spark-layout framing shared by both save paths: data dir + single
     part file; caller writes it, then :func:`_mark_success` seals it."""

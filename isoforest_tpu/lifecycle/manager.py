@@ -138,7 +138,12 @@ class ModelManager:
     is provable with zero real sleeps. ``hooks`` is a test seam: a
     ``"mid_swap"`` callable runs after the candidate's durable save but
     before the in-memory flip (the slow-swap injection point for the
-    swap-under-load proof).
+    swap-under-load proof). ``resume=True`` (default) checks
+    ``work_dir/CURRENT.json`` at construction and, when it points at a
+    sealed swapped generation, serves THAT model (at its recorded
+    generation) instead of the one passed in — a restarted process picks up
+    where the last one swapped; ``resume=False`` always starts from the
+    given model at generation 1.
     """
 
     def __init__(
@@ -161,6 +166,7 @@ class ModelManager:
         sleep: Callable[[float], None] = time.sleep,
         monitor_kwargs: Optional[dict] = None,
         hooks: Optional[Dict[str, Callable[[], None]]] = None,
+        resume: bool = True,
     ) -> None:
         if model.baseline is None:
             raise ValueError(
@@ -207,14 +213,71 @@ class ModelManager:
         self._retraining = False
         self._retrain_thread: Optional[threading.Thread] = None
         self._outcomes: Dict[str, int] = {}
+        if resume:
+            # a restarted serve/manage process picks up the last swapped
+            # generation from CURRENT.json instead of the seed model
+            self._resume_from_current()
         kwargs = dict(monitor_kwargs or {})
         if monitor_threshold is not None:
             kwargs["threshold"] = monitor_threshold
-        self._monitor = model.enable_monitoring(**kwargs)
+        self._monitor = self._model.enable_monitoring(**kwargs)
         _GENERATION.set(self.generation)
         _RETRAIN_IN_PROGRESS.set(0)
         global _ACTIVE_REF
         _ACTIVE_REF = weakref.ref(self)
+
+    def _resume_from_current(self) -> bool:
+        """Resume the last swapped generation from ``work_dir/CURRENT.json``
+        when a sealed one exists (ROADMAP item 2 follow-on): a restarted
+        ``serve``/``manage`` process serves the model the previous process
+        swapped to, not the seed it was constructed with. Any failure
+        (missing/torn pointer, unsealed or corrupt generation dir, missing
+        baseline) logs a warning and keeps the constructor's model at
+        generation 1 — resume is an optimisation, never a crash."""
+        current = os.path.join(self.work_dir, CURRENT_NAME)
+        if not os.path.exists(current):
+            return False
+        try:
+            with open(current) as fh:
+                doc = json.load(fh)
+            generation = int(doc["generation"])
+            path = doc["path"]
+            from ..io.persistence import load_model
+
+            model = load_model(path)
+        except Exception as exc:
+            logger.warning(
+                "lifecycle: could not resume from %s (%s); starting from the "
+                "provided model at generation 1",
+                current,
+                exc,
+            )
+            return False
+        if model.baseline is None:
+            logger.warning(
+                "lifecycle: %s carries no _BASELINE.json sidecar; cannot "
+                "resume monitoring from it — starting from the provided "
+                "model at generation 1",
+                path,
+            )
+            return False
+        self._model = model
+        self.generation = generation
+        self.model_path = path
+        swapped = doc.get("swapped_unix_s")
+        self.last_swap_unix_s = float(swapped) if swapped is not None else None
+        record_event(
+            "lifecycle.resume",
+            generation=generation,
+            path=path,
+            swapped_unix_s=self.last_swap_unix_s,
+        )
+        logger.info(
+            "lifecycle: resumed generation %d from %s (CURRENT.json)",
+            generation,
+            path,
+        )
+        return True
 
     # ------------------------------------------------------------------ #
     # serving path
@@ -232,13 +295,23 @@ class ModelManager:
     def monitor(self):
         return self._monitor
 
-    def score(self, X, y: Optional[np.ndarray] = None) -> np.ndarray:
+    def score(
+        self,
+        X,
+        y: Optional[np.ndarray] = None,
+        *,
+        timeout_s: Optional[float] = None,
+        strict: bool = False,
+    ) -> np.ndarray:
         """Score a served batch through the active model (folding the drift
         monitor), remember the rows in the retrain reservoir (labels too,
         when given — they arm the AUROC validation gate), and run the
-        debounced drift trigger."""
+        debounced drift trigger. ``timeout_s``/``strict`` forward to
+        :meth:`model.score` — the serving layer uses ``timeout_s`` to bound
+        coalesced-flush tail latency via the scoring watchdog + degradation
+        ladder (docs/resilience.md §6)."""
         model = self.model
-        scores = model.score(X)
+        scores = model.score(X, timeout_s=timeout_s, strict=strict)
         self.reservoir.fold(X, y)
         self._maybe_trigger()
         return scores

@@ -1,0 +1,44 @@
+"""Online scoring service: a real ``POST /score`` with dynamic
+micro-batch coalescing (ROADMAP item 1, docs/serving.md).
+
+Everything the prior PRs built toward "serves heavy traffic" meets the
+wire here: concurrent requests coalesce into micro-batches sized to the
+autotuner's sweet-spot buckets (:mod:`.coalescer`), score ONCE through the
+lifecycle manager (drift monitoring, reservoir, transparent hot-swaps) with
+the watchdog/degradation ladder bounding tail latency, and demultiplex back
+to their waiters (:mod:`.service`), behind the existing telemetry HTTP
+daemon (:mod:`.http`) with a crisp backpressure ladder: 429 on queue
+overflow, 503 on a stale queue or timeout — never a hang, never a torn
+batch.
+
+Start one with ``python -m isoforest_tpu serve <model_dir> --port N`` or
+:func:`serve_model`; load-test with ``tools/serving_latency.py``.
+"""
+
+from .coalescer import (
+    CoalescerClosedError,
+    MicroBatchCoalescer,
+    QueueFullError,
+    QueueStaleError,
+    RequestTimeoutError,
+    ServingError,
+)
+from .http import SCORE_PATH, handle_score, mount, unmount
+from .service import ScoringService, ServingConfig, ServingHandle, serve_model
+
+__all__ = [
+    "SCORE_PATH",
+    "CoalescerClosedError",
+    "MicroBatchCoalescer",
+    "QueueFullError",
+    "QueueStaleError",
+    "RequestTimeoutError",
+    "ScoringService",
+    "ServingConfig",
+    "ServingError",
+    "ServingHandle",
+    "handle_score",
+    "mount",
+    "serve_model",
+    "unmount",
+]

@@ -1,0 +1,373 @@
+"""Dynamic micro-batch coalescing: many requests, one traversal.
+
+The forest-inference throughput lesson (FastForest, arxiv 2004.02423; our
+own packed-layout + autotuner measurements) is that traversal work wants to
+be batched to the memory system's sweet spot — a 1-row score and a
+1024-row score cost nearly the same once the batch is padded to its
+power-of-two bucket (``ops/traversal.batch_bucket``) and the per-call
+overhead (Python dispatch, per-strategy prep, XLA program entry) is paid.
+An online endpoint that scores each request alone therefore throws away
+almost the entire batch budget.
+
+:class:`MicroBatchCoalescer` recovers it: concurrent requests enqueue their
+rows into one shared buffer; a flusher drains the buffer into a single
+scoring call when either
+
+* the pending row count reaches ``max_batch_rows`` (the configured
+  per-bucket sweet spot — ``serve`` pre-warms exactly these buckets), or
+* the OLDEST queued request has lingered ``max_linger_s`` (the tail-latency
+  bound: a lone 2 a.m. request never waits for company longer than the
+  linger),
+
+whichever comes first, then demultiplexes the score vector back to the
+waiting requests by row offset. Requests are never split across flushes —
+each waiter's rows travel together, so its scores come from exactly one
+model reference (the no-torn-batch guarantee the lifecycle hot-swap test
+leans on).
+
+Admission control keeps overload failure crisp instead of degenerate:
+
+* a request that would push the buffer past ``max_queue_rows`` is refused
+  immediately with :class:`QueueFullError` (HTTP 429 — the client should
+  back off and retry);
+* once the oldest queued request is older than ``queue_deadline_s`` the
+  service is not keeping up at all and new work is refused with
+  :class:`QueueStaleError` (HTTP 503 — the client should go elsewhere);
+* a waiter whose own result does not arrive within its wait budget gets
+  :class:`RequestTimeoutError` (503) rather than a hang.
+
+``clock`` is injectable and ``start=False`` runs the coalescer without its
+flusher thread (tests drive flushes via :meth:`pump` on a
+:class:`~isoforest_tpu.resilience.faults.FakeClock` — the whole size/linger
+policy is provable with zero real sleeps). Metrics:
+``isoforest_serving_queue_depth`` (gauge, rows waiting),
+``isoforest_serving_batch_rows`` (histogram, rows per flush),
+``isoforest_serving_coalesced_requests_total`` (counter, requests scored
+per flush) and ``isoforest_serving_flushes_total{cause=size|linger|close}``.
+Schema table in ``docs/serving.md``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ..telemetry.metrics import counter as _counter, gauge as _gauge
+from ..telemetry.metrics import histogram as _histogram
+
+_QUEUE_DEPTH = _gauge(
+    "isoforest_serving_queue_depth",
+    "Rows currently waiting in the micro-batch coalescer buffer",
+)
+_BATCH_ROWS = _histogram(
+    "isoforest_serving_batch_rows",
+    "Rows per coalesced scoring flush",
+    buckets=tuple(float(1 << i) for i in range(17)),  # 1 .. 65536
+)
+_COALESCED = _counter(
+    "isoforest_serving_coalesced_requests_total",
+    "Requests whose rows were scored via a coalesced flush "
+    "(incremented by the request count of every flush)",
+)
+_FLUSHES = _counter(
+    "isoforest_serving_flushes_total",
+    "Coalesced scoring flushes by trigger "
+    "(size = buffer reached max_batch_rows; linger = oldest request hit "
+    "the max-linger deadline; close = drain at shutdown)",
+    labelnames=("cause",),
+)
+
+
+class ServingError(Exception):
+    """Base class for serving-layer refusals; ``status`` is the HTTP code
+    the endpoint maps the error to (docs/serving.md backpressure table)."""
+
+    status = 500
+
+
+class QueueFullError(ServingError):
+    """Admission refused: the request would overflow ``max_queue_rows``
+    (HTTP 429 — retriable after backoff)."""
+
+    status = 429
+
+
+class QueueStaleError(ServingError):
+    """Admission refused: the oldest queued request has aged past
+    ``queue_deadline_s`` — the service is not draining (HTTP 503)."""
+
+    status = 503
+
+
+class RequestTimeoutError(ServingError):
+    """The caller's wait budget expired before its flush completed
+    (HTTP 503)."""
+
+    status = 503
+
+
+class CoalescerClosedError(ServingError):
+    """Submitted after :meth:`MicroBatchCoalescer.close` (HTTP 503)."""
+
+    status = 503
+
+
+class _Pending:
+    """One enqueued request: its rows, arrival time, and the slot its
+    flush fills in. ``flush_rows``/``flush_requests`` record the flush it
+    rode in (surfaced in the HTTP response so a load generator can verify
+    coalescing actually happened)."""
+
+    __slots__ = (
+        "rows",
+        "enqueued_at",
+        "event",
+        "scores",
+        "error",
+        "flush_rows",
+        "flush_requests",
+    )
+
+    def __init__(self, rows: np.ndarray, enqueued_at: float) -> None:
+        self.rows = rows
+        self.enqueued_at = enqueued_at
+        self.event = threading.Event()
+        self.scores: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.flush_rows = 0
+        self.flush_requests = 0
+
+
+class MicroBatchCoalescer:
+    """Shared request buffer with size-or-linger flushing (module doc).
+
+    ``score_fn(X) -> scores`` is called once per flush with the
+    concatenated ``[N, F]`` rows of every drained request — in serving it
+    is ``manager.score`` (so coalesced traffic feeds the drift monitor and
+    recent-data reservoir, and hot-swaps stay transparent) with
+    ``timeout_s`` arming the scoring watchdog + degradation ladder.
+    """
+
+    def __init__(
+        self,
+        score_fn: Callable[[np.ndarray], np.ndarray],
+        *,
+        max_batch_rows: int = 1024,
+        max_linger_s: float = 0.002,
+        max_queue_rows: int = 8192,
+        queue_deadline_s: float = 2.0,
+        clock: Callable[[], float] = time.monotonic,
+        start: bool = True,
+    ) -> None:
+        if max_batch_rows < 1:
+            raise ValueError(f"max_batch_rows must be >= 1, got {max_batch_rows}")
+        if max_queue_rows < max_batch_rows:
+            raise ValueError(
+                f"max_queue_rows ({max_queue_rows}) must be >= max_batch_rows "
+                f"({max_batch_rows}) or the size trigger can never fire"
+            )
+        if max_linger_s < 0 or queue_deadline_s <= 0:
+            raise ValueError(
+                "max_linger_s must be >= 0 and queue_deadline_s > 0"
+            )
+        self._score_fn = score_fn
+        self.max_batch_rows = int(max_batch_rows)
+        self.max_linger_s = float(max_linger_s)
+        self.max_queue_rows = int(max_queue_rows)
+        self.queue_deadline_s = float(queue_deadline_s)
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._queue: List[_Pending] = []
+        self._pending_rows = 0
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="isoforest-coalescer"
+            )
+            self._thread.start()
+
+    # ------------------------------------------------------------------ #
+    # request side
+    # ------------------------------------------------------------------ #
+
+    def submit(self, rows: np.ndarray) -> _Pending:
+        """Enqueue one request's rows; returns the pending handle to pass
+        to :meth:`result`. Raises the admission-control errors documented
+        on the module instead of ever blocking the caller on a full or
+        stalled buffer."""
+        rows = np.asarray(rows, np.float32)
+        if rows.ndim != 2 or rows.shape[0] < 1:
+            raise ValueError(
+                f"submit expects a non-empty [N, F] row matrix, got shape "
+                f"{rows.shape}"
+            )
+        n = int(rows.shape[0])
+        with self._cond:
+            if self._closed:
+                raise CoalescerClosedError("the coalescer is shut down")
+            now = self._clock()
+            if self._queue:
+                age = now - self._queue[0].enqueued_at
+                if age > self.queue_deadline_s:
+                    raise QueueStaleError(
+                        f"oldest queued request is {age:.3f}s old "
+                        f"(> queue_deadline_s={self.queue_deadline_s:g}); "
+                        "the scoring backend is not draining the queue"
+                    )
+            if self._pending_rows + n > self.max_queue_rows:
+                raise QueueFullError(
+                    f"{n} rows would overflow the admission queue "
+                    f"({self._pending_rows}/{self.max_queue_rows} rows "
+                    "pending); back off and retry"
+                )
+            pending = _Pending(rows, now)
+            self._queue.append(pending)
+            self._pending_rows += n
+            _QUEUE_DEPTH.set(self._pending_rows)
+            self._cond.notify_all()
+        return pending
+
+    def result(
+        self, pending: _Pending, timeout_s: Optional[float] = None
+    ) -> np.ndarray:
+        """Block until ``pending``'s flush completes; returns its scores or
+        re-raises the flush's error. A wait past ``timeout_s`` raises
+        :class:`RequestTimeoutError` (the flush may still complete later;
+        its result is discarded)."""
+        if not pending.event.wait(timeout_s):
+            raise RequestTimeoutError(
+                f"no result within {timeout_s:g}s (queue wait + scoring)"
+            )
+        if pending.error is not None:
+            raise pending.error
+        assert pending.scores is not None
+        return pending.scores
+
+    def score(self, rows: np.ndarray, timeout_s: Optional[float] = None) -> np.ndarray:
+        """Convenience: :meth:`submit` + :meth:`result`."""
+        return self.result(self.submit(rows), timeout_s=timeout_s)
+
+    # ------------------------------------------------------------------ #
+    # flush side
+    # ------------------------------------------------------------------ #
+
+    @property
+    def pending_rows(self) -> int:
+        with self._cond:
+            return self._pending_rows
+
+    def _due_locked(self) -> Tuple[List[_Pending], Optional[str]]:
+        """(batch, cause) when a flush is due, else ([], None). Caller
+        holds the lock. Never splits a request: drains whole waiters from
+        the front until the NEXT one would exceed ``max_batch_rows`` (a
+        single oversize request drains alone — ``score_fn`` chunks
+        internally)."""
+        if not self._queue:
+            return [], None
+        if self._closed:
+            cause = "close"
+        elif self._pending_rows >= self.max_batch_rows:
+            cause = "size"
+        elif self._clock() - self._queue[0].enqueued_at >= self.max_linger_s:
+            cause = "linger"
+        else:
+            return [], None
+        batch: List[_Pending] = []
+        rows = 0
+        while self._queue:
+            head = self._queue[0]
+            n = int(head.rows.shape[0])
+            if batch and rows + n > self.max_batch_rows:
+                break
+            batch.append(self._queue.pop(0))
+            rows += n
+        self._pending_rows -= rows
+        _QUEUE_DEPTH.set(self._pending_rows)
+        return batch, cause
+
+    def _wait_s_locked(self) -> Optional[float]:
+        """How long the flusher may sleep before the next linger deadline
+        (None = until notified). Caller holds the lock."""
+        if not self._queue:
+            return None
+        due = self._queue[0].enqueued_at + self.max_linger_s - self._clock()
+        return max(due, 0.0)
+
+    def _flush(self, batch: List[_Pending], cause: str) -> None:
+        offsets = np.cumsum([0] + [int(p.rows.shape[0]) for p in batch])
+        total = int(offsets[-1])
+        X = batch[0].rows if len(batch) == 1 else np.concatenate(
+            [p.rows for p in batch], axis=0
+        )
+        try:
+            scores = np.asarray(self._score_fn(X))
+            if scores.shape[0] != total:
+                raise ValueError(
+                    f"score_fn returned {scores.shape[0]} scores for "
+                    f"{total} rows"
+                )
+        except BaseException as exc:  # every waiter learns the same fate
+            for p in batch:
+                p.error = exc
+                p.event.set()
+            _FLUSHES.inc(cause=cause)
+            return
+        _BATCH_ROWS.observe(float(total))
+        _COALESCED.inc(len(batch))
+        _FLUSHES.inc(cause=cause)
+        for i, p in enumerate(batch):
+            p.scores = scores[offsets[i] : offsets[i + 1]]
+            p.flush_rows = total
+            p.flush_requests = len(batch)
+            p.event.set()
+
+    def pump(self) -> int:
+        """Run at most one due flush on the CALLER's thread; returns the
+        number of requests flushed (0 = nothing due). The threadless test
+        mode: with ``start=False`` and an injected fake clock, the
+        size/linger/backpressure policy is exercised deterministically."""
+        with self._cond:
+            batch, cause = self._due_locked()
+        if not batch:
+            return 0
+        self._flush(batch, cause)
+        return len(batch)
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while True:
+                    batch, cause = self._due_locked()
+                    if batch:
+                        break
+                    if self._closed:
+                        return
+                    self._cond.wait(self._wait_s_locked())
+            self._flush(batch, cause)
+
+    def close(self, drain: bool = True) -> None:
+        """Stop accepting work. ``drain=True`` flushes whatever is queued
+        (cause ``close``) so no waiter is stranded; ``drain=False`` fails
+        the stragglers with :class:`CoalescerClosedError`. Idempotent."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            if not drain:
+                for p in self._queue:
+                    p.error = CoalescerClosedError("coalescer closed")
+                    p.event.set()
+                self._queue.clear()
+                self._pending_rows = 0
+                _QUEUE_DEPTH.set(0)
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        elif drain:
+            while self.pump():
+                pass

@@ -1,0 +1,175 @@
+"""``POST /score``: the wire protocol over the telemetry HTTP server.
+
+Mounted onto the existing :class:`~isoforest_tpu.telemetry.http
+.MetricsServer` (one daemon serves ``/metrics``, ``/healthz``,
+``/snapshot`` AND scores — a deployment is one port, one process). Wire
+schema (docs/serving.md):
+
+* ``Content-Type: application/json`` —
+  ``{"row": [f, ...]}`` (single row) or ``{"rows": [[f, ...], ...]}``
+  (batch). Response: ``{"scores": [...], "predictions": [...],
+  "rows": n, "generation": g, "flush_rows": m, "flush_requests": k}``
+  (``flush_*`` report the coalesced flush the request rode in — a load
+  generator verifies coalescing from them).
+* ``Content-Type: text/csv`` (or a ``?format=csv`` query) — body is CSV
+  feature rows; response is a CSV column ``outlierScore``.
+
+Status codes are the backpressure ladder, never a hang: 400 malformed
+payload, 429 admission queue full (retry with backoff), 503 queue stale /
+request timeout / shutting down, 500 scoring error. End-to-end request
+latency (parse → queue → coalesced score → encode) lands in the
+``isoforest_serving_request_seconds`` histogram — the p50/p95/p99 the load
+generator reports come from the server's own series, not client clocks —
+and every response ticks ``isoforest_serving_responses_total{code=}``.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+from typing import Tuple
+
+import numpy as np
+
+from ..telemetry.metrics import counter as _counter
+from ..telemetry.metrics import exponential_buckets, histogram as _histogram
+from .coalescer import ServingError
+
+SCORE_PATH = "/score"
+
+# ~1.3x-geometric bounds, 50 us .. ~0.65 s: a warm coalesced 1-row request
+# through a cold full-bucket flush all resolve (same shape the old
+# serving-latency microbench used, so round-to-round numbers compare)
+_REQUEST_SECONDS = _histogram(
+    "isoforest_serving_request_seconds",
+    "End-to-end /score request latency (parse + queue wait + coalesced "
+    "scoring + encode)",
+    buckets=exponential_buckets(50e-6, 1.3, 36),
+)
+_RESPONSES = _counter(
+    "isoforest_serving_responses_total",
+    "/score responses by HTTP status code",
+    labelnames=("code",),
+)
+
+
+class _BadRequest(ValueError):
+    """Payload the endpoint refuses with a 400 and a reason."""
+
+
+def _parse_json(body: bytes) -> Tuple[np.ndarray, bool]:
+    """(rows, single?) from a JSON body; raises :class:`_BadRequest` with
+    an actionable message on any malformed shape."""
+    try:
+        doc = json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+        raise _BadRequest(f"body is not valid JSON: {exc}") from None
+    if not isinstance(doc, dict) or ("row" in doc) == ("rows" in doc):
+        raise _BadRequest(
+            'JSON body must be an object with exactly one of "row" '
+            '(single feature vector) or "rows" (list of feature vectors)'
+        )
+    single = "row" in doc
+    payload = [doc["row"]] if single else doc["rows"]
+    try:
+        rows = np.asarray(payload, dtype=np.float32)
+    except (TypeError, ValueError) as exc:
+        raise _BadRequest(f"feature values are not numeric: {exc}") from None
+    if rows.ndim != 2 or rows.shape[0] < 1 or rows.shape[1] < 1:
+        raise _BadRequest(
+            f'"{"row" if single else "rows"}" must parse to a non-empty '
+            f"[N, F] matrix, got shape {tuple(rows.shape)}"
+        )
+    return rows, single
+
+
+def _parse_csv(body: bytes) -> np.ndarray:
+    if not body.strip():
+        raise _BadRequest("CSV body contains no rows")
+    try:
+        rows = np.loadtxt(
+            io.StringIO(body.decode("utf-8")),
+            delimiter=",",
+            comments="#",
+            ndmin=2,
+        ).astype(np.float32)
+    except (UnicodeDecodeError, ValueError) as exc:
+        raise _BadRequest(f"body is not parseable CSV: {exc}") from None
+    if rows.size == 0:
+        raise _BadRequest("CSV body contains no rows")
+    return rows
+
+
+def handle_score(service, body: bytes, headers, query: str = "") -> Tuple[int, str, str]:
+    """One ``/score`` request → ``(status, content_type, body)``. Pure
+    function of the payload + service so the status mapping is unit-testable
+    without a socket."""
+    t0 = time.perf_counter()
+    content_type = (headers.get("Content-Type") or "").lower()
+    csv = "csv" in content_type or "format=csv" in (query or "")
+    try:
+        try:
+            rows = _parse_csv(body) if csv else None
+            single = False
+            if rows is None:
+                rows, single = _parse_json(body)
+        except _BadRequest as exc:
+            return _finish(t0, 400, _error_body(400, str(exc)))
+        try:
+            pending = service.coalescer.submit(rows)
+            scores = service.coalescer.result(
+                pending, timeout_s=service.config.request_timeout_s
+            )
+        except ServingError as exc:
+            return _finish(t0, exc.status, _error_body(exc.status, str(exc)))
+        except Exception as exc:  # scoring failure: typed 500, never a hang
+            return _finish(t0, 500, _error_body(500, repr(exc)))
+        if csv:
+            out = "outlierScore\n" + "".join(
+                f"{float(s)!r}\n" for s in scores
+            )
+            return _finish(t0, 200, out, "text/csv; charset=utf-8")
+        predictions = service.predict(scores)
+        doc = {
+            "scores": [float(s) for s in scores],
+            "predictions": [float(p) for p in predictions],
+            "rows": int(rows.shape[0]),
+            "single": single,
+            "generation": (
+                service.manager.generation if service.manager is not None else None
+            ),
+            "flush_rows": pending.flush_rows,
+            "flush_requests": pending.flush_requests,
+        }
+        return _finish(t0, 200, json.dumps(doc) + "\n")
+    except Exception as exc:  # encoder/accounting bug: still a typed 500
+        return _finish(t0, 500, _error_body(500, repr(exc)))
+
+
+def _error_body(status: int, message: str) -> str:
+    return json.dumps({"error": message, "status": status}) + "\n"
+
+
+def _finish(
+    t0: float, status: int, body: str, content_type: str = "application/json"
+) -> Tuple[int, str, str]:
+    _REQUEST_SECONDS.observe(time.perf_counter() - t0)
+    _RESPONSES.inc(code=status)
+    return status, content_type, body
+
+
+def mount(server, service) -> None:
+    """Register ``POST /score`` on a running
+    :class:`~isoforest_tpu.telemetry.http.MetricsServer` and add the
+    service's state to its ``/healthz`` payload."""
+    server.register_post(
+        SCORE_PATH,
+        lambda body, headers, query="": handle_score(service, body, headers, query),
+    )
+    server.serving_state = service.state  # picked up by health()
+
+
+def unmount(server) -> None:
+    server.unregister_post(SCORE_PATH)
+    server.serving_state = None

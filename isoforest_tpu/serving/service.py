@@ -1,0 +1,271 @@
+"""ScoringService: the online scoring path behind ``POST /score``.
+
+Glues the pieces every prior PR built into one request path
+(docs/serving.md):
+
+* scoring goes through a :class:`~isoforest_tpu.lifecycle.ModelManager`
+  when one is attached — live traffic feeds the drift monitor and the
+  recent-data reservoir, hot-swaps stay transparent to in-flight requests
+  (each flush scores on one complete model reference), and a restarted
+  process resumes from ``CURRENT.json``; a baseline-less model serves
+  bare, with a warning, through ``model.score`` directly;
+* requests coalesce in a :class:`~.coalescer.MicroBatchCoalescer` sized to
+  the autotuner's batch buckets; ``score_timeout_s`` arms the scoring
+  watchdog so a stalled kernel degrades (ladder rung ``scoring_timeout``)
+  instead of stalling the queue;
+* :meth:`prewarm` resolves the strategy winner table and compiles the
+  scoring programs for the configured buckets at startup (ROADMAP item 4
+  follow-on) so the first coalesced flush never pays a probe or an XLA
+  compile, and emits one ``serving.warmup`` event naming the buckets.
+
+:func:`serve_model` is the one-call assembly the ``serve`` subcommand (and
+tests) use: load → manage (resume) → mount → prewarm → handle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..telemetry.events import record_event
+from ..utils.logging import logger
+from .coalescer import MicroBatchCoalescer
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Knobs of the coalescing policy and the backpressure ladder
+    (docs/serving.md). ``batch_rows`` should be a
+    :func:`~isoforest_tpu.ops.traversal.batch_bucket` size — flushes then
+    land exactly on the pre-warmed, autotuned compiled shapes."""
+
+    batch_rows: int = 1024
+    linger_ms: float = 2.0
+    max_queue_rows: int = 8192
+    queue_deadline_ms: float = 2000.0
+    request_timeout_s: float = 30.0
+    score_timeout_s: Optional[float] = None
+
+
+class ScoringService:
+    """One model lineage's online scoring front: admission-controlled,
+    coalesced, lifecycle-aware. Construct with EITHER ``manager`` (the
+    lifecycle path) or ``model`` (bare). ``clock``/``start`` forward to the
+    coalescer (tests: fake clock, threadless :meth:`~.coalescer
+    .MicroBatchCoalescer.pump`)."""
+
+    def __init__(
+        self,
+        model=None,
+        manager=None,
+        config: Optional[ServingConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+        start: bool = True,
+    ) -> None:
+        if (model is None) == (manager is None):
+            raise ValueError("pass exactly one of model= or manager=")
+        self._bare_model = model
+        self.manager = manager
+        self.config = config or ServingConfig()
+        self.coalescer = MicroBatchCoalescer(
+            self._score_batch,
+            max_batch_rows=self.config.batch_rows,
+            max_linger_s=self.config.linger_ms / 1e3,
+            max_queue_rows=self.config.max_queue_rows,
+            queue_deadline_s=self.config.queue_deadline_ms / 1e3,
+            clock=clock,
+            start=start,
+        )
+        self.started_unix_s = time.time()
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def model(self):
+        """The CURRENT active model (post any hot-swap)."""
+        return self.manager.model if self.manager is not None else self._bare_model
+
+    def _score_batch(self, X: np.ndarray) -> np.ndarray:
+        """One coalesced flush: a single scoring call on one complete model
+        reference. Through the manager the flush also feeds the drift
+        monitor + reservoir and may trigger the retrain loop."""
+        timeout_s = self.config.score_timeout_s
+        if self.manager is not None:
+            return self.manager.score(X, timeout_s=timeout_s)
+        return self._bare_model.score(X, timeout_s=timeout_s)
+
+    def score(self, rows: np.ndarray) -> np.ndarray:
+        """Blocking request-side score: enqueue, coalesce, demultiplex.
+        Raises the :mod:`.coalescer` admission/timeout errors (the HTTP
+        layer maps them to 429/503)."""
+        pending = self.coalescer.submit(rows)
+        return self.coalescer.result(
+            pending, timeout_s=self.config.request_timeout_s
+        )
+
+    def predict(self, scores: np.ndarray) -> np.ndarray:
+        return self.model.predict(scores)
+
+    # ------------------------------------------------------------------ #
+
+    def prewarm(self, batch_sizes: Sequence[int] = ()) -> List[dict]:
+        """Resolve the autotuner's winner and compile the scoring program
+        for each batch bucket BEFORE traffic arrives, so no live flush pays
+        a cold probe or an XLA compile (docs/autotune.md; the ``autotune
+        --warm`` machinery applied to serving's own buckets). Emits exactly
+        one ``serving.warmup`` event naming the warmed buckets and the
+        resolved strategies; returns the per-bucket decisions."""
+        from ..ops.traversal import batch_bucket
+        from .. import tuning
+
+        model = self.model
+        sizes = set(int(b) for b in batch_sizes)
+        sizes.add(self.config.batch_rows)
+        buckets = sorted({batch_bucket(b) for b in sizes if b >= 1})
+        width = max(int(model.total_num_features), 1)
+        decisions = []
+        for bucket in buckets:
+            dummy = np.zeros((bucket, width), np.float32)
+            d = tuning.resolve_decision(
+                model.forest, dummy, model.num_samples, site="serving.prewarm"
+            )
+            decisions.append(
+                {
+                    "bucket": bucket,
+                    "strategy": d.strategy,
+                    "source": d.source,
+                    "key": d.key,
+                }
+            )
+        model.warmup(batch_sizes=buckets)
+        record_event(
+            "serving.warmup",
+            buckets=",".join(str(b) for b in buckets),
+            strategies=json.dumps(
+                {str(d["bucket"]): d["strategy"] for d in decisions},
+                sort_keys=True,
+            ),
+        )
+        logger.info(
+            "serving: pre-warmed %d batch bucket(s): %s",
+            len(buckets),
+            ", ".join(f"{d['bucket']}->{d['strategy']}" for d in decisions),
+        )
+        return decisions
+
+    def state(self) -> dict:
+        """Operator-facing service state (plain JSON types), merged into
+        ``/healthz`` alongside the lifecycle section."""
+        doc = {
+            "batch_rows": self.config.batch_rows,
+            "linger_ms": self.config.linger_ms,
+            "max_queue_rows": self.config.max_queue_rows,
+            "queue_deadline_ms": self.config.queue_deadline_ms,
+            "queue_rows": self.coalescer.pending_rows,
+            "generation": (
+                self.manager.generation if self.manager is not None else None
+            ),
+            "lifecycle": self.manager is not None,
+        }
+        return doc
+
+    def close(self) -> None:
+        """Drain the coalescer; the manager (if any) is left to its owner."""
+        self.coalescer.close(drain=True)
+
+
+class ServingHandle:
+    """A running ``/score`` deployment: HTTP server + service (+ manager).
+    ``close()`` tears the stack down in dependency order; usable as a
+    context manager."""
+
+    def __init__(self, server, service: ScoringService, manager=None) -> None:
+        self.server = server
+        self.service = service
+        self.manager = manager
+
+    @property
+    def url(self) -> str:
+        return self.server.url
+
+    def __enter__(self) -> "ServingHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        self.service.close()
+        if self.manager is not None:
+            self.manager.close()
+        self.server.stop()
+
+
+def serve_model(
+    model_dir: str,
+    *,
+    port: int = 0,
+    host: str = "127.0.0.1",
+    config: Optional[ServingConfig] = None,
+    lifecycle: bool = True,
+    work_dir: Optional[str] = None,
+    warm_batch_sizes: Sequence[int] = (1,),
+    manager_kwargs: Optional[dict] = None,
+) -> ServingHandle:
+    """Assemble the full online scoring stack over a saved model dir:
+
+    1. load the model (class-dispatched);
+    2. wrap it in a :class:`~isoforest_tpu.lifecycle.ModelManager` when it
+       carries a drift baseline (resuming from ``work_dir/CURRENT.json`` if
+       a sealed generation exists — a restarted process picks up the last
+       swapped model, not the seed); a baseline-less model serves bare with
+       a warning;
+    3. start the telemetry HTTP server and mount ``POST /score`` on it;
+    4. pre-warm the autotuner winner table + compiled programs for the
+       serving batch buckets.
+
+    Returns the :class:`ServingHandle`.
+    """
+    from ..io.persistence import load_model
+    from ..telemetry.events import record_event as _event
+    from ..telemetry.http import serve as _telemetry_serve
+    from .http import mount
+
+    config = config or ServingConfig()
+    model = load_model(model_dir)
+    manager = None
+    if lifecycle and model.baseline is not None:
+        from ..lifecycle import ModelManager
+
+        manager = ModelManager(
+            model,
+            work_dir=work_dir or model_dir + ".lifecycle",
+            **(manager_kwargs or {}),
+        )
+    elif lifecycle:
+        logger.warning(
+            "serving: %s has no _BASELINE.json sidecar — serving WITHOUT "
+            "the lifecycle manager (no drift-triggered retraining); refit "
+            "and re-save to enable it",
+            model_dir,
+        )
+    service = ScoringService(
+        model=None if manager is not None else model,
+        manager=manager,
+        config=config,
+    )
+    server = _telemetry_serve(port=port, host=host)
+    mount(server, service)
+    service.prewarm(warm_batch_sizes)
+    _event(
+        "serving.start",
+        port=server.port,
+        model=model_dir,
+        generation=manager.generation if manager is not None else 0,
+        lifecycle=manager is not None,
+    )
+    return ServingHandle(server, service, manager)

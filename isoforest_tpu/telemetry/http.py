@@ -55,6 +55,11 @@ _INDEX = (
     "  /snapshot  full JSON telemetry snapshot\n"
 )
 
+# Refuse request bodies past this size before reading them into memory: the
+# scoring endpoint is for serving batches, not bulk uploads (use the `score`
+# CLI for files). 64 MiB ~= a 4M-row x 4-feature JSON batch.
+MAX_POST_BYTES = 64 << 20
+
 
 def _lifecycle_state():
     """The live ModelManager's state, or None (no manager / import issue —
@@ -104,6 +109,49 @@ class _Handler(BaseHTTPRequestHandler):
                 404, "text/plain; charset=utf-8", f"unknown path {path}\n{_INDEX}"
             )
 
+    def do_POST(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler contract
+        """Dispatch to the owner's registered POST routes (the serving
+        layer mounts ``/score`` here, docs/serving.md). Routes return
+        ``(status, content_type, body)``; any handler exception is a typed
+        500 — the telemetry daemon must never die to a bad request."""
+        owner: "MetricsServer" = self.server.owner  # type: ignore[attr-defined]
+        path, _, query = self.path.partition("?")
+        handler = owner.post_routes.get(path)
+        if handler is None:
+            self._reply(
+                404,
+                "text/plain; charset=utf-8",
+                f"no POST route at {path}\n{_INDEX}",
+            )
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = -1
+        if length < 0 or length > MAX_POST_BYTES:
+            self._reply(
+                413 if length > MAX_POST_BYTES else 400,
+                "application/json",
+                json.dumps(
+                    {
+                        "error": f"Content-Length must be 0..{MAX_POST_BYTES}",
+                        "status": 413 if length > MAX_POST_BYTES else 400,
+                    }
+                )
+                + "\n",
+            )
+            return
+        body = self.rfile.read(length) if length else b""
+        try:
+            status, content_type, payload = handler(body, self.headers, query)
+        except Exception as exc:
+            status, content_type, payload = (
+                500,
+                "application/json",
+                json.dumps({"error": repr(exc), "status": 500}) + "\n",
+            )
+        self._reply(status, content_type, payload)
+
     def _reply(self, status: int, content_type: str, body: str) -> None:
         data = body.encode("utf-8")
         self.send_response(status)
@@ -132,6 +180,11 @@ class MetricsServer:
     ) -> None:
         self.heartbeat_dir = heartbeat_dir
         self.stale_after_s = float(stale_after_s)
+        # POST routes (path -> (body, headers, query) -> (status, ctype,
+        # body)): the serving layer mounts /score here. serving_state is an
+        # optional zero-arg callable merged into /healthz.
+        self.post_routes: dict = {}
+        self.serving_state = None
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.owner = self  # type: ignore[attr-defined]
@@ -157,6 +210,15 @@ class MetricsServer:
     def start(self) -> "MetricsServer":
         self._thread.start()
         return self
+
+    def register_post(self, path: str, handler) -> None:
+        """Mount a POST route (``handler(body, headers, query) -> (status,
+        content_type, body_str)``); replaces any existing route at
+        ``path``."""
+        self.post_routes[str(path)] = handler
+
+    def unregister_post(self, path: str) -> None:
+        self.post_routes.pop(str(path), None)
 
     def health(self) -> Tuple[dict, bool]:
         """``(payload, healthy)`` for ``/healthz``: heartbeat ages from the
@@ -189,6 +251,12 @@ class MetricsServer:
             # model generation / last-swap timestamp / retrain-in-progress:
             # a swapped model and a stale one answer /healthz differently
             payload["lifecycle"] = lifecycle
+        if self.serving_state is not None:
+            try:
+                payload["serving"] = self.serving_state()
+            except Exception:
+                # the liveness answer must not die to a state-read race
+                payload["serving"] = None
         return payload, not stale
 
     def stop(self) -> None:

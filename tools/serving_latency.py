@@ -1,134 +1,359 @@
-"""Serving-batch latency microbench for the native CPU walker.
+"""Closed- and open-loop load generator for the live ``/score`` endpoint.
 
-Measures p50/p95/p99 `model.score(batch)` latency at serving batch sizes
-with the per-forest prep cache warm — the number a low-latency deployment
-cares about, complementary to bench.py's bulk-throughput headline. Run with
-``PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python tools/serving_latency.py``
-in this image (see benchmarks/README.md for the tunnel-wedge context).
+Drives a running ``python -m isoforest_tpu serve`` deployment over HTTP
+(docs/serving.md) and reports what a capacity review needs:
 
-Latency collection goes through the telemetry subsystem
-(``isoforest_serving_latency_seconds{batch=...}`` histogram,
-docs/observability.md) rather than a hand-rolled list of floats: the
-reported quantiles are the bucket-interpolated ones a scraped Prometheus
-deployment would compute (~1.3x-geometric buckets, so p99 resolves to
-~15% relative error per bucket edge), plus the exact max the histogram
-tracks alongside. Each JSON row carries the sample count.
+* **closed-loop** throughput at ``--concurrency`` workers (each worker
+  keeps exactly one request in flight), versus the **sequential**
+  one-request-at-a-time baseline — the ratio is the measurable win of
+  dynamic micro-batch coalescing, CI-gated with ``--gate`` (ISSUE 8:
+  coalesced concurrent throughput must be >= 1.2x per-request scoring);
+* **open-loop** behaviour at a target arrival rate (``--rps``): achieved
+  rate plus error/backpressure counts — the regime where admission control
+  (429/503) matters, since arrivals do not slow down when the server does;
+* **server-side** p50/p95/p99 from the deployment's OWN
+  ``isoforest_serving_request_seconds`` histogram (fetched from
+  ``/snapshot``, quantiles interpolated exactly as the server would) — not
+  client clocks, so coordinated omission in the client cannot flatter the
+  tail;
+* **parity**: with ``--model``, every response is cross-checked against a
+  direct in-process ``model.score`` on the same rows — coalescing must be
+  BITWISE invisible to the caller (scores serialise via repr round-trip).
 
-Round-5 build host (1 core, avx512f/dq; exact-percentile collection):
-batch 1 p50 0.94 ms / p99 2.45 ms; batch 64 p50 0.98 ms; batch 1024 p50
-1.49 ms; batch 8192 p50 3.57 ms — the 16k-row thread gate keeps serving
-batches single-threaded by design. (Bucketed quantiles land within one
-bucket edge of those.)
+Typical CI smoke (the serving step in ci.yml):
 
-``--metrics-port N`` (0 = ephemeral) additionally serves the live
-``telemetry.serve`` HTTP endpoint for the duration of the run and
-self-checks it end-to-end: the served ``/metrics`` body must parse via
-``telemetry.export.parse_prometheus`` and contain the latency histogram the
-loop just wrote.
+    python -m isoforest_tpu serve /tmp/model --port 9321 &
+    python tools/serving_latency.py --url http://127.0.0.1:9321 \\
+        --model /tmp/model --duration 2 --concurrency 8 --gate 1.2
+
+Every phase prints one JSON line; the final line carries the verdict.
+Exits non-zero on parity failure, a missed gate, or missing serving series.
 """
 
 import argparse
 import json
+import math
 import pathlib
 import sys
+import threading
 import time
+import urllib.error
 import urllib.request
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
+import numpy as np  # noqa: E402
+
+
+def _post(url: str, rows, timeout: float = 30.0):
+    """POST one JSON batch; returns (status, parsed-body-or-None)."""
+    body = json.dumps({"rows": [[float(v) for v in r] for r in rows]}).encode()
+    req = urllib.request.Request(
+        url + "/score", data=body, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, None
+    except Exception:
+        return -1, None
+
+
+def _closed_loop(url, rows_pool, concurrency, duration, rows_per_request):
+    """``concurrency`` workers, one in-flight request each, for
+    ``duration`` seconds; returns aggregate counters."""
+    stop = time.perf_counter() + duration
+    lock = threading.Lock()
+    stats = {
+        "requests": 0,
+        "rows": 0,
+        "errors": {},
+        "flush_requests_sum": 0,
+        "flush_rows_sum": 0,
+    }
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        while time.perf_counter() < stop:
+            start = rng.integers(0, max(1, len(rows_pool) - rows_per_request))
+            batch = rows_pool[start : start + rows_per_request]
+            status, doc = _post(url, batch)
+            with lock:
+                if status == 200:
+                    stats["requests"] += 1
+                    stats["rows"] += len(batch)
+                    stats["flush_requests_sum"] += doc["flush_requests"]
+                    stats["flush_rows_sum"] += doc["flush_rows"]
+                else:
+                    stats["errors"][status] = stats["errors"].get(status, 0) + 1
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(concurrency)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration + 60)
+    elapsed = time.perf_counter() - t0
+    ok = max(stats["requests"], 1)
+    return {
+        "concurrency": concurrency,
+        "duration_s": round(elapsed, 3),
+        "requests": stats["requests"],
+        "rows": stats["rows"],
+        "rows_per_s": round(stats["rows"] / elapsed, 1),
+        "requests_per_s": round(stats["requests"] / elapsed, 1),
+        "mean_flush_requests": round(stats["flush_requests_sum"] / ok, 2),
+        "mean_flush_rows": round(stats["flush_rows_sum"] / ok, 2),
+        "errors": stats["errors"],
+    }
+
+
+def _open_loop(url, rows_pool, rps, duration, rows_per_request, max_inflight=64):
+    """Fire requests on a fixed arrival schedule regardless of completions
+    (bounded by ``max_inflight`` threads so an unresponsive server cannot
+    fork-bomb the client); returns achieved rate + status mix."""
+    interval = 1.0 / rps
+    lock = threading.Lock()
+    stats = {"sent": 0, "status": {}, "dropped_inflight": 0}
+    inflight = threading.Semaphore(max_inflight)
+    threads = []
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+    next_fire = t0
+    while True:
+        now = time.perf_counter()
+        if now >= t0 + duration:
+            break
+        if now < next_fire:
+            time.sleep(min(next_fire - now, interval))
+            continue
+        next_fire += interval
+        if not inflight.acquire(blocking=False):
+            with lock:
+                stats["dropped_inflight"] += 1
+            continue
+        start = rng.integers(0, max(1, len(rows_pool) - rows_per_request))
+        batch = rows_pool[start : start + rows_per_request]
+
+        def fire(batch=batch):
+            try:
+                status, _ = _post(url, batch)
+                with lock:
+                    stats["status"][status] = stats["status"].get(status, 0) + 1
+            finally:
+                inflight.release()
+
+        t = threading.Thread(target=fire, daemon=True)
+        t.start()
+        threads.append(t)
+        with lock:
+            stats["sent"] += 1
+    for t in threads:
+        t.join(timeout=60)
+    elapsed = time.perf_counter() - t0
+    return {
+        "target_rps": rps,
+        "duration_s": round(elapsed, 3),
+        "sent": stats["sent"],
+        "achieved_rps": round(stats["sent"] / elapsed, 1),
+        "status": {str(k): v for k, v in sorted(stats["status"].items())},
+        "dropped_inflight_cap": stats["dropped_inflight"],
+    }
+
+
+def _server_histogram_summary(url):
+    """p50/p95/p99 of ``isoforest_serving_request_seconds`` from the
+    server's /snapshot, interpolated with the same le-bucket rule
+    ``telemetry.metrics.Histogram.quantile`` uses."""
+    with urllib.request.urlopen(url + "/snapshot", timeout=10) as resp:
+        doc = json.loads(resp.read())
+    metric = doc.get("metrics", {}).get("isoforest_serving_request_seconds")
+    if not metric or not metric.get("series"):
+        return None
+    series = metric["series"][0]
+    count, lo, hi = series["count"], series["min"], series["max"]
+    if not count:
+        return None
+    buckets = [
+        (math.inf if b == "+Inf" else float(b), c) for b, c in series["buckets"]
+    ]
+
+    def quantile(q):
+        target = q * count
+        cumulative = 0.0
+        lower = 0.0
+        estimate = lower
+        for bound, in_bucket in buckets:
+            previous = cumulative
+            cumulative += in_bucket
+            if cumulative >= target and in_bucket > 0:
+                estimate = (
+                    lower
+                    if math.isinf(bound)
+                    else lower + (bound - lower) * ((target - previous) / in_bucket)
+                )
+                break
+            if not math.isinf(bound):
+                lower = bound
+        return min(max(estimate, lo), hi)
+
+    return {
+        "count": count,
+        "p50_ms": round(quantile(0.50) * 1e3, 3),
+        "p95_ms": round(quantile(0.95) * 1e3, 3),
+        "p99_ms": round(quantile(0.99) * 1e3, 3),
+        "max_ms": round(hi * 1e3, 3),
+    }
+
+
+def _check_parity(url, model_dir, rows_pool, n_rows):
+    """HTTP scores must be BITWISE the direct ``model.score`` on the same
+    rows — once per single-row request and once as one batch (so both the
+    coalesced and the one-flush path are covered)."""
+    from isoforest_tpu.io.persistence import load_model
+
+    model = load_model(model_dir)
+    rows = rows_pool[:n_rows]
+    direct = [float(s) for s in model.score(rows)]
+    mismatches = []
+    # one batch request
+    status, doc = _post(url, rows)
+    if status != 200:
+        return {"pass": False, "error": f"batch parity request -> HTTP {status}"}
+    for i, (got, want) in enumerate(zip(doc["scores"], direct)):
+        if got != want:
+            mismatches.append({"row": i, "http": got, "direct": want, "kind": "batch"})
+    # single-row requests (these coalesce server-side under load; alone
+    # they still traverse the same padded bucket)
+    for i in range(min(8, n_rows)):
+        status, doc = _post(url, rows[i : i + 1])
+        if status != 200 or doc["scores"][0] != direct[i]:
+            mismatches.append(
+                {
+                    "row": i,
+                    "http": None if status != 200 else doc["scores"][0],
+                    "direct": direct[i],
+                    "kind": "single",
+                }
+            )
+    return {"pass": not mismatches, "rows": n_rows, "mismatches": mismatches[:5]}
+
+
+SERVING_SERIES = (
+    "isoforest_serving_queue_depth",
+    "isoforest_serving_batch_rows",
+    "isoforest_serving_coalesced_requests_total",
+    "isoforest_serving_request_seconds",
+    "isoforest_serving_responses_total",
+)
+
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", required=True, help="base URL of a running serve")
     ap.add_argument(
-        "--metrics-port",
-        type=int,
+        "--model",
         default=None,
-        help="serve the telemetry HTTP endpoint on this port during the run "
-        "and smoke-check /metrics end-to-end (0 = ephemeral port)",
+        help="model dir for the bitwise parity cross-check (and synthetic "
+        "row widths when --input is not given)",
+    )
+    ap.add_argument("--input", default=None, help="CSV of rows to score")
+    ap.add_argument("--duration", type=float, default=2.0, help="seconds per phase")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--rows-per-request", type=int, default=1)
+    ap.add_argument(
+        "--rps",
+        type=float,
+        default=0.0,
+        help="open-loop target arrival rate (0 = skip the open-loop phase)",
+    )
+    ap.add_argument("--parity-rows", type=int, default=64)
+    ap.add_argument(
+        "--gate",
+        type=float,
+        default=0.0,
+        help="fail unless concurrent rows/s >= gate * sequential rows/s "
+        "(0 = report only)",
     )
     args = ap.parse_args()
+    url = args.url.rstrip("/")
 
-    from isoforest_tpu import IsolationForest, telemetry
-    from isoforest_tpu.data import kddcup_http_hard
+    if args.input:
+        rows_pool = np.loadtxt(
+            args.input, delimiter=",", comments="#", ndmin=2
+        ).astype(np.float32)
+    elif args.model:
+        from isoforest_tpu.io.persistence import load_model
 
-    server = (
-        telemetry.serve(port=args.metrics_port)
-        if args.metrics_port is not None
-        else None
+        width = max(int(load_model(args.model).total_num_features), 1)
+        rng = np.random.default_rng(0)
+        rows_pool = rng.normal(size=(4096, width)).astype(np.float32)
+    else:
+        ap.error("pass --input (rows to score) or --model (synthetic rows)")
+
+    failed = []
+
+    if args.model:
+        parity = _check_parity(url, args.model, rows_pool, args.parity_rows)
+        print(json.dumps({"phase": "parity", **parity}), flush=True)
+        if not parity["pass"]:
+            failed.append("parity")
+
+    sequential = _closed_loop(url, rows_pool, 1, args.duration, args.rows_per_request)
+    print(json.dumps({"phase": "closed_sequential", **sequential}), flush=True)
+    concurrent = _closed_loop(
+        url, rows_pool, args.concurrency, args.duration, args.rows_per_request
     )
+    print(json.dumps({"phase": "closed_concurrent", **concurrent}), flush=True)
 
-    # ~1.3x-geometric bounds, 50 us .. ~0.65 s: serving latencies from a
-    # warm 1-row native walk up to a cold 8k-row batch all resolve
-    buckets = telemetry.exponential_buckets(50e-6, 1.3, 36)
-    latency = telemetry.histogram(
-        "isoforest_serving_latency_seconds",
-        "model.score wall-clock at serving batch sizes (prep caches warm)",
-        labelnames=("batch",),
-        buckets=buckets,
-    )
-
-    X, _ = kddcup_http_hard(n=200_000)
-    model = IsolationForest(num_estimators=100, random_seed=1).fit(X)
-    for bs in (1, 64, 1024, 8192):
-        xb = X[:bs]
-        model.score(xb)  # warm: compile/prep caches
-        # enough iterations that p99 is a real tail statistic, not the max
-        # of a tiny sample (ADVICE r4); the sample size ships in the JSON
-        iters = 200 if bs <= 1024 else 100
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            model.score(xb)
-            latency.observe(time.perf_counter() - t0, batch=bs)
-        stats = latency.summary(batch=bs)
-        assert stats["count"] == iters
-        print(
-            json.dumps(
-                {
-                    "metric": "serving_latency_ms",
-                    "batch": bs,
-                    "iters": iters,
-                    "p50": round(stats["p50"] * 1e3, 3),
-                    "p95": round(stats["p95"] * 1e3, 3),
-                    "p99": round(stats["p99"] * 1e3, 3),
-                    "max": round(stats["max"] * 1e3, 3),
-                }
-            ),
-            flush=True,
+    if args.rps > 0:
+        open_loop = _open_loop(
+            url, rows_pool, args.rps, args.duration, args.rows_per_request
         )
+        print(json.dumps({"phase": "open_loop", **open_loop}), flush=True)
 
-    if server is not None:
-        # end-to-end endpoint smoke: the latencies recorded above must come
-        # back over HTTP as parseable Prometheus exposition
-        try:
-            body = (
-                urllib.request.urlopen(server.url + "/metrics", timeout=10)
-                .read()
-                .decode("utf-8")
-            )
-            parsed = telemetry.parse_prometheus(body)
-            buckets = parsed.get("isoforest_serving_latency_seconds_bucket", {})
-            served_batches = {
-                dict(labels).get("batch") for labels in buckets
+    latency = _server_histogram_summary(url)
+    print(json.dumps({"phase": "server_latency", "histogram": latency}), flush=True)
+
+    try:
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as resp:
+            metrics_body = resp.read().decode("utf-8")
+    except Exception as exc:
+        metrics_body = ""
+        failed.append(f"metrics_fetch:{exc!r}")
+    missing_series = [s for s in SERVING_SERIES if s not in metrics_body]
+    if missing_series:
+        failed.append(f"missing_series:{missing_series}")
+
+    ratio = (
+        concurrent["rows_per_s"] / sequential["rows_per_s"]
+        if sequential["rows_per_s"]
+        else float("inf")
+    )
+    if args.gate and not (ratio >= args.gate):
+        failed.append(f"gate:{ratio:.2f}<{args.gate}")
+    print(
+        json.dumps(
+            {
+                "phase": "verdict",
+                "sequential_rows_per_s": sequential["rows_per_s"],
+                "concurrent_rows_per_s": concurrent["rows_per_s"],
+                "coalescing_speedup": round(ratio, 2),
+                "mean_flush_requests": concurrent["mean_flush_requests"],
+                "gate": args.gate or None,
+                "serving_series_present": not missing_series,
+                "failed": failed,
+                "pass": not failed,
             }
-            ok = {"1", "64", "1024", "8192"} <= served_batches
-            print(
-                json.dumps(
-                    {
-                        "metric": "metrics_endpoint_smoke",
-                        "url": server.url + "/metrics",
-                        "parsed_metrics": len(parsed),
-                        "latency_batches_served": sorted(
-                            served_batches, key=int
-                        ),
-                        "pass": ok,
-                    }
-                ),
-                flush=True,
-            )
-            if not ok:
-                sys.exit(1)
-        finally:
-            server.stop()
+        ),
+        flush=True,
+    )
+    if failed:
+        sys.exit(1)
 
 
 if __name__ == "__main__":
