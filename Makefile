@@ -1,26 +1,32 @@
 # One-command validation of a fresh checkout — the analogue of the
 # reference's CI gates (.github/workflows/ci.yml: build + test matrix;
 # isolation-forest-onnx/setup.cfg: flake8/mypy/coverage). The image ships no
-# external linters, so lint is the in-repo AST gate (tools/lint.py) and
-# coverage is the sys.monitoring gate (tools/coverage_gate.py).
+# external linters, so analysis is the in-repo AST gate (tools/analysis,
+# docs/static_analysis.md: generic lint + project-invariant rules + the
+# static lock-order auditor) and coverage is the sys.monitoring gate
+# (tools/coverage_gate.py). `lint` stays as the fast generic subset
+# (tools/lint.py shim over the same rules).
 #
-# `check` = lint + coverage: the coverage gate runs the FULL test suite once
-# under line monitoring and enforces two floors (onnx >= 90%, matching the
-# reference's setup.cfg fail_under=90; whole package >= 90% since r5), so a
-# separate `test` pass would run every test twice (ADVICE r2). `test` stays
-# for quick monitoring-free local runs.
+# `check` = analyze + coverage: `analyze` subsumes lint, and the coverage
+# gate runs the FULL test suite once under line monitoring and enforces two
+# floors (onnx >= 90%, matching the reference's setup.cfg fail_under=90;
+# whole package >= 90% since r5), so a separate `test` pass would run every
+# test twice (ADVICE r2). `test` stays for quick monitoring-free local runs.
 
 PY ?= python3
 
-.PHONY: check lint test coverage bench dryrun
+.PHONY: check lint analyze test coverage bench dryrun
 
-check: lint coverage
+check: analyze coverage
 
 coverage:
 	$(PY) tools/coverage_gate.py
 
 lint:
 	$(PY) tools/lint.py
+
+analyze:
+	$(PY) -m tools.analysis
 
 # Per-file pytest processes: XLA:CPU's compiler segfaults intermittently in
 # LONG-LIVED processes in this image (r5: 4 of 5 single-process full-suite

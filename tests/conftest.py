@@ -37,6 +37,21 @@ if "xla_force_host_platform_device_count" not in _flags:
     _flags += " --xla_force_host_platform_device_count=8"
 os.environ["XLA_FLAGS"] = _flags
 
+# Runtime lock-order witness (docs/static_analysis.md): under
+# ISOFOREST_TPU_LOCK_WITNESS=1 (CI's chaos step exports it) every lock the
+# package creates is wrapped to record the per-thread acquisition graph and
+# raise LockOrderViolation on a cycle BEFORE blocking — so the serving and
+# lifecycle suites, whose coalescer/swap/monitor locks genuinely
+# interleave, double as deadlock audits. Must install before the package
+# imports (module-level locks are created at import time).
+if os.environ.get("ISOFOREST_TPU_LOCK_WITNESS"):
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    from tools.analysis import lockwitness
+
+    lockwitness.install()
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -178,6 +178,31 @@ class TestResolutionAndParity:
         s_pin = score_matrix(std.forest, X, std.num_samples, strategy="dense")
         np.testing.assert_array_equal(s_auto, s_pin)
 
+    def test_unknown_pin_takes_env_strategy_unknown_rung(
+        self, models, autotune, monkeypatch
+    ):
+        from isoforest_tpu.resilience import DegradationError
+
+        X, std, _ = models
+        reset_degradations("env_strategy_unknown")
+        monkeypatch.setenv("ISOFOREST_TPU_STRATEGY", "warpdrive")
+        monkeypatch.setenv("ISOFOREST_TPU_AUTOTUNE", "0")
+        d = tuning.resolve_decision(std.forest, X, std.num_samples)
+        # the invalid pin is warned + recorded through the ladder and
+        # resolution continues to the static default (docs/resilience.md)
+        assert d.source == "fallback"
+        rungs = {e.reason: e for e in degradation_report().events()}
+        assert "env_strategy_unknown" in rungs
+        assert "warpdrive" in rungs["env_strategy_unknown"].detail
+        s_auto = score_matrix(std.forest, X, std.num_samples, strategy="auto")
+        s_static = score_matrix(std.forest, X, std.num_samples, strategy=d.strategy)
+        np.testing.assert_array_equal(s_auto, s_static)
+        # a serving stack that pinned a strategy for its SLO must fail
+        # loudly on a bad pin instead of silently scoring elsewhere
+        with pytest.raises(DegradationError):
+            tuning.resolve_decision(std.forest, X, std.num_samples, strict=True)
+        reset_degradations("env_strategy_unknown")
+
     def test_disabled_resolves_static_default(self, models, autotune, monkeypatch):
         from isoforest_tpu.ops.traversal import default_strategy
 

@@ -1,0 +1,15 @@
+"""Project-aware static analysis (``python -m tools.analysis``).
+
+Grown out of ``tools/lint.py`` (ISSUE 9): generic lint rules plus
+AST-based project-invariant checkers (degradation-ladder discipline,
+fault-seam coverage, telemetry-schema/doc cross-references, the
+FakeClock no-real-sleeps policy, jit purity) and a static lock-order
+auditor with a runtime witness (:mod:`.lockwitness`). Rule table and
+suppression syntax: ``docs/static_analysis.md``.
+
+Public API: :func:`tools.analysis.core.run`, :class:`Finding`, `RULES`.
+"""
+
+from .core import RULES, Finding, Project, rule, run
+
+__all__ = ["RULES", "Finding", "Project", "rule", "run"]

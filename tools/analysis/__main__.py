@@ -1,0 +1,81 @@
+"""CLI entry: ``python -m tools.analysis [--select ...] [--format ...]``.
+
+Exit 0 when every selected rule passes, 1 with findings (listed on
+stdout), 2 on usage errors. ``--format json`` emits one machine-readable
+object (findings + per-rule counts) for CI artifact consumption.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from .core import ROOT, RULES, _load_rules, run
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="Project-aware static analysis (docs/static_analysis.md)",
+    )
+    parser.add_argument(
+        "--select",
+        default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text", dest="fmt"
+    )
+    parser.add_argument(
+        "--root",
+        default=None,
+        help="repo root to analyze (default: this checkout)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rule ids and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        _load_rules()
+        for rule_id in sorted(RULES):
+            print(f"{rule_id}  {RULES[rule_id].title}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+    root = pathlib.Path(args.root).resolve() if args.root else ROOT
+    try:
+        findings = run(root=root, select=select)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.fmt == "json":
+        counts: dict = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        print(
+            json.dumps(
+                {
+                    "root": str(root),
+                    "rules_run": sorted(select) if select else sorted(RULES),
+                    "findings": [f.as_dict() for f in findings],
+                    "counts": counts,
+                },
+                indent=1,
+                sort_keys=True,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.text())
+        print(f"analysis: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,231 @@
+"""Analyzer core: file model, suppression handling, rule registry, runner.
+
+The analyzer is AST-first (the image ships no ruff/flake8/mypy — same
+constraint ``tools/lint.py`` was born under) and *project-aware*: beyond
+generic lint, rules read the repo's own invariant tables (``LADDER``,
+``KNOWN_FAULTS``, the ``docs/observability.md`` schema tables) and check
+the code against them in both directions. Rule modules register checks
+with :func:`rule`; ``python -m tools.analysis`` runs them all.
+
+Suppression syntax (documented in docs/static_analysis.md):
+
+* ``# analysis: ignore[RULE1,RULE2]`` — suppress the named rules for
+  findings reported on this line or the line directly below (the
+  line-above form covers multi-line statements whose reported line is
+  the statement head);
+* ``# analysis: ignore`` — suppress every rule for that line.
+
+Suppressions only work in Python sources; a finding anchored in a
+markdown doc means the doc (or the code it describes) should be fixed.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+from typing import Callable, Dict, List, Optional, Sequence
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
+
+# Analyzed file set: the package, its tests, the tooling, and the root
+# scripts — the same universe tools/lint.py covered, now shared by every
+# rule through one parsed-AST cache.
+TARGETS = ("isoforest_tpu", "tests", "tools", "bench.py", "__graft_entry__.py")
+
+OBSERVABILITY_DOC = "docs/observability.md"
+
+_IGNORE_RE = re.compile(r"#\s*analysis:\s*ignore(?:\[([A-Za-z0-9_,\s]+)\])?")
+
+ALL_RULES = "*"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation: rule id, repo-relative path, 1-based line, message."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def text(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+class SourceFile:
+    """One parsed Python source: text, lines, AST (None on syntax error)
+    and the per-line suppression map."""
+
+    def __init__(self, path: pathlib.Path, root: pathlib.Path) -> None:
+        self.path = path
+        self.rel = path.relative_to(root).as_posix()
+        self.text = path.read_text()
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(self.text, filename=str(path))
+        except SyntaxError as exc:
+            self.syntax_error = exc
+        self.ignores: Dict[int, set] = {}
+        for lineno, line in enumerate(self.lines, 1):
+            m = _IGNORE_RE.search(line)
+            if not m:
+                continue
+            rules = m.group(1)
+            if rules is None:
+                self.ignores[lineno] = {ALL_RULES}
+            else:
+                self.ignores[lineno] = {
+                    r.strip() for r in rules.split(",") if r.strip()
+                }
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """True when ``rule`` is ignored for ``line`` — by a marker on the
+        line itself or on the line directly above (multi-line statements
+        report the statement head)."""
+        for at in (line, line - 1):
+            rules = self.ignores.get(at)
+            if rules and (ALL_RULES in rules or rule in rules):
+                return True
+        return False
+
+
+class Project:
+    """The analyzed repo: parsed Python files plus the docs the
+    cross-reference rules read. Built once, shared by every rule."""
+
+    def __init__(self, root: pathlib.Path = ROOT) -> None:
+        self.root = root
+        self.files: List[SourceFile] = []
+        for target in TARGETS:
+            p = root / target
+            if p.is_dir():
+                candidates = sorted(p.rglob("*.py"))
+            elif p.is_file():
+                candidates = [p]
+            else:
+                continue
+            for f in candidates:
+                if "__pycache__" in f.parts or ".jax_cache" in f.parts:
+                    continue
+                self.files.append(SourceFile(f, root))
+        self._by_rel = {f.rel: f for f in self.files}
+        doc = root / OBSERVABILITY_DOC
+        self.observability_doc: Optional[str] = (
+            doc.read_text() if doc.exists() else None
+        )
+
+    def file(self, rel: str) -> Optional[SourceFile]:
+        return self._by_rel.get(rel)
+
+    def package_files(self) -> List[SourceFile]:
+        return [f for f in self.files if f.rel.startswith("isoforest_tpu/")]
+
+    def test_files(self) -> List[SourceFile]:
+        return [f for f in self.files if f.rel.startswith("tests/")]
+
+
+RuleFunc = Callable[[Project], List[Finding]]
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleInfo:
+    id: str
+    title: str
+    func: RuleFunc
+
+
+RULES: Dict[str, RuleInfo] = {}
+
+
+def rule(rule_id: str, title: str) -> Callable[[RuleFunc], RuleFunc]:
+    """Register a rule. Each rule is ``func(project) -> [Finding]``; the
+    runner applies suppressions and ``--select`` filtering afterwards."""
+
+    def register(func: RuleFunc) -> RuleFunc:
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id}")
+        RULES[rule_id] = RuleInfo(rule_id, title, func)
+        return func
+
+    return register
+
+
+def _load_rules() -> None:
+    """Import every rule module exactly once (registration side effect)."""
+    from . import jit_rules, lint_rules, lock_rules, project_rules  # noqa: F401
+
+
+def run(
+    root: pathlib.Path = ROOT,
+    select: Optional[Sequence[str]] = None,
+    project: Optional[Project] = None,
+) -> List[Finding]:
+    """Run the selected rules (default: all) over ``root``; returns the
+    surviving (non-suppressed) findings sorted by path/line/rule."""
+    _load_rules()
+    if select:
+        unknown = sorted(set(select) - set(RULES))
+        if unknown:
+            raise ValueError(
+                f"unknown rule id(s) {', '.join(unknown)}; known: "
+                f"{', '.join(sorted(RULES))}"
+            )
+        infos = [RULES[s] for s in sorted(set(select))]
+    else:
+        infos = [RULES[k] for k in sorted(RULES)]
+    if project is None:
+        project = Project(root)
+    findings: List[Finding] = []
+    for info in infos:
+        for finding in info.func(project):
+            src = project.file(finding.path)
+            if src is not None and src.suppressed(finding.rule, finding.line):
+                continue
+            findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings
+
+
+# --------------------------------------------------------------------------- #
+# small AST helpers shared by rule modules
+# --------------------------------------------------------------------------- #
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Bare callable name: ``foo(...)`` -> "foo", ``a.b.foo(...)`` -> "foo"."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Render ``a.b.c`` attribute chains; None for anything non-trivial."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

@@ -1,0 +1,172 @@
+"""JIT001 — jitted functions must not call impure host functions.
+
+Anything a ``jax.jit``-traced function calls runs exactly once, at trace
+time, and its result is baked into the compiled program: a ``time.time()``
+inside a jitted scorer returns the *compile* timestamp forever, a
+``random.random()`` freezes one draw into every batch, and a telemetry
+counter ticks once per compilation instead of once per call. FastForest
+(arxiv 2004.02423) is the measured reminder that forest engines live in
+their hot traversal loop — this rule keeps that loop referentially
+transparent.
+
+Detected jit entry forms (the ones this repo uses):
+
+* ``@jax.jit`` / ``@functools.partial(jax.jit, ...)`` decorators;
+* ``name = jax.jit(fn, ...)`` and
+  ``name = functools.partial(jax.jit, ...)(fn)`` module-level wrapping;
+* ``jax.jit(fn, ...)`` anywhere (e.g. ``return jax.jit(...)`` program
+  builders), resolving ``fn`` through one level of wrapper call (the
+  ``shard_map(body, ...)`` case) to a local def or lambda.
+
+Flagged inside a jitted body (direct body only — transitive callees are
+out of scope, documented in docs/static_analysis.md): ``time.*`` and
+stdlib ``random.*`` calls, ``np.random.*``/``numpy.random.*``,
+``record_event``, ``logger.*``, and mutation (``inc``/``observe``/``set``)
+of ALL_CAPS module globals — the repo's metric-instance convention.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set
+
+from .core import Finding, Project, SourceFile, call_name, dotted, rule
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+_METRIC_GLOBAL_RE = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+_METRIC_MUTATORS = {"inc", "observe", "set", "dec"}
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    return dotted(node) in _JIT_NAMES
+
+
+def _is_partial_jit(node: ast.AST) -> bool:
+    """``functools.partial(jax.jit, ...)`` expression."""
+    return (
+        isinstance(node, ast.Call)
+        and dotted(node.func) in _PARTIAL_NAMES
+        and bool(node.args)
+        and _is_jit_ref(node.args[0])
+    )
+
+
+def _local_defs(tree: ast.AST) -> dict:
+    defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    return defs
+
+
+def _resolve_jitted_arg(arg: ast.AST, defs: dict, depth: int = 0):
+    """The function body jax.jit will trace: a def, a lambda, or None."""
+    if isinstance(arg, ast.Name):
+        return defs.get(arg.id)
+    if isinstance(arg, ast.Lambda):
+        return arg
+    if isinstance(arg, ast.Call) and depth < 1 and arg.args:
+        # one wrapper level: shard_map(body, ...), checkify(body), ...
+        return _resolve_jitted_arg(arg.args[0], defs, depth + 1)
+    return None
+
+
+def _jitted_functions(f: SourceFile) -> List[ast.AST]:
+    """Every function/lambda node in ``f`` that jax.jit traces."""
+    if f.tree is None:
+        return []
+    defs = _local_defs(f.tree)
+    jitted: List[ast.AST] = []
+    seen: Set[int] = set()
+
+    def add(node) -> None:
+        if node is not None and id(node) not in seen:
+            seen.add(id(node))
+            jitted.append(node)
+
+    for node in ast.walk(f.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if _is_jit_ref(deco) or _is_partial_jit(deco):
+                    add(node)
+                elif isinstance(deco, ast.Call) and _is_jit_ref(deco.func):
+                    add(node)
+        elif isinstance(node, ast.Call):
+            if _is_jit_ref(node.func) and node.args:
+                add(_resolve_jitted_arg(node.args[0], defs))
+            elif _is_partial_jit(node.func) and node.args:
+                # functools.partial(jax.jit, ...)(fn)
+                add(_resolve_jitted_arg(node.args[0], defs))
+    return jitted
+
+
+def _impurity(node: ast.Call, time_aliases: Set[str], random_aliases: Set[str]) -> Optional[str]:
+    func = node.func
+    name = call_name(node)
+    if name == "record_event":
+        return "record_event() mutates the telemetry timeline"
+    path = dotted(func)
+    if path is not None:
+        head = path.split(".")[0]
+        if head in time_aliases and "." in path:
+            return f"{path}() reads the host clock"
+        if head in random_aliases and "." in path:
+            return f"{path}() draws from host RNG state"
+        if path.startswith(("np.random.", "numpy.random.")):
+            return f"{path}() draws from host (numpy) RNG state"
+        if head == "logger" and "." in path:
+            return f"{path}() logs once at trace time, then never again"
+    if (
+        isinstance(func, ast.Attribute)
+        and func.attr in _METRIC_MUTATORS
+        and isinstance(func.value, ast.Name)
+        and _METRIC_GLOBAL_RE.match(func.value.id)
+    ):
+        return (
+            f"{func.value.id}.{func.attr}() mutates a telemetry metric "
+            "once per trace, not once per call"
+        )
+    return None
+
+
+def _module_aliases(tree: ast.AST, module: str) -> Set[str]:
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == module:
+                    aliases.add(alias.asname or module)
+    return aliases
+
+
+@rule("JIT001", "jitted functions must not call impure host functions")
+def check_jit_purity(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in project.package_files():
+        jitted = _jitted_functions(f)
+        if not jitted:
+            continue
+        time_aliases = _module_aliases(f.tree, "time")
+        random_aliases = _module_aliases(f.tree, "random")
+        reported: Set[int] = set()
+        for fn in jitted:
+            label = getattr(fn, "name", "<lambda>")
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) or node.lineno in reported:
+                    continue
+                why = _impurity(node, time_aliases, random_aliases)
+                if why is not None:
+                    reported.add(node.lineno)
+                    findings.append(
+                        Finding(
+                            "JIT001",
+                            f.rel,
+                            node.lineno,
+                            f"impure call inside jitted {label!r}: {why} — "
+                            "the result bakes into the traced program "
+                            "(runs at compile time, not per call)",
+                        )
+                    )
+    return findings

@@ -1,0 +1,108 @@
+"""Generic lint rules — the original ``tools/lint.py`` checks as rules.
+
+SYN001 syntax error · IMP001 unused import · WSP001 trailing whitespace ·
+WSP002 tab indentation. ``tools/lint.py`` remains a thin shim running
+exactly this subset so ``make lint`` and the CI lint step are unchanged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding, Project, SourceFile, rule
+
+LINT_RULES = ("SYN001", "IMP001", "WSP001", "WSP002")
+
+
+@rule("SYN001", "file must parse")
+def check_syntax(project: Project) -> List[Finding]:
+    findings = []
+    for f in project.files:
+        if f.syntax_error is not None:
+            findings.append(
+                Finding(
+                    "SYN001",
+                    f.rel,
+                    int(f.syntax_error.lineno or 1),
+                    f"syntax error: {f.syntax_error.msg}",
+                )
+            )
+    return findings
+
+
+@rule("WSP001", "no trailing whitespace")
+def check_trailing_whitespace(project: Project) -> List[Finding]:
+    findings = []
+    for f in project.files:
+        for lineno, line in enumerate(f.lines, 1):
+            if line != line.rstrip():
+                findings.append(
+                    Finding("WSP001", f.rel, lineno, "trailing whitespace")
+                )
+    return findings
+
+
+@rule("WSP002", "no tab indentation")
+def check_tab_indentation(project: Project) -> List[Finding]:
+    findings = []
+    for f in project.files:
+        for lineno, line in enumerate(f.lines, 1):
+            if line.startswith("\t"):
+                findings.append(
+                    Finding("WSP002", f.rel, lineno, "tab indentation")
+                )
+    return findings
+
+
+def _imported_names(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                yield node.lineno, alias.asname or alias.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name != "*":
+                    yield node.lineno, alias.asname or alias.name
+
+
+def _used_names(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                used.add(base.id)
+    return used
+
+
+def _unused_imports(f: SourceFile) -> List[Finding]:
+    if f.tree is None or f.path.name == "__init__.py":
+        return []
+    findings = []
+    used = _used_names(f.tree)
+    docstring = ast.get_docstring(f.tree) or ""
+    for lineno, name in _imported_names(f.tree):
+        if name in used or name == "annotations":
+            continue
+        # legacy escape hatch, honored alongside `# analysis: ignore`
+        if lineno - 1 < len(f.lines) and "noqa" in f.lines[lineno - 1]:
+            continue
+        if f"`{name}`" in docstring:  # doc-referenced re-export
+            continue
+        findings.append(
+            Finding("IMP001", f.rel, lineno, f"unused import {name!r}")
+        )
+    return findings
+
+
+@rule("IMP001", "no unused imports")
+def check_unused_imports(project: Project) -> List[Finding]:
+    findings = []
+    for f in project.files:
+        findings.extend(_unused_imports(f))
+    return findings

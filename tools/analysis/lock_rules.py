@@ -1,0 +1,630 @@
+"""LCK001/LCK002 — static lock-order auditing over ``isoforest_tpu/``.
+
+Fifteen modules now hold ``threading.Lock``/``RLock``/``Condition``s, and
+the serving/lifecycle stack genuinely interleaves three of them under
+load (the coalescer condition, the manager swap lock, the monitor lock).
+A lock-order inversion between any two is a deadlock that no amount of
+dynamic testing reliably catches — the static pass makes the acquisition
+ORDER a checked invariant, the runtime witness (:mod:`.lockwitness`)
+makes real test traffic double as an audit.
+
+Model (documented in docs/static_analysis.md):
+
+* a lock *identity* is its declaration site — a module-level
+  ``NAME = threading.Lock()`` or a ``self.attr = threading.Lock()`` in a
+  class body (all instances of a class share one identity: an inversion
+  between two instances of the same site is the same code bug);
+* acquisitions are ``with <lock>:`` blocks (the only form the package
+  uses); ``.acquire()`` call discipline is out of scope;
+* an edge A → B means "B was (or may be) acquired while A is held":
+  directly by nesting, or through a call made while holding A to a
+  function whose may-acquire closure contains B (closure = its own
+  ``with`` blocks plus everything reachable through statically
+  resolvable calls: local/imported functions, ``self.method``,
+  ``self.attr.method`` for constructor-typed attrs, and module-global
+  metric instances);
+* LCK001: a cycle in the edge graph is a potential deadlock;
+* LCK002: a call made while holding a NON-reentrant ``Lock`` into a
+  same-class method whose closure re-acquires that same lock is a
+  guaranteed self-deadlock on the same instance.
+
+Calls the resolver cannot type (dynamic callables, ``**hooks``, values
+returned from other calls) are skipped — the auditor under-approximates
+rather than spraying false positives; the runtime witness covers the
+dynamic remainder.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, Project, SourceFile, rule
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+# telemetry.metrics factory -> the class its instances carry
+_METRIC_FACTORY_CLASSES = {
+    "counter": "Counter",
+    "gauge": "Gauge",
+    "histogram": "Histogram",
+}
+_METRICS_MODULE = "isoforest_tpu.telemetry.metrics"
+
+
+@dataclasses.dataclass(frozen=True)
+class LockDecl:
+    id: str  # "<rel>::<Class>.<attr>" or "<rel>::<var>"
+    kind: str  # Lock | RLock | Condition
+    rel: str
+    line: int
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    module: "ModuleInfo"
+    bases: List[str]
+    lock_attrs: Dict[str, LockDecl] = dataclasses.field(default_factory=dict)
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+    methods: Dict[str, str] = dataclasses.field(default_factory=dict)  # name -> qual
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qual: str
+    rel: str
+    class_name: Optional[str]
+    module: "ModuleInfo"
+    direct: List[Tuple[str, int]] = dataclasses.field(default_factory=list)
+    calls: List[Tuple[tuple, int]] = dataclasses.field(default_factory=list)
+    held_calls: List[Tuple[str, tuple, int]] = dataclasses.field(
+        default_factory=list
+    )
+    held_nested: List[Tuple[str, str, int]] = dataclasses.field(
+        default_factory=list
+    )
+
+
+class ModuleInfo:
+    def __init__(self, src: SourceFile) -> None:
+        self.src = src
+        self.rel = src.rel
+        parts = src.rel[: -len(".py")].split("/")
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        self.qual = ".".join(parts)
+        self.import_from: Dict[str, Tuple[str, str]] = {}  # local -> (mod, orig)
+        self.import_mod: Dict[str, str] = {}  # alias -> module qual
+        self.module_locks: Dict[str, LockDecl] = {}
+        self.module_instances: Dict[str, Tuple[str, str]] = {}  # var -> (mod, cls)
+        self.classes: Dict[str, ClassInfo] = {}
+
+    def resolve_relative(self, level: int, module: Optional[str]) -> str:
+        if level == 0:
+            return module or ""
+        base = self.qual.split(".")
+        base = base[: len(base) - level]
+        if module:
+            base.append(module)
+        return ".".join(base)
+
+
+def _lock_ctor_kind(node: ast.AST) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition' for a ``threading.X()`` call."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == "threading"
+        and node.func.attr in _LOCK_CTORS
+    ):
+        return node.func.attr
+    return None
+
+
+class _Analyzer:
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self.lock_decls: Dict[str, LockDecl] = {}
+        for src in project.package_files():
+            if src.tree is None:
+                continue
+            mod = ModuleInfo(src)
+            self.modules[mod.qual] = mod
+        for mod in self.modules.values():
+            self._collect_decls(mod)
+        # second pass: constructor-typed attrs/globals can only resolve
+        # once EVERY module's classes are known (a module often constructs
+        # classes from modules collected after it)
+        for mod in self.modules.values():
+            self._collect_instance_types(mod)
+        for mod in self.modules.values():
+            self._collect_functions(mod)
+        self.may_acquire = self._closure()
+
+    # ---------------------------- declarations ---------------------------- #
+
+    def _collect_decls(self, mod: ModuleInfo) -> None:
+        tree = mod.src.tree
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    mod.import_mod[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                target = mod.resolve_relative(node.level, node.module)
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    if target in self.modules or target.startswith("isoforest_tpu"):
+                        mod.import_from[local] = (target, alias.name)
+        for stmt in tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+            ):
+                name = stmt.targets[0].id
+                kind = _lock_ctor_kind(stmt.value)
+                if kind is not None:
+                    decl = LockDecl(f"{mod.rel}::{name}", kind, mod.rel, stmt.lineno)
+                    mod.module_locks[name] = decl
+                    self.lock_decls[decl.id] = decl
+        for stmt in tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                info = ClassInfo(
+                    stmt.name,
+                    mod,
+                    [b.id for b in stmt.bases if isinstance(b, ast.Name)],
+                )
+                mod.classes[stmt.name] = info
+                for method in stmt.body:
+                    if not isinstance(
+                        method, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        continue
+                    info.methods[method.name] = (
+                        f"{mod.qual}.{stmt.name}.{method.name}"
+                    )
+                    for sub in ast.walk(method):
+                        if not (
+                            isinstance(sub, ast.Assign)
+                            and len(sub.targets) == 1
+                            and isinstance(sub.targets[0], ast.Attribute)
+                            and isinstance(sub.targets[0].value, ast.Name)
+                            and sub.targets[0].value.id == "self"
+                        ):
+                            continue
+                        attr = sub.targets[0].attr
+                        kind = _lock_ctor_kind(sub.value)
+                        if kind is not None:
+                            decl = LockDecl(
+                                f"{mod.rel}::{stmt.name}.{attr}",
+                                kind,
+                                mod.rel,
+                                sub.lineno,
+                            )
+                            info.lock_attrs.setdefault(attr, decl)
+                            self.lock_decls.setdefault(decl.id, decl)
+
+    def _collect_instance_types(self, mod: ModuleInfo) -> None:
+        tree = mod.src.tree
+        for stmt in tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id not in mod.module_locks
+            ):
+                cls_ref = self._class_of_ctor(mod, stmt.value)
+                if cls_ref is not None:
+                    mod.module_instances[stmt.targets[0].id] = cls_ref
+        for cls_stmt in tree.body:
+            if not isinstance(cls_stmt, ast.ClassDef):
+                continue
+            info = mod.classes[cls_stmt.name]
+            for sub in ast.walk(cls_stmt):
+                if not (
+                    isinstance(sub, ast.Assign)
+                    and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Attribute)
+                    and isinstance(sub.targets[0].value, ast.Name)
+                    and sub.targets[0].value.id == "self"
+                ):
+                    continue
+                attr = sub.targets[0].attr
+                if attr in info.lock_attrs:
+                    continue
+                cls_ref = self._class_of_ctor(mod, sub.value)
+                if cls_ref is not None:
+                    info.attr_types.setdefault(attr, f"{cls_ref[0]}.{cls_ref[1]}")
+
+    def _class_of_ctor(
+        self, mod: ModuleInfo, value: ast.AST
+    ) -> Optional[Tuple[str, str]]:
+        """(module_qual, class_name) when ``value`` constructs a package
+        class or a telemetry metric (via the counter/gauge/histogram
+        factories, under any import alias)."""
+        if not (isinstance(value, ast.Call) and isinstance(value.func, ast.Name)):
+            return None
+        fname = value.func.id
+        if fname in mod.classes:
+            return (mod.qual, fname)
+        ref = mod.import_from.get(fname)
+        if ref is None:
+            return None
+        target_mod, orig = ref
+        if target_mod == _METRICS_MODULE and orig in _METRIC_FACTORY_CLASSES:
+            return (_METRICS_MODULE, _METRIC_FACTORY_CLASSES[orig])
+        target = self.modules.get(target_mod)
+        if target is not None and orig in target.classes:
+            return (target_mod, orig)
+        return None
+
+    # ----------------------------- summaries ------------------------------ #
+
+    def _collect_functions(self, mod: ModuleInfo) -> None:
+        def handle(fn, class_name: Optional[str], qual: str) -> None:
+            info = FuncInfo(qual, mod.rel, class_name, mod)
+            self.functions[qual] = info
+            self._walk_body(fn.body, [], info)
+            for sub in fn.body:
+                collect_nested(sub, class_name, qual)
+
+        def collect_nested(node, class_name, parent_qual) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    handle(child, class_name, f"{parent_qual}.{child.name}")
+                else:
+                    collect_nested(child, class_name, parent_qual)
+
+        for stmt in mod.src.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                handle(stmt, None, f"{mod.qual}.{stmt.name}")
+            elif isinstance(stmt, ast.ClassDef):
+                for method in stmt.body:
+                    if isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        handle(
+                            method,
+                            stmt.name,
+                            f"{mod.qual}.{stmt.name}.{method.name}",
+                        )
+
+    def _lock_of_expr(
+        self, expr: ast.AST, info: FuncInfo
+    ) -> Optional[LockDecl]:
+        if isinstance(expr, ast.Name):
+            return info.module.module_locks.get(expr.id)
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and info.class_name is not None
+        ):
+            return self._lookup_lock_attr(info.module, info.class_name, expr.attr)
+        return None
+
+    def _lookup_lock_attr(
+        self, mod: ModuleInfo, class_name: str, attr: str, depth: int = 0
+    ) -> Optional[LockDecl]:
+        cls = mod.classes.get(class_name)
+        if cls is None or depth > 4:
+            return None
+        if attr in cls.lock_attrs:
+            return cls.lock_attrs[attr]
+        for base in cls.bases:
+            found = self._lookup_lock_attr(mod, base, attr, depth + 1)
+            if found is not None:
+                return found
+        return None
+
+    def _callref(self, node: ast.Call, info: FuncInfo) -> Optional[tuple]:
+        func = node.func
+        if isinstance(func, ast.Name):
+            return ("name", func.id)
+        if not isinstance(func, ast.Attribute):
+            return None
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id == "self":
+                return ("self", func.attr)
+            if base.id in info.module.module_locks:
+                return None  # lock-object method (acquire/notify/...): not a call edge
+            if base.id in info.module.module_instances:
+                return ("global", base.id, func.attr)
+            if base.id in info.module.import_mod or base.id in info.module.import_from:
+                return ("mod", base.id, func.attr)
+            return None
+        if (
+            isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "self"
+        ):
+            if (
+                info.class_name is not None
+                and self._lookup_lock_attr(info.module, info.class_name, base.attr)
+                is not None
+            ):
+                return None  # self._cond.wait() etc.
+            return ("self_attr", base.attr, func.attr)
+        return None
+
+    def _walk_body(self, body: Sequence[ast.AST], held: List[str], info: FuncInfo):
+        for node in body:
+            self._visit(node, held, info)
+
+    def _visit(self, node: ast.AST, held: List[str], info: FuncInfo) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs run when called, not here; summarized separately
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in node.items:
+                decl = self._lock_of_expr(item.context_expr, info)
+                if decl is not None:
+                    for h in held + acquired:
+                        info.held_nested.append((h, decl.id, node.lineno))
+                    info.direct.append((decl.id, node.lineno))
+                    acquired.append(decl.id)
+                else:
+                    self._visit_expr(item.context_expr, held, info)
+            self._walk_body(node.body, held + acquired, info)
+            return
+        if isinstance(node, ast.Call):
+            ref = self._callref(node, info)
+            if ref is not None:
+                info.calls.append((ref, node.lineno))
+                for h in held:
+                    info.held_calls.append((h, ref, node.lineno))
+            for child in ast.iter_child_nodes(node):
+                self._visit(child, held, info)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held, info)
+
+    def _visit_expr(self, node: ast.AST, held: List[str], info: FuncInfo) -> None:
+        self._visit(node, held, info)
+
+    # ----------------------------- resolution ----------------------------- #
+
+    def resolve(self, ref: tuple, info: FuncInfo) -> List[str]:
+        mod = info.module
+        kind = ref[0]
+        if kind == "name":
+            name = ref[1]
+            nested = f"{info.qual}.{name}"
+            if nested in self.functions:
+                return [nested]
+            if f"{mod.qual}.{name}" in self.functions:
+                return [f"{mod.qual}.{name}"]
+            imported = mod.import_from.get(name)
+            if imported is not None:
+                target_mod, orig = imported
+                qual = f"{target_mod}.{orig}"
+                if qual in self.functions:
+                    return [qual]
+            return []
+        if kind == "self":
+            if info.class_name is None:
+                return []
+            return self._method_quals(mod, info.class_name, ref[1])
+        if kind == "global":
+            var, method = ref[1], ref[2]
+            target_mod, cls = mod.module_instances[var]
+            return self._method_in(target_mod, cls, method)
+        if kind == "self_attr":
+            attr, method = ref[1], ref[2]
+            if info.class_name is None:
+                return []
+            cls = mod.classes.get(info.class_name)
+            if cls is None or attr not in cls.attr_types:
+                return []
+            type_qual = cls.attr_types[attr]
+            target_mod, cls_name = type_qual.rsplit(".", 1)
+            return self._method_in(target_mod, cls_name, method)
+        if kind == "mod":
+            alias, fname = ref[1], ref[2]
+            target_qual = mod.import_mod.get(alias)
+            if target_qual is None:
+                imported = mod.import_from.get(alias)
+                if imported is None:
+                    return []
+                target_qual = f"{imported[0]}.{imported[1]}"
+            qual = f"{target_qual}.{fname}"
+            return [qual] if qual in self.functions else []
+        return []
+
+    def _method_quals(
+        self, mod: ModuleInfo, class_name: str, method: str, depth: int = 0
+    ) -> List[str]:
+        cls = mod.classes.get(class_name)
+        if cls is None or depth > 4:
+            return []
+        if method in cls.methods:
+            return [cls.methods[method]]
+        for base in cls.bases:
+            found = self._method_quals(mod, base, method, depth + 1)
+            if found:
+                return found
+        return []
+
+    def _method_in(self, mod_qual: str, class_name: str, method: str) -> List[str]:
+        target = self.modules.get(mod_qual)
+        if target is None:
+            return []
+        return self._method_quals(target, class_name, method)
+
+    # ------------------------------ closure -------------------------------- #
+
+    def _closure(self) -> Dict[str, Set[str]]:
+        acquire: Dict[str, Set[str]] = {
+            q: {lock for lock, _ in fi.direct} for q, fi in self.functions.items()
+        }
+        callees: Dict[str, Set[str]] = {}
+        for q, fi in self.functions.items():
+            outs: Set[str] = set()
+            for ref, _ in fi.calls:
+                outs.update(self.resolve(ref, fi))
+            callees[q] = outs
+        changed = True
+        while changed:
+            changed = False
+            for q in self.functions:
+                before = len(acquire[q])
+                for callee in callees[q]:
+                    acquire[q] |= acquire.get(callee, set())
+                if len(acquire[q]) != before:
+                    changed = True
+        return acquire
+
+    # ------------------------------- edges --------------------------------- #
+
+    def edges(self) -> Dict[Tuple[str, str], Tuple[str, int, str]]:
+        out: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        for fi in self.functions.values():
+            for a, b, line in fi.held_nested:
+                if a != b:
+                    out.setdefault((a, b), (fi.rel, line, "nested with"))
+            for held, ref, line in fi.held_calls:
+                for target in self.resolve(ref, fi):
+                    for lock in self.may_acquire.get(target, ()):
+                        if lock != held:
+                            out.setdefault(
+                                (held, lock),
+                                (fi.rel, line, f"call into {target}"),
+                            )
+        return out
+
+    def self_deadlocks(self) -> List[Tuple[str, str, int, str]]:
+        hits = []
+        for fi in self.functions.values():
+            for held, ref, line in fi.held_calls:
+                decl = self.lock_decls.get(held)
+                if decl is None or decl.kind != "Lock":
+                    continue
+                for target in self.resolve(ref, fi):
+                    if held in self.may_acquire.get(target, ()):
+                        hits.append((held, fi.rel, line, target))
+            for a, b, line in fi.held_nested:
+                decl = self.lock_decls.get(a)
+                if a == b and decl is not None and decl.kind == "Lock":
+                    hits.append((a, fi.rel, line, "directly nested with"))
+        return hits
+
+
+def _find_cycles(
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]]
+) -> List[List[str]]:
+    """Elementary cycles via SCC: each SCC with >1 node (self-edges are
+    filtered at insertion) yields one representative cycle."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(sorted(graph[v])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def _analyzer_for(project: Project) -> _Analyzer:
+    """One shared acquisition-graph build per Project (LCK001 + LCK002)."""
+    cached = getattr(project, "_lock_analyzer", None)
+    if cached is None:
+        cached = _Analyzer(project)
+        project._lock_analyzer = cached
+    return cached
+
+
+@rule("LCK001", "no cycles in the static lock-acquisition graph")
+def check_lock_order(project: Project) -> List[Finding]:
+    analyzer = _analyzer_for(project)
+    edges = analyzer.edges()
+    findings: List[Finding] = []
+    for cycle in _find_cycles(edges):
+        involved = [
+            (pair, site) for pair, site in sorted(edges.items())
+            if pair[0] in cycle and pair[1] in cycle
+        ]
+        rel, line = (involved[0][1][0], involved[0][1][1]) if involved else (
+            "isoforest_tpu", 1
+        )
+        detail = "; ".join(
+            f"{a} -> {b} ({srel}:{sline}, {how})"
+            for (a, b), (srel, sline, how) in involved[:6]
+        )
+        findings.append(
+            Finding(
+                "LCK001",
+                rel,
+                line,
+                "lock-order cycle (potential deadlock) between "
+                f"{', '.join(cycle)}: {detail}",
+            )
+        )
+    return findings
+
+
+@rule("LCK002", "no re-acquisition of a held non-reentrant Lock")
+def check_self_deadlock(project: Project) -> List[Finding]:
+    analyzer = _analyzer_for(project)
+    findings: List[Finding] = []
+    for lock, rel, line, via in analyzer.self_deadlocks():
+        findings.append(
+            Finding(
+                "LCK002",
+                rel,
+                line,
+                f"while holding non-reentrant {lock}, this statement may "
+                f"re-acquire it ({via}) — guaranteed self-deadlock on the "
+                "same instance",
+            )
+        )
+    return findings

@@ -1,0 +1,295 @@
+"""Runtime lock-order witness: real test traffic as a deadlock audit.
+
+The static pass (:mod:`.lock_rules`) proves what it can resolve; this
+module witnesses the rest at runtime. When installed (tests/conftest.py
+does so under ``ISOFOREST_TPU_LOCK_WITNESS=1`` — CI's chaos step exports
+it so the serving/lifecycle suites double as lock-order audits),
+``threading.Lock``/``RLock``/``Condition`` constructed FROM
+``isoforest_tpu/`` source files return instrumented wrappers that record
+the per-thread acquisition graph keyed by each lock's creation site.
+
+The crucial ordering property: edges are recorded and cycle-checked
+**before** the blocking acquire. A genuine inversion therefore raises
+:class:`LockOrderViolation` in whichever thread closes the cycle instead
+of deadlocking the suite — the deliberately inverted two-lock fixture in
+``tests/test_analysis.py`` proves exactly that.
+
+Identity is the creation *site* (file:line), matching the static model:
+two instances created at the same line are the same code-level lock, and
+an A→B plus B→A ordering between two sites is the same latent deadlock
+whether or not the specific instances coincide. Consequences: re-acquiring
+an instance this thread already holds records nothing (RLock reentrancy,
+``Condition.wait`` re-acquires), and same-site pairs are skipped (distinct
+instances of one class interlocking is instance-level, not order-level).
+
+Out-of-band locks (jax, numpy, stdlib internals) are never wrapped: the
+factory checks the creation frame's filename, so the blast radius is
+exactly the package's own locks.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+ENV = "ISOFOREST_TPU_LOCK_WITNESS"
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+_SCOPE_MARKERS = (f"{os.sep}isoforest_tpu{os.sep}",)
+_THIS_FILE = os.path.abspath(__file__)
+
+
+class LockOrderViolation(RuntimeError):
+    """Acquiring this lock would close a cycle in the acquisition-order
+    graph — a potential deadlock. Raised *instead of* blocking."""
+
+
+class _Graph:
+    """Process-wide site-level acquisition-order graph."""
+
+    def __init__(self) -> None:
+        self._guard = _REAL_LOCK()
+        self.edges: Dict[Tuple[str, str], str] = {}  # (from, to) -> where seen
+        self.sites: Set[str] = set()
+
+    def reset(self) -> None:
+        with self._guard:
+            self.edges.clear()
+            self.sites.clear()
+
+    def note_site(self, site: str) -> None:
+        with self._guard:
+            self.sites.add(site)
+
+    def add_edges(self, held_sites: List[str], target: str, where: str) -> None:
+        """Record held→target edges; raise on a new edge closing a cycle."""
+        with self._guard:
+            for held in held_sites:
+                if held == target:
+                    continue
+                key = (held, target)
+                if key in self.edges:
+                    continue
+                cycle = self._path(target, held)
+                if cycle is not None:
+                    detail = " -> ".join(cycle + [target])
+                    raise LockOrderViolation(
+                        f"acquiring {target} while holding {held} (at {where}) "
+                        f"closes a lock-order cycle: {held} -> {target} but "
+                        f"also {detail}; first-seen reverse edges: "
+                        + "; ".join(
+                            f"{a} -> {b} ({w})"
+                            for (a, b), w in self.edges.items()
+                            if a in cycle and b in cycle
+                        )
+                    )
+                self.edges[key] = where
+
+    def _path(self, start: str, goal: str) -> Optional[List[str]]:
+        """DFS path start→goal through recorded edges (None if absent)."""
+        stack: List[Tuple[str, List[str]]] = [(start, [start])]
+        seen: Set[str] = set()
+        while stack:
+            node, path = stack.pop()
+            if node == goal:
+                return path
+            if node in seen:
+                continue
+            seen.add(node)
+            for (a, b) in self.edges:
+                if a == node and b not in seen:
+                    stack.append((b, path + [b]))
+        return None
+
+    def snapshot(self) -> dict:
+        with self._guard:
+            return {
+                "sites": sorted(self.sites),
+                "edges": [
+                    {"from": a, "to": b, "where": w}
+                    for (a, b), w in sorted(self.edges.items())
+                ],
+            }
+
+
+_GRAPH = _Graph()
+
+
+class _Held(threading.local):
+    def __init__(self) -> None:
+        self.stack: List[object] = []  # witness instances, outermost first
+        self.depth: Dict[int, int] = {}  # id(witness) -> reentry depth
+
+
+_HELD = _Held()
+
+
+def _caller_site(skip_threading: bool = True) -> str:
+    frame = sys._getframe(1)
+    while frame is not None:
+        filename = frame.f_code.co_filename
+        if os.path.abspath(filename) != _THIS_FILE and not (
+            skip_threading and filename.endswith(("threading.py",))
+        ):
+            return f"{filename}:{frame.f_lineno}"
+        frame = frame.f_back
+    return "<unknown>"
+
+
+def _in_scope(site: str) -> bool:
+    return any(marker in site for marker in _SCOPE_MARKERS)
+
+
+def _before_acquire(witness: "_WitnessBase") -> None:
+    """Pre-acquire bookkeeping: no-op on reentry, else record+check edges
+    for every currently held witness lock."""
+    if _HELD.depth.get(id(witness), 0) > 0:
+        return
+    if _HELD.stack:
+        held_sites = [w.site for w in _HELD.stack]
+        _GRAPH.add_edges(held_sites, witness.site, _caller_site())
+
+
+def _after_acquire(witness: "_WitnessBase") -> None:
+    depth = _HELD.depth.get(id(witness), 0)
+    if depth == 0:
+        _HELD.stack.append(witness)
+    _HELD.depth[id(witness)] = depth + 1
+
+
+def _after_release(witness: "_WitnessBase") -> None:
+    depth = _HELD.depth.get(id(witness), 0)
+    if depth <= 1:
+        _HELD.depth.pop(id(witness), None)
+        try:
+            _HELD.stack.remove(witness)
+        except ValueError:
+            pass
+    else:
+        _HELD.depth[id(witness)] = depth - 1
+
+
+class _WitnessBase:
+    _factory = staticmethod(_REAL_LOCK)
+
+    def __init__(self, site: Optional[str] = None) -> None:
+        self._inner = self._factory()
+        self.site = site or _caller_site()
+        _GRAPH.note_site(self.site)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        _before_acquire(self)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _after_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        _after_release(self)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # aids violation messages in test output
+        return f"<{type(self).__name__} {self.site}>"
+
+
+class WitnessLock(_WitnessBase):
+    """Instrumented non-reentrant lock."""
+
+
+class WitnessRLock(_WitnessBase):
+    """Instrumented reentrant lock; supports ``threading.Condition``."""
+
+    _factory = staticmethod(_REAL_RLOCK)
+
+    # Condition integration: these three are what threading.Condition
+    # probes for, and are how wait() releases/re-acquires through us.
+    def _release_save(self):
+        state = self._inner._release_save()
+        _HELD.depth.pop(id(self), None)
+        try:
+            _HELD.stack.remove(self)
+        except ValueError:
+            pass
+        return state
+
+    def _acquire_restore(self, state) -> None:
+        _before_acquire(self)
+        self._inner._acquire_restore(state)
+        _HELD.stack.append(self)
+        _HELD.depth[id(self)] = int(state[0]) if isinstance(state, tuple) else 1
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+def _lock_factory():
+    site = _caller_site()
+    return WitnessLock(site) if _in_scope(site) else _REAL_LOCK()
+
+
+def _rlock_factory():
+    site = _caller_site()
+    return WitnessRLock(site) if _in_scope(site) else _REAL_RLOCK()
+
+
+def _condition_factory(lock=None):
+    if lock is None:
+        site = _caller_site()
+        if _in_scope(site):
+            lock = WitnessRLock(site)
+    return _REAL_CONDITION(lock)
+
+
+_installed = False
+
+
+def install() -> None:
+    """Patch the ``threading`` factories (idempotent). Must run before
+    ``isoforest_tpu`` modules create their locks — tests/conftest.py
+    installs at collection start, before the package imports."""
+    global _installed
+    if _installed:
+        return
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    threading.Condition = _condition_factory
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    threading.Condition = _REAL_CONDITION
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    """Clear the recorded graph (test isolation)."""
+    _GRAPH.reset()
+
+
+def report() -> dict:
+    """Snapshot of the recorded sites and acquisition-order edges."""
+    return _GRAPH.snapshot()

@@ -1,0 +1,587 @@
+"""Project-invariant rules: the contracts PRs 2-8 established dynamically,
+now enforced statically.
+
+* LAD001/LAD002 — every ``degrade(reason=...)`` call site names a
+  ``resilience.degradation.LADDER`` rung, and every rung is exercised by
+  at least one test (a rung no test takes is a parity guarantee nobody
+  has ever verified).
+* FLT001/FLT002 — every fault name armed via ``faults.inject(...)`` /
+  ``faults.get``/``faults.active`` exists in ``KNOWN_FAULTS``, and every
+  known seam is referenced by at least one test (an orphaned seam is dead
+  injection code).
+* OBS001-OBS004 — every ``isoforest_*`` metric registered in code and
+  every ``record_event`` kind appears in ``docs/observability.md`` (the
+  public schema, §6: renaming is a dashboard-breaking change), and vice
+  versa — a documented-but-unregistered name is doc rot.
+* SLP001 — tests must not call ``time.sleep``: the FakeClock policy
+  (``resilience.faults.FakeClock``) that kept tier-1 at zero real sleeps,
+  previously enforced only by review.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Project, SourceFile, call_name, rule, str_const
+
+DEGRADATION_FILE = "isoforest_tpu/resilience/degradation.py"
+FAULTS_FILE = "isoforest_tpu/resilience/faults.py"
+OBS_DOC = "docs/observability.md"
+
+METRIC_FACTORIES = {
+    "counter",
+    "gauge",
+    "histogram",
+    "_counter",
+    "_gauge",
+    "_histogram",
+}
+
+
+# --------------------------------------------------------------------------- #
+# invariant-table extraction
+# --------------------------------------------------------------------------- #
+
+
+def ladder_rungs(project: Project) -> Dict[str, int]:
+    """``LADDER`` keys -> definition line, from degradation.py's AST."""
+    src = project.file(DEGRADATION_FILE)
+    if src is None or src.tree is None:
+        return {}
+    for node in ast.walk(src.tree):
+        target = None
+        if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            target = node.target.id
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+            node.targets[0], ast.Name
+        ):
+            target = node.targets[0].id
+        if target == "LADDER" and isinstance(getattr(node, "value", None), ast.Dict):
+            return {
+                key.value: key.lineno
+                for key in node.value.keys
+                if isinstance(key, ast.Constant) and isinstance(key.value, str)
+            }
+    return {}
+
+
+def known_faults(project: Project) -> Dict[str, int]:
+    """``KNOWN_FAULTS`` names -> definition line, from faults.py's AST."""
+    src = project.file(FAULTS_FILE)
+    if src is None or src.tree is None:
+        return {}
+    for node in ast.walk(src.tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "KNOWN_FAULTS"
+        ):
+            out: Dict[str, int] = {}
+            for const in ast.walk(node.value):
+                if isinstance(const, ast.Constant) and isinstance(const.value, str):
+                    out[const.value] = const.lineno
+            return out
+    return {}
+
+
+# --------------------------------------------------------------------------- #
+# LAD001 / LAD002 — degradation-ladder discipline
+# --------------------------------------------------------------------------- #
+
+
+def _enclosing_function(
+    tree: ast.AST, node: ast.AST
+) -> Optional[ast.FunctionDef]:
+    """Innermost function def containing ``node`` (by position walk)."""
+    best: Optional[ast.FunctionDef] = None
+    for fn in ast.walk(tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if (
+                fn.lineno <= node.lineno
+                and node.lineno <= max(fn.body[-1].end_lineno or fn.lineno, fn.lineno)
+                and (best is None or fn.lineno > best.lineno)
+            ):
+                best = fn
+    return best
+
+
+def _param_default(fn: ast.FunctionDef, name: str) -> Optional[str]:
+    """String-literal default of parameter ``name`` (pos or kw-only)."""
+    args = fn.args
+    pos = args.posonlyargs + args.args
+    for arg, default in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+        if arg.arg == name:
+            return str_const(default)
+    for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+        if arg.arg == name and default is not None:
+            return str_const(default)
+    return None
+
+
+def _is_param(fn: ast.FunctionDef, name: str) -> bool:
+    args = fn.args
+    every = args.posonlyargs + args.args + args.kwonlyargs
+    return any(a.arg == name for a in every)
+
+
+def _callsite_kwarg_literals(
+    project: Project, func_name: str, kwarg: str
+) -> List[str]:
+    """Literal string values passed as ``kwarg=`` to any call of
+    ``func_name`` across the package (how a parameterized reason like
+    ``pin_rung`` gets its non-default values)."""
+    values: List[str] = []
+    for f in project.package_files():
+        if f.tree is None:
+            continue
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Call) and call_name(node) == func_name:
+                for kw in node.keywords:
+                    if kw.arg == kwarg:
+                        value = str_const(kw.value)
+                        if value is not None:
+                            values.append(value)
+    return values
+
+
+def _reason_candidates(
+    project: Project, src: SourceFile, node: ast.Call, reason: ast.AST
+) -> Optional[List[str]]:
+    """All statically resolvable string values the ``reason`` argument can
+    take; None when unresolvable."""
+    literal = str_const(reason)
+    if literal is not None:
+        return [literal]
+    if not isinstance(reason, ast.Name):
+        return None
+    fn = _enclosing_function(src.tree, node)
+    if fn is None:
+        return None
+    candidates: List[str] = []
+    # local literal assignments inside the enclosing function
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Assign):
+            for target in sub.targets:
+                if isinstance(target, ast.Name) and target.id == reason.id:
+                    value = str_const(sub.value)
+                    if value is None:
+                        return None  # non-literal rebind: unresolvable
+                    candidates.append(value)
+    if _is_param(fn, reason.id):
+        default = _param_default(fn, reason.id)
+        if default is not None:
+            candidates.append(default)
+        candidates.extend(
+            _callsite_kwarg_literals(project, fn.name, reason.id)
+        )
+    return candidates or None
+
+
+@rule("LAD001", "degrade() reason must name a LADDER rung")
+def check_degrade_reasons(project: Project) -> List[Finding]:
+    rungs = ladder_rungs(project)
+    findings: List[Finding] = []
+    if not rungs:
+        return findings
+    for f in project.package_files():
+        if f.tree is None or f.rel == DEGRADATION_FILE:
+            continue
+        for node in ast.walk(f.tree):
+            if not (isinstance(node, ast.Call) and call_name(node) == "degrade"):
+                continue
+            reason: Optional[ast.AST] = None
+            if node.args:
+                reason = node.args[0]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "reason":
+                        reason = kw.value
+            if reason is None:
+                continue
+            candidates = _reason_candidates(project, f, node, reason)
+            if candidates is None:
+                findings.append(
+                    Finding(
+                        "LAD001",
+                        f.rel,
+                        node.lineno,
+                        "degrade() reason is not statically resolvable to a "
+                        "string literal; use a LADDER rung name (or a local/"
+                        "parameter value whose every assignment is one)",
+                    )
+                )
+                continue
+            for value in candidates:
+                if value not in rungs:
+                    findings.append(
+                        Finding(
+                            "LAD001",
+                            f.rel,
+                            node.lineno,
+                            f"degrade() reason {value!r} is not a LADDER rung "
+                            "(add it to resilience.degradation.LADDER and "
+                            "docs/resilience.md)",
+                        )
+                    )
+    return findings
+
+
+@rule("LAD002", "every LADDER rung is exercised by a test")
+def check_ladder_coverage(project: Project) -> List[Finding]:
+    rungs = ladder_rungs(project)
+    findings: List[Finding] = []
+    tests = project.test_files()
+    for rung, lineno in sorted(rungs.items()):
+        if not any(rung in t.text for t in tests):
+            findings.append(
+                Finding(
+                    "LAD002",
+                    DEGRADATION_FILE,
+                    lineno,
+                    f"LADDER rung {rung!r} is not exercised by any test "
+                    "under tests/ — its parity guarantee is unverified",
+                )
+            )
+    return findings
+
+
+# --------------------------------------------------------------------------- #
+# FLT001 / FLT002 — fault-seam discipline
+# --------------------------------------------------------------------------- #
+
+
+def _fault_name_uses(f: SourceFile) -> List[Tuple[str, int]]:
+    """(fault_name, line) for every statically visible arming/lookup:
+    ``inject(name=...)`` keywords, and literal names passed to
+    ``faults.get``/``faults.active`` (or bare ``get``/``active`` inside
+    faults.py itself)."""
+    if f.tree is None:
+        return []
+    in_faults_module = f.rel == FAULTS_FILE
+    uses: List[Tuple[str, int]] = []
+    for node in ast.walk(f.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if call_name(node) == "inject":
+            # both faults.inject(...) and a bare imported inject(...)
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    uses.append((kw.arg, node.lineno))
+            continue
+        name: Optional[str] = None
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in ("get", "active")
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "faults"
+        ):
+            name = func.attr
+        elif (
+            in_faults_module
+            and isinstance(func, ast.Name)
+            and func.id in ("get", "active")
+        ):
+            name = func.id
+        if name is not None and node.args:
+            literal = str_const(node.args[0])
+            if literal is not None:
+                uses.append((literal, node.lineno))
+    return uses
+
+
+@rule("FLT001", "fault names must exist in KNOWN_FAULTS")
+def check_fault_names(project: Project) -> List[Finding]:
+    known = known_faults(project)
+    findings: List[Finding] = []
+    if not known:
+        return findings
+    for f in project.package_files() + project.test_files():
+        for fault, lineno in _fault_name_uses(f):
+            if fault not in known:
+                findings.append(
+                    Finding(
+                        "FLT001",
+                        f.rel,
+                        lineno,
+                        f"fault {fault!r} is not in resilience.faults."
+                        "KNOWN_FAULTS — inject() would raise at runtime",
+                    )
+                )
+    return findings
+
+
+@rule("FLT002", "every fault seam is referenced by a test")
+def check_fault_coverage(project: Project) -> List[Finding]:
+    known = known_faults(project)
+    findings: List[Finding] = []
+    tests = project.test_files()
+    for fault, lineno in sorted(known.items()):
+        if not any(fault in t.text for t in tests):
+            findings.append(
+                Finding(
+                    "FLT002",
+                    FAULTS_FILE,
+                    lineno,
+                    f"fault seam {fault!r} is not referenced by any test "
+                    "under tests/ — the seam is unproven injection code",
+                )
+            )
+    return findings
+
+
+# --------------------------------------------------------------------------- #
+# OBS001-OBS004 — telemetry schema vs docs/observability.md
+# --------------------------------------------------------------------------- #
+
+
+def _aliases_of(tree: ast.AST, originals: Set[str]) -> Set[str]:
+    """Local names bound by ``from X import <orig> [as alias]`` for any
+    original name in ``originals`` — catches ``record_event as _event`` and
+    ``histogram as _telemetry_histogram`` style imports."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name in originals:
+                    aliases.add(alias.asname or alias.name)
+    return aliases
+
+
+def registered_metrics(project: Project) -> List[Tuple[str, str, int]]:
+    """(name, file, line) for every ``isoforest_*`` metric registration —
+    by factory name (``counter``/``gauge``/``histogram``, attribute calls
+    included) or any import alias of those factories."""
+    out: List[Tuple[str, str, int]] = []
+    for f in project.package_files():
+        if f.tree is None:
+            continue
+        factories = METRIC_FACTORIES | _aliases_of(
+            f.tree, {"counter", "gauge", "histogram"}
+        )
+        for node in ast.walk(f.tree):
+            if (
+                isinstance(node, ast.Call)
+                and call_name(node) in factories
+                and node.args
+            ):
+                name = str_const(node.args[0])
+                if name and name.startswith("isoforest_"):
+                    out.append((name, f.rel, node.lineno))
+    return out
+
+
+def recorded_event_kinds(project: Project) -> List[Tuple[str, str, int]]:
+    """(kind, file, line) for every literal ``record_event`` kind, under
+    any import alias."""
+    out: List[Tuple[str, str, int]] = []
+    for f in project.package_files():
+        if f.tree is None or f.rel.endswith("telemetry/events.py"):
+            continue
+        names = {"record_event"} | _aliases_of(f.tree, {"record_event"})
+        for node in ast.walk(f.tree):
+            if (
+                isinstance(node, ast.Call)
+                and call_name(node) in names
+                and node.args
+            ):
+                kind = str_const(node.args[0])
+                if kind:
+                    out.append((kind, f.rel, node.lineno))
+    return out
+
+
+def _doc_section(doc: str, heading_prefix: str) -> List[Tuple[int, str]]:
+    """(lineno, line) rows of the section whose ``## `` heading starts with
+    ``heading_prefix``, up to the next ``## `` heading."""
+    rows: List[Tuple[int, str]] = []
+    in_section = False
+    for lineno, line in enumerate(doc.splitlines(), 1):
+        if line.startswith("## "):
+            in_section = line.startswith(heading_prefix)
+            continue
+        if in_section:
+            rows.append((lineno, line))
+    return rows
+
+
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+
+
+def _table_first_cell_tokens(
+    rows: List[Tuple[int, str]]
+) -> List[Tuple[str, int]]:
+    """Backticked tokens from the first cell of each markdown table row."""
+    tokens: List[Tuple[str, int]] = []
+    for lineno, line in rows:
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            continue
+        cells = stripped.split("|")
+        first = cells[1] if len(cells) > 1 else ""
+        if set(first.strip()) <= {"-", ":", " "}:
+            continue  # separator row
+        for token in _BACKTICK_RE.findall(first):
+            tokens.append((token.strip(), lineno))
+    return tokens
+
+
+def documented_metrics(project: Project) -> List[Tuple[str, int]]:
+    """Metric names from the docs/observability.md §3 table (labels like
+    ``{strategy}`` stripped, ``*``-wildcard rows skipped)."""
+    if project.observability_doc is None:
+        return []
+    rows = _doc_section(project.observability_doc, "## 3.")
+    out: List[Tuple[str, int]] = []
+    for token, lineno in _table_first_cell_tokens(rows):
+        name = token.split("{")[0].strip()
+        if "*" in name or not name.startswith("isoforest_"):
+            continue
+        out.append((name, lineno))
+    return out
+
+
+def documented_event_kinds(project: Project) -> List[Tuple[str, int]]:
+    """Event kinds from the docs/observability.md §4 table."""
+    if project.observability_doc is None:
+        return []
+    rows = _doc_section(project.observability_doc, "## 4.")
+    out: List[Tuple[str, int]] = []
+    for token, lineno in _table_first_cell_tokens(rows):
+        if re.fullmatch(r"[a-z_]+(\.[a-z_]+)*", token):
+            out.append((token, lineno))
+    return out
+
+
+@rule("OBS001", "registered metrics must be documented")
+def check_metrics_documented(project: Project) -> List[Finding]:
+    doc = project.observability_doc or ""
+    findings: List[Finding] = []
+    for name, rel, lineno in registered_metrics(project):
+        if name not in doc:
+            findings.append(
+                Finding(
+                    "OBS001",
+                    rel,
+                    lineno,
+                    f"metric {name!r} is registered here but never appears "
+                    f"in {OBS_DOC} (the public schema, its §6)",
+                )
+            )
+    return findings
+
+
+@rule("OBS002", "documented metrics must be registered (doc rot)")
+def check_metrics_exist(project: Project) -> List[Finding]:
+    registered = {name for name, _, _ in registered_metrics(project)}
+    findings: List[Finding] = []
+    for name, lineno in documented_metrics(project):
+        if name not in registered:
+            findings.append(
+                Finding(
+                    "OBS002",
+                    OBS_DOC,
+                    lineno,
+                    f"documented metric {name!r} is not registered anywhere "
+                    "in isoforest_tpu/ — doc rot",
+                )
+            )
+    return findings
+
+
+@rule("OBS003", "recorded event kinds must be documented")
+def check_events_documented(project: Project) -> List[Finding]:
+    doc = project.observability_doc or ""
+    findings: List[Finding] = []
+    for kind, rel, lineno in recorded_event_kinds(project):
+        if kind not in doc:
+            findings.append(
+                Finding(
+                    "OBS003",
+                    rel,
+                    lineno,
+                    f"event kind {kind!r} is recorded here but never appears "
+                    f"in {OBS_DOC} §4 (the public schema)",
+                )
+            )
+    return findings
+
+
+@rule("OBS004", "documented event kinds must be recorded (doc rot)")
+def check_events_exist(project: Project) -> List[Finding]:
+    recorded = {kind for kind, _, _ in recorded_event_kinds(project)}
+    findings: List[Finding] = []
+    for kind, lineno in documented_event_kinds(project):
+        if kind not in recorded:
+            findings.append(
+                Finding(
+                    "OBS004",
+                    OBS_DOC,
+                    lineno,
+                    f"documented event kind {kind!r} is never recorded "
+                    "anywhere in isoforest_tpu/ — doc rot",
+                )
+            )
+    return findings
+
+
+# --------------------------------------------------------------------------- #
+# SLP001 — the FakeClock policy
+# --------------------------------------------------------------------------- #
+
+
+def _time_module_aliases(tree: ast.AST) -> Tuple[Set[str], bool]:
+    """(aliases of the ``time`` module, whether ``sleep`` itself was
+    imported from it)."""
+    aliases: Set[str] = set()
+    bare_sleep = False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time":
+                    aliases.add(alias.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "sleep":
+                    bare_sleep = True
+    return aliases, bare_sleep
+
+
+@rule("SLP001", "tests must not sleep on the wall clock")
+def check_test_sleeps(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in project.test_files():
+        if f.tree is None:
+            continue
+        aliases, bare_sleep = _time_module_aliases(f.tree)
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            hit = False
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr == "sleep"
+                and isinstance(func.value, ast.Name)
+                and func.value.id in aliases
+            ):
+                hit = True
+            elif isinstance(func, ast.Name) and func.id == "sleep" and bare_sleep:
+                hit = True
+            if hit:
+                findings.append(
+                    Finding(
+                        "SLP001",
+                        f.rel,
+                        node.lineno,
+                        "real time.sleep in a test — drive schedules with "
+                        "resilience.faults.FakeClock / event-gated waits "
+                        "(the zero-real-sleeps policy); a genuinely "
+                        "wall-clock-bound wait needs an explicit "
+                        "`# analysis: ignore[SLP001]` justification",
+                    )
+                )
+    return findings

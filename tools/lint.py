@@ -1,87 +1,31 @@
-"""Minimal in-repo lint gate (the image ships no ruff/flake8/mypy).
+"""Thin shim over ``tools/analysis`` (the lint-rule subset).
 
-Checks, over ``isoforest_tpu/`` + ``tests/`` + root scripts:
-  * every file parses (syntax);
-  * no unused imports (AST-based; ``__init__.py`` re-exports and
-    ``# noqa`` lines exempt);
-  * no tabs in indentation, no trailing whitespace.
+The original in-repo AST lint grew into the project-aware analyzer
+(``python -m tools.analysis``, docs/static_analysis.md); this entry keeps
+``make lint`` and the CI lint step stable, running exactly the original
+checks: SYN001 (syntax), IMP001 (unused imports), WSP001/WSP002
+(whitespace). Run the full analyzer for the project-invariant and
+lock-order rules.
 
-Exit 0 clean, 1 with findings listed. Run via ``make check``.
+Exit 0 clean, 1 with findings listed.
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-TARGETS = ["isoforest_tpu", "tests", "bench.py", "__graft_entry__.py", "tools"]
+sys.path.insert(0, str(ROOT))
 
-
-def _imported_names(tree: ast.AST):
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                yield node.lineno, alias.asname or alias.name.split(".")[0]
-        elif isinstance(node, ast.ImportFrom):
-            for alias in node.names:
-                if alias.name != "*":
-                    yield node.lineno, alias.asname or alias.name
-
-
-def _used_names(tree: ast.AST) -> set:
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            base = node
-            while isinstance(base, ast.Attribute):
-                base = base.value
-            if isinstance(base, ast.Name):
-                used.add(base.id)
-    return used
-
-
-def lint_file(path: pathlib.Path) -> list:
-    findings = []
-    text = path.read_text()
-    try:
-        tree = ast.parse(text, filename=str(path))
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
-    lines = text.splitlines()
-    for lineno, line in enumerate(lines, 1):
-        if line != line.rstrip():
-            findings.append(f"{path}:{lineno}: trailing whitespace")
-        if line.startswith("\t"):
-            findings.append(f"{path}:{lineno}: tab indentation")
-    if path.name != "__init__.py":
-        used = _used_names(tree)
-        docstring = ast.get_docstring(tree) or ""
-        for lineno, name in _imported_names(tree):
-            if name in used or name == "annotations":
-                continue
-            if lineno - 1 < len(lines) and "noqa" in lines[lineno - 1]:
-                continue
-            if f"`{name}`" in docstring:  # doc-referenced re-export
-                continue
-            findings.append(f"{path}:{lineno}: unused import {name!r}")
-    return findings
+from tools.analysis.core import run  # noqa: E402
+from tools.analysis.lint_rules import LINT_RULES  # noqa: E402
 
 
 def main() -> int:
-    findings = []
-    for target in TARGETS:
-        p = ROOT / target
-        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
-        for f in files:
-            if "__pycache__" in f.parts or ".jax_cache" in f.parts:
-                continue
-            findings.extend(lint_file(f))
+    findings = run(root=ROOT, select=list(LINT_RULES))
     for f in findings:
-        print(f)
+        print(f.text())
     print(f"lint: {len(findings)} finding(s)")
     return 1 if findings else 0
 
