@@ -302,6 +302,8 @@ class ModelManager:
         *,
         timeout_s: Optional[float] = None,
         strict: bool = False,
+        chunk_size: Optional[int] = None,
+        pipeline: Optional[bool] = None,
     ) -> np.ndarray:
         """Score a served batch through the active model (folding the drift
         monitor), remember the rows in the retrain reservoir (labels too,
@@ -309,9 +311,17 @@ class ModelManager:
         debounced drift trigger. ``timeout_s``/``strict`` forward to
         :meth:`model.score` — the serving layer uses ``timeout_s`` to bound
         coalesced-flush tail latency via the scoring watchdog + degradation
-        ladder (docs/resilience.md §6)."""
+        ladder (docs/resilience.md §6) and ``chunk_size``/``pipeline`` to
+        stream oversized flushes through the micro-batch executor
+        (docs/pipeline.md)."""
         model = self.model
-        scores = model.score(X, timeout_s=timeout_s, strict=strict)
+        scores = model.score(
+            X,
+            timeout_s=timeout_s,
+            strict=strict,
+            chunk_size=chunk_size,
+            pipeline=pipeline,
+        )
         self.reservoir.fold(X, y)
         self._maybe_trigger()
         return scores

@@ -519,6 +519,8 @@ class IsolationForestModel:
         nonfinite: str = "warn",
         timeout_s: Optional[float] = None,
         strategy: str = "auto",
+        chunk_size: Optional[int] = None,
+        pipeline: Optional[bool] = None,
     ) -> np.ndarray:
         """Outlier scores ``2^(-E[h(x)]/c(n))`` for an ``[N, F]`` matrix.
 
@@ -533,7 +535,11 @@ class IsolationForestModel:
         raises instead). Local-strategy path only — mesh scoring runs the
         fused sharded program without a watchdog. ``strategy`` defaults to
         ``"auto"``, resolved by the measured autotuner (docs/autotune.md;
-        the mesh path restricts it to the shard_map-jittable pair)."""
+        the mesh path restricts it to the shard_map-jittable pair).
+        ``chunk_size``/``pipeline`` forward to the streaming micro-batch
+        executor (docs/pipeline.md): batches spanning multiple chunks
+        double-buffer host→device transfer under compute, bitwise equal to
+        single-shot scoring."""
         X = np.asarray(X, np.float32)
         check_non_finite(X, nonfinite)
         validate_feature_vector_size(X.shape[1], self.total_num_features)
@@ -542,7 +548,13 @@ class IsolationForestModel:
                 from ..parallel.sharded import sharded_score
 
                 scores = sharded_score(
-                    mesh, self.forest, X, self.num_samples, score_strategy=strategy
+                    mesh,
+                    self.forest,
+                    X,
+                    self.num_samples,
+                    score_strategy=strategy,
+                    pipeline=pipeline,
+                    chunk_rows=chunk_size,
                 )
             else:
                 if self._scoring_layout is None:
@@ -556,11 +568,13 @@ class IsolationForestModel:
                     self.forest,
                     X,
                     self.num_samples,
+                    chunk_size=chunk_size,
                     strategy=strategy,
                     layout=self._scoring_layout,
                     strict=strict,
                     expected_features=expected,
                     timeout_s=timeout_s,
+                    pipeline=pipeline,
                 )
         monitor = self._monitor
         if monitor is not None:

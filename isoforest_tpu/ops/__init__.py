@@ -17,6 +17,12 @@ from .scoring_layout import (
     get_layout,
     pack_forest,
 )
+from .streaming import (
+    StreamingExecutor,
+    pipeline_enabled,
+    pipeline_stats,
+    resolve_chunk_rows,
+)
 from .traversal import (
     extended_path_lengths,
     path_lengths,
@@ -42,6 +48,10 @@ __all__ = [
     "PackedStandardLayout",
     "get_layout",
     "pack_forest",
+    "StreamingExecutor",
+    "pipeline_enabled",
+    "pipeline_stats",
+    "resolve_chunk_rows",
     "extended_path_lengths",
     "path_lengths",
     "score_matrix",

@@ -49,6 +49,7 @@ from .scoring_layout import (
     get_layout,
     pack_forest,
 )
+from .streaming import PLATFORM_DEFAULT_CHUNK, StreamingExecutor, pipeline_enabled
 from .tree_growth import StandardForest
 
 # Trees per lax.scan step of the gather walk. Blocking bounds the live
@@ -446,14 +447,6 @@ def _pad_buckets_enabled(override: bool | None) -> bool:
     )
 
 
-# Measured on a live v5e (2026-07-29, 524k rows x 100 trees, dense): bigger
-# chunks win monotonically — 0.81 s at 2^17, 0.64 s at 2^18, 0.53 s at 2^19
-# (single chunk) vs 0.35 s for the raw kernel on resident data; the gap is
-# per-chunk dispatch + tunnel transfer overhead. CPU keeps the smaller
-# working set (the XLA:CPU paths are latency- not dispatch-bound).
-PLATFORM_DEFAULT_CHUNK = {"tpu": 1 << 19, "cpu": 1 << 18}
-
-
 def _default_chunk_size() -> int:
     return PLATFORM_DEFAULT_CHUNK.get(_live_platform(), 1 << 18)
 
@@ -469,6 +462,7 @@ def score_matrix(
     expected_features: int | None = None,
     timeout_s: float | None = None,
     pad_to_bucket: bool | None = None,
+    pipeline: bool | None = None,
 ) -> np.ndarray:
     """Score a full ``[N, F]`` matrix, chunked along rows.
 
@@ -530,6 +524,16 @@ def score_matrix(
     ``strict=True`` raises at the timeout instead. A gather run that
     itself times out raises
     :class:`~isoforest_tpu.resilience.WatchdogTimeout`.
+
+    Multi-chunk execution runs through the streaming micro-batch executor
+    (:mod:`.streaming`, docs/pipeline.md): host-resident inputs stage
+    chunk *k+1* into a reusable host buffer and issue its (committed,
+    async) ``device_put`` while chunk *k* computes, with results fetched
+    at a lag of one — H2D, compute and D2H overlap, scores bitwise equal
+    to the single-shot path. ``pipeline=False`` (or
+    ``ISOFOREST_TPU_PIPELINE=0``) keeps chunking but uploads each chunk
+    synchronously; backends without committed async ``device_put`` take
+    the ``pipeline_fallback`` rung onto the same synchronous path.
     """
     if not isinstance(X, (np.ndarray, jax.Array)):
         X = np.asarray(X, np.float32)
@@ -706,48 +710,33 @@ def score_matrix(
     if n == 0:
         return np.zeros((0,), np.float32)
 
-    def _execute() -> np.ndarray:
-        # hung-kernel fault seam: stalls here (inside the watchdog scope)
-        # while slow_collective is armed — docs/resilience.md §3
-        faults.maybe_slow_collective(strategy)
-        if n <= chunk_size:
-            Xc = jnp.asarray(X, jnp.float32)
-            owned = Xc is not X
-            bucket = batch_bucket(n) if _pad_buckets_enabled(pad_to_bucket) else n
-            pad = bucket - n
-            if pad:
-                Xc = jnp.pad(Xc, ((0, pad), (0, 0)))
-                owned = True
-            return np.asarray(run_chunk(Xc, owned)[:n])
-
-        # Multi-chunk: (a) host-resident inputs are uploaded PER CHUNK inside
-        # the loop — async dispatch overlaps chunk k+1's host->device transfer
-        # with chunk k's compute (measured 26% faster than one upfront transfer
-        # at 2M rows on a live v5e; the upfront copy serialises ~120 MB through
-        # the tunnel before any compute starts at 10M rows); (b) every chunk is
-        # dispatched before any result is pulled back, so device compute also
-        # overlaps the device->host score transfers.
-        streaming = not isinstance(X, jax.Array)
-        Xd = X if streaming else jnp.asarray(X, jnp.float32)
-        outs = []
-        for start in range(0, n, chunk_size):
-            chunk = Xd[start : start + chunk_size]
-            if streaming:
-                chunk = jnp.asarray(chunk, jnp.float32)
-            pad = chunk_size - chunk.shape[0]
-            if pad:
-                chunk = jnp.pad(chunk, ((0, pad), (0, 0)))
-            # every multi-chunk buffer is a fresh slice/upload/pad — safe
-            # to donate on backends that honor it
-            scores = run_chunk(chunk, True)
-            outs.append(scores[: chunk_size - pad] if pad else scores)
-        return np.concatenate([np.asarray(o) for o in outs])
+    # One executor owns chunking, staging, donation and the watchdog
+    # (ops/streaming.py, docs/pipeline.md): multi-chunk host inputs
+    # double-buffer chunk k+1's committed device_put under chunk k's
+    # compute and fetch results at a lag of one (the loop here previously
+    # leaned on bare async dispatch — measured 26% faster than one upfront
+    # transfer at 2M rows on a live v5e; the executor adds the committed
+    # staging + bounded live buffers the shard_map paths need too). The
+    # slow_collective fault seam runs as the executor's prelude so stalls
+    # land inside the watchdog scope — docs/resilience.md §3.
+    executor = StreamingExecutor(
+        run_chunk,
+        chunk_size,
+        site="score_matrix",
+        single_pad=(
+            batch_bucket if _pad_buckets_enabled(pad_to_bucket) else None
+        ),
+        streaming=pipeline_enabled(pipeline),
+        timeout_s=timeout_s,
+        describe=f"scoring strategy {strategy!r}",
+        prelude=lambda: faults.maybe_slow_collective(strategy),
+    )
 
     def _execute_timed() -> np.ndarray:
         if not _scoring_metrics_on():
-            return _execute()
+            return executor.execute(X, n)
         t0 = time.perf_counter()
-        out = _execute()
+        out = executor.execute(X, n)
         _SCORING_SECONDS.observe(time.perf_counter() - t0, strategy=strategy)
         _SCORED_ROWS_TOTAL.inc(n, strategy=strategy)
         return out
@@ -755,17 +744,15 @@ def score_matrix(
     if timeout_s is None:
         return _execute_timed()
 
-    # scoring watchdog (docs/resilience.md §6): bound the strategy's
-    # wall-clock — a wedged native walker or a stalled Pallas compile is
-    # abandoned to its daemon thread and the batch retried ONCE on the
-    # portable gather kernel through the ladder. A gather run that itself
-    # times out raises: there is no lower rung to stand on.
+    # scoring watchdog (docs/resilience.md §6), armed by the executor:
+    # a wedged native walker or a stalled Pallas compile is abandoned to
+    # its daemon thread and the batch retried ONCE on the portable gather
+    # kernel through the ladder. A gather run that itself times out
+    # raises: there is no lower rung to stand on.
     from ..resilience import watchdog as _watchdog
 
     try:
-        return _watchdog.run_with_deadline(
-            _execute_timed, timeout_s, describe=f"scoring strategy {strategy!r}"
-        )
+        return _execute_timed()
     except _watchdog.WatchdogTimeout:
         if strategy == "gather":
             raise
@@ -790,4 +777,5 @@ def score_matrix(
             expected_features=expected_features,
             timeout_s=timeout_s,
             pad_to_bucket=pad_to_bucket,
+            pipeline=pipeline,
         )

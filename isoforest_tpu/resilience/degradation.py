@@ -90,6 +90,14 @@ LADDER: Dict[str, str] = {
         "thread): scores are gather's, within cross-strategy f32 tolerance; "
         "a gather run that itself times out raises WatchdogTimeout"
     ),
+    # streaming-executor rung (ops/streaming.py, docs/pipeline.md)
+    "pipeline_fallback": (
+        "committed async device_put unavailable for the streaming "
+        "micro-batch executor -> synchronous per-chunk upload: scores are "
+        "BITWISE identical (every scoring formulation is row-independent; "
+        "only the H2D/compute overlap is lost), so — like drift_alert — "
+        "this rung is deliberately strict-exempt"
+    ),
     # model-observability rung (telemetry/monitor.py, ScoreMonitor)
     "drift_alert": (
         "serving traffic drifted past the configured PSI threshold vs the "

@@ -77,6 +77,7 @@ KNOWN_FAULTS = frozenset(
         "fail_swap",
         "fail_distributed_init",
         "slow_collective",
+        "break_pipeline_stage",
     }
 )
 

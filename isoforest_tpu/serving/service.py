@@ -71,6 +71,13 @@ class ScoringService:
         self._bare_model = model
         self.manager = manager
         self.config = config or ServingConfig()
+        from ..ops.traversal import batch_bucket
+
+        # largest pre-warmed compiled batch shape; flushes beyond it stream
+        # through the micro-batch executor in bucket-sized chunks instead
+        # of compiling (and synchronously uploading) one oversized program
+        # (docs/pipeline.md) — prewarm() raises it to the largest bucket
+        self._max_warm_bucket = batch_bucket(self.config.batch_rows)
         self.coalescer = MicroBatchCoalescer(
             self._score_batch,
             max_batch_rows=self.config.batch_rows,
@@ -92,11 +99,22 @@ class ScoringService:
     def _score_batch(self, X: np.ndarray) -> np.ndarray:
         """One coalesced flush: a single scoring call on one complete model
         reference. Through the manager the flush also feeds the drift
-        monitor + reservoir and may trigger the retrain loop."""
+        monitor + reservoir and may trigger the retrain loop.
+
+        A flush larger than the largest pre-warmed bucket (a single
+        oversized request draining alone — e.g. a 1M-row CSV POST) streams
+        through the micro-batch executor in pre-warmed-bucket-sized chunks
+        (docs/pipeline.md): H2D overlaps compute, no oversized XLA program
+        is compiled on a live request, and the flusher returns to the
+        queue sooner. Scores are bitwise identical; the 429/503 admission
+        ladder is untouched (it runs at submit time, before scoring)."""
         timeout_s = self.config.score_timeout_s
+        kwargs = {}
+        if int(X.shape[0]) > self._max_warm_bucket:
+            kwargs = {"chunk_size": self._max_warm_bucket, "pipeline": True}
         if self.manager is not None:
-            return self.manager.score(X, timeout_s=timeout_s)
-        return self._bare_model.score(X, timeout_s=timeout_s)
+            return self.manager.score(X, timeout_s=timeout_s, **kwargs)
+        return self._bare_model.score(X, timeout_s=timeout_s, **kwargs)
 
     def score(self, rows: np.ndarray) -> np.ndarray:
         """Blocking request-side score: enqueue, coalesce, demultiplex.
@@ -142,6 +160,8 @@ class ScoringService:
                 }
             )
         model.warmup(batch_sizes=buckets)
+        if buckets:
+            self._max_warm_bucket = max(buckets)
         record_event(
             "serving.warmup",
             buckets=",".join(str(b) for b in buckets),

@@ -6,7 +6,16 @@ run a short warmed best-of-k probe of every eligible strategy and the
 winner table is cached as schema-versioned JSON with a TTL, FastForest-style
 (PAPERS.md, arxiv 2004.02423). See docs/autotune.md and
 :mod:`.autotuner` / :mod:`.cost_model`.
+
+The streaming executor's chunk policy
+(:func:`~isoforest_tpu.ops.streaming.resolve_chunk_rows`, re-exported
+here) rides the same bucket formula the table keys on: streamed
+micro-batches always land on the pre-warmed, autotuned compiled shapes
+(docs/pipeline.md), so a tuned decision for bucket ``b`` covers every
+chunk of a streamed run at chunk size ``b``.
 """
+
+from ..ops.streaming import resolve_chunk_rows
 
 from .autotuner import (
     DECISION_SOURCES,
@@ -49,6 +58,7 @@ __all__ = [
     "emit_decision",
     "model_bucket",
     "reset_cost_model",
+    "resolve_chunk_rows",
     "resolve_decision",
     "table_path",
     "table_snapshot",

@@ -288,3 +288,211 @@ class TestMeshWarmup:
         assert model.warmup(batch_sizes=(64,), mesh=mesh) is model
         scores = model.score(data[:64], mesh=mesh)
         assert np.isfinite(scores).all()
+
+
+class TestStreamedScoring:
+    """ISSUE 10: the streaming double-buffered pipeline (ops/streaming.py,
+    docs/pipeline.md) must produce scores BITWISE equal to the single-shot
+    upload — every traversal formulation is row-independent, so splitting
+    the row axis (and zero-padding the uneven final chunk) cannot change a
+    valid row's arithmetic."""
+
+    CHUNK = 1024  # 4093-row batches end on an uneven 1021-row final chunk
+
+    @pytest.fixture(scope="class")
+    def std_model(self, data):
+        return IsolationForest(num_estimators=16, max_samples=64.0).fit(data)
+
+    @pytest.fixture(scope="class")
+    def ext_model(self, data):
+        from isoforest_tpu import ExtendedIsolationForest
+
+        return ExtendedIsolationForest(
+            num_estimators=10, max_samples=64.0, extension_level=2
+        ).fit(data)
+
+    @pytest.mark.parametrize("rows", [4096, 4093])
+    def test_sharded_score_streamed_bitwise(self, mesh, data, std_model, rows):
+        X = data[:rows]
+        single = sharded_score(
+            mesh, std_model.forest, X, std_model.num_samples, pipeline=False
+        )
+        streamed = sharded_score(
+            mesh,
+            std_model.forest,
+            X,
+            std_model.num_samples,
+            pipeline=True,
+            chunk_rows=self.CHUNK,
+        )
+        np.testing.assert_array_equal(single, streamed)
+
+    @pytest.mark.parametrize("rows", [4096, 4093])
+    def test_sharded_score_streamed_bitwise_extended(
+        self, mesh, data, ext_model, rows
+    ):
+        X = data[:rows]
+        single = sharded_score(
+            mesh, ext_model.forest, X, ext_model.num_samples, pipeline=False
+        )
+        streamed = sharded_score(
+            mesh,
+            ext_model.forest,
+            X,
+            ext_model.num_samples,
+            pipeline=True,
+            chunk_rows=self.CHUNK,
+        )
+        np.testing.assert_array_equal(single, streamed)
+
+    @pytest.mark.parametrize("rows", [4096, 4093])
+    def test_sharded_score_2d_streamed_bitwise(self, mesh, data, std_model, rows):
+        X = data[:rows]
+        single = sharded_score_2d(
+            mesh, std_model.forest, X, std_model.num_samples, pipeline=False
+        )
+        streamed = sharded_score_2d(
+            mesh,
+            std_model.forest,
+            X,
+            std_model.num_samples,
+            pipeline=True,
+            chunk_rows=self.CHUNK,
+        )
+        np.testing.assert_array_equal(single, streamed)
+
+    def test_sharded_score_2d_streamed_bitwise_extended(
+        self, mesh, data, ext_model
+    ):
+        X = data[:4093]
+        single = sharded_score_2d(
+            mesh, ext_model.forest, X, ext_model.num_samples, pipeline=False
+        )
+        streamed = sharded_score_2d(
+            mesh,
+            ext_model.forest,
+            X,
+            ext_model.num_samples,
+            pipeline=True,
+            chunk_rows=self.CHUNK,
+        )
+        np.testing.assert_array_equal(single, streamed)
+
+    @pytest.mark.parametrize("donate", [False, True])
+    def test_streamed_donation_on_off(
+        self, mesh, data, std_model, donate, monkeypatch
+    ):
+        """Streamed chunk buffers are executor-owned, so the sharded path
+        may donate them on capable backends; forcing the donate-built
+        program on (XLA:CPU ignores donation with a warning) must not
+        change a bit."""
+        import isoforest_tpu.parallel.sharded as sh
+
+        single = sharded_score(
+            mesh, std_model.forest, data, std_model.num_samples, pipeline=False
+        )
+        monkeypatch.setattr(sh, "donation_supported", lambda platform=None: donate)
+        streamed = sharded_score(
+            mesh,
+            std_model.forest,
+            data,
+            std_model.num_samples,
+            pipeline=True,
+            chunk_rows=self.CHUNK,
+        )
+        np.testing.assert_array_equal(single, streamed)
+
+    def test_score_matrix_streamed_bitwise(self, data, std_model):
+        X = data[:4093]
+        one_shot = score_matrix(
+            std_model.forest, X, std_model.num_samples, strategy="gather"
+        )
+        streamed = score_matrix(
+            std_model.forest,
+            X,
+            std_model.num_samples,
+            strategy="gather",
+            chunk_size=self.CHUNK,
+            pipeline=True,
+        )
+        sync_chunks = score_matrix(
+            std_model.forest,
+            X,
+            std_model.num_samples,
+            strategy="gather",
+            chunk_size=self.CHUNK,
+            pipeline=False,
+        )
+        np.testing.assert_array_equal(one_shot, streamed)
+        np.testing.assert_array_equal(one_shot, sync_chunks)
+
+    def test_pipeline_metrics_and_event(self, mesh, data, std_model):
+        from isoforest_tpu import telemetry
+        from isoforest_tpu.ops.streaming import pipeline_stats
+
+        before = pipeline_stats("sharded")
+        last_seq = max((e.seq for e in telemetry.get_events()), default=0)
+        sharded_score(
+            mesh,
+            std_model.forest,
+            data,  # 4096 rows / 1024-row chunks -> 4 micro-batches
+            std_model.num_samples,
+            pipeline=True,
+            chunk_rows=self.CHUNK,
+        )
+        after = pipeline_stats("sharded")
+        assert after["chunks"] - before["chunks"] == 4
+        assert after["h2d_seconds"] >= before["h2d_seconds"]
+        assert 0.0 <= after["overlap_efficiency"] <= 1.0
+        runs = [
+            e
+            for e in telemetry.get_events(kind="pipeline.run", since_seq=last_seq)
+            if e.fields.get("site") == "sharded"
+        ]
+        assert len(runs) == 1
+        assert runs[0].fields["chunks"] == 4
+        assert runs[0].fields["rows"] == 4096
+        assert runs[0].fields["fallback"] is False
+
+    def test_pipeline_fallback_rung_fires_once(self, caplog):
+        """The break_pipeline_stage fault forces committed device_put
+        unavailable: every streamed execution records the pipeline_fallback
+        rung (count per occurrence) but WARNS exactly once, scores stay
+        bitwise correct, and the injected FakeClock proves the executor's
+        timing needs zero real sleeps (SLP001)."""
+        import logging
+
+        import jax.numpy as jnp
+
+        from isoforest_tpu.ops.streaming import StreamingExecutor
+        from isoforest_tpu.resilience import faults, reset_degradations
+        from isoforest_tpu.resilience.degradation import degradation_report
+
+        clock = faults.FakeClock()
+        reset_degradations("pipeline_fallback")
+        executor = StreamingExecutor(
+            lambda chunk, owned: jnp.asarray(chunk)[:, 0],
+            8,
+            site="test",
+            clock=clock.now,
+        )
+        X = np.arange(40, dtype=np.float32).reshape(20, 2)
+        with caplog.at_level(logging.WARNING, logger="isoforest_tpu"):
+            with faults.inject(break_pipeline_stage=True):
+                out1 = executor.execute(X, 20)
+                out2 = executor.execute(X, 20)
+        assert degradation_report().count("pipeline_fallback") == 2
+        warnings = [
+            r for r in caplog.records if "pipeline_fallback" in r.getMessage()
+        ]
+        assert len(warnings) == 1
+        assert clock.sleeps == []  # virtual time only — no wall-clock waits
+        np.testing.assert_array_equal(out1, X[:, 0])
+        np.testing.assert_array_equal(out2, X[:, 0])
+
+    def test_model_score_mesh_pipeline_passthrough(self, mesh, data, std_model):
+        direct = std_model.score(data, mesh=mesh)
+        streamed = std_model.score(
+            data, mesh=mesh, pipeline=True, chunk_size=self.CHUNK
+        )
+        np.testing.assert_array_equal(direct, streamed)

@@ -247,6 +247,44 @@ class TestParity:
             )
             assert p.flush_rows == 256 and p.flush_requests == len(slices)
 
+    def test_oversized_request_streams_through_executor(
+        self, model, data, monkeypatch
+    ):
+        """ISSUE 10: a request larger than the largest pre-warmed bucket
+        (here ``batch_bucket(64) == 1024``) must score through the
+        streaming micro-batch executor in bucket-sized chunks
+        (docs/pipeline.md) — provable from the ``isoforest_pipeline_*``
+        chunk counter — with scores bitwise equal to direct scoring and
+        the 429/503 admission ladder untouched. Strategy pinned to the
+        jax gather kernel: the native C++ walker is pure host numpy (no
+        H2D, no XLA program) and legitimately bypasses the executor."""
+        from isoforest_tpu.ops.streaming import pipeline_stats
+
+        monkeypatch.setenv("ISOFOREST_TPU_STRATEGY", "gather")
+        service = ScoringService(
+            model=model,
+            config=ServingConfig(batch_rows=64),
+            start=False,
+        )
+        assert service._max_warm_bucket == 1024
+        big = np.resize(data, (2500, data.shape[1]))
+        before = pipeline_stats("score_matrix")
+        pending = service.coalescer.submit(big)
+        assert service.coalescer.pump() == 1, "oversize request drains alone"
+        got = service.coalescer.result(pending, timeout_s=0)
+        after = pipeline_stats("score_matrix")
+        assert after["chunks"] - before["chunks"] == 3, "2500 rows / 1024 chunks"
+        np.testing.assert_array_equal(got, model.score(big))
+        # small requests keep the single-call path: exactly one chunk per
+        # score (the direct big score above, the small flush, the small
+        # direct reference — three single-chunk executions, never more)
+        small = service.coalescer.submit(data[:32])
+        service.coalescer.close(drain=True)
+        np.testing.assert_array_equal(
+            service.coalescer.result(small, timeout_s=0), model.score(data[:32])
+        )
+        assert pipeline_stats("score_matrix")["chunks"] - after["chunks"] == 3
+
     def test_parity_through_manager(self, model, data, tmp_path):
         """The lifecycle path (drift fold + reservoir) must not perturb
         scores either."""

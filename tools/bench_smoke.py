@@ -27,6 +27,12 @@ the same smoke workload, AND the tuner must select the measured r05 winner
 (``native``) for the CPU 1M-row regime — probed against an isolated table
 so a developer's real /tmp table is never touched.
 
+Fifth gate (docs/pipeline.md, ISSUE 10): the streamed double-buffered
+sharded scoring path must stay >= 0.95x the single-shot upload on the
+8-virtual-device CPU mesh, where overlap is pure overhead — run via
+``tools/pipeline_smoke.py`` in a subprocess so its 8-device XLA flag never
+perturbs this process's single-device timing gates.
+
 Timing asserts in shared CI runners are noisy, so both gates are best-of-N
 against a margin, not an exact comparison; the JSON line it prints records
 every timing for trend tracking.
@@ -237,6 +243,38 @@ def main() -> int:
         tuning.reset_cost_model()
     autotune_ratio = t_static / t_auto  # >= AUTOTUNE_MIN_RATIO to pass
 
+    # pipeline gate (docs/pipeline.md, ISSUE 10): streamed sharded scoring
+    # must stay >= 0.95x single-shot on the 8-virtual-device CPU mesh,
+    # where overlap is pure overhead (the win is on-device) — run as a
+    # subprocess so its 8-device XLA flag never perturbs the single-device
+    # timing gates above; its own JSON line rides along in ours
+    import subprocess
+
+    pipeline_json = None
+    ok_pipeline = False
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(pathlib.Path(__file__).resolve().parent / "pipeline_smoke.py")],
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                pipeline_json = json.loads(line)
+        ok_pipeline = proc.returncode == 0 and bool(
+            pipeline_json and pipeline_json.get("pass")
+        )
+        if not ok_pipeline:
+            print(
+                f"pipeline smoke subprocess rc={proc.returncode}: "
+                f"{proc.stderr.strip()[-300:]}",
+                file=sys.stderr,
+            )
+    except Exception as exc:  # noqa: BLE001 — a dead gate must fail loudly
+        print(f"pipeline smoke failed to run: {exc}", file=sys.stderr)
+
     # correctness guard alongside the timing gate: packed scores must match
     # the unpacked baseline's scores to float32 tolerance
     from isoforest_tpu.utils.math import avg_path_length
@@ -252,6 +290,7 @@ def main() -> int:
         and ok_monitor
         and ok_autotune_speed
         and ok_regime
+        and ok_pipeline
     )
     print(
         json.dumps(
@@ -281,6 +320,7 @@ def main() -> int:
                 "autotune_static_pick": static_pick,
                 "autotune_regime_pick": regime_pick,
                 "autotune_regime_expected": regime_expected,
+                "pipeline_smoke": pipeline_json,
                 "backend": jax.devices()[0].platform,
                 "pass": ok,
             }
@@ -295,7 +335,9 @@ def main() -> int:
             f"{t_mon_on:.4f}/{t_mon_off:.4f}s (margin {MONITOR_MARGIN}x), "
             f"autotuned auto {t_auto:.4f}s vs static {t_static:.4f}s "
             f"(min ratio {AUTOTUNE_MIN_RATIO}), 1M-regime pick "
-            f"{regime_pick!r} (expected {regime_expected!r})",
+            f"{regime_pick!r} (expected {regime_expected!r}), "
+            f"pipeline gate {'ok' if ok_pipeline else 'FAILED'} "
+            f"({pipeline_json})",
             file=sys.stderr,
         )
         return 1

@@ -1,4 +1,4 @@
-"""Device-count scaling curve for the fused distributed train step.
+"""Device-count scaling curves: fused train step AND streamed scoring.
 
 The reference's scale story is Spark executors (one tree per partition,
 SharedTrainLogic.scala:140-145); ours is a ``(data, trees)`` mesh. This tool
@@ -8,11 +8,20 @@ measures the same program at 1/2/4/8 devices two ways:
     with the mesh): ideal is flat wall-clock; the gap is collective overhead.
   * **strong scaling** — total work held constant: ideal is 1/n wall-clock.
 
+``--mode train`` (default) measures the fused distributed train step;
+``--mode score`` measures :func:`~isoforest_tpu.parallel.sharded_score`
+through the streaming double-buffered pipeline (docs/pipeline.md) — the
+linear-scaling yardstick ROADMAP item 3 asks for, recording rows/s vs
+device count weak + strong next to bench.py's roofline (each JSON line is
+also appended to ``benchmarks/scaling_score.jsonl``), with the run's
+``isoforest_pipeline_*`` roll-up (chunks, blocking H2D seconds, overlap
+efficiency) inline.
+
 On this image the mesh is 8 virtual CPU devices (the same validation surface
 as tests/test_parallel.py); on a real slice the identical script measures ICI
 instead. One JSON line per point::
 
-    python tools/scaling_curve.py [--rows 262144] [--trees 128]
+    python tools/scaling_curve.py [--mode score] [--rows 262144] [--trees 128]
 """
 
 from __future__ import annotations
@@ -40,6 +49,23 @@ def main() -> None:
         help="cpu = virtual-device mesh (safe when the TPU tunnel is wedged: "
         "probing the default backend would hang); default = whatever the "
         "environment registers (a real slice on TPU hosts)",
+    )
+    ap.add_argument(
+        "--mode",
+        choices=("train", "score"),
+        default="train",
+        help="train = fused distributed train step (default); score = "
+        "streamed sharded_score through the double-buffered pipeline "
+        "(rows/s vs device count, weak + strong — ROADMAP item 3's "
+        "linear-scaling yardstick, appended to "
+        "benchmarks/scaling_score.jsonl)",
+    )
+    ap.add_argument(
+        "--chunk-rows",
+        type=int,
+        default=None,
+        help="--mode score: pipeline micro-batch size override "
+        "(default: the autotuner-bucket-aligned platform chunk)",
     )
     ap.add_argument(
         "--score-variants",
@@ -150,6 +176,79 @@ def main() -> None:
                 flush=True,
             )
 
+    _score_model = {}
+
+    def run_score(n_dev: int, rows: int, mode: str) -> None:
+        """One streamed-scoring point: rows sharded over ``n_dev`` devices,
+        forest replicated, host->device transfer double-buffered under
+        compute (docs/pipeline.md). The forest is FIXED across device
+        counts (scoring work scales with rows x trees; growing the forest
+        with the mesh would conflate ensemble size with scale-out), so
+        weak scaling holds per-device rows constant and strong scaling
+        total rows."""
+        import pathlib
+
+        from isoforest_tpu import IsolationForest
+        from isoforest_tpu.ops.streaming import pipeline_stats, resolve_chunk_rows
+        from isoforest_tpu.parallel import sharded_score
+
+        if "model" not in _score_model:
+            _score_model["model"] = IsolationForest(
+                num_estimators=args.trees,
+                max_samples=float(args.samples),
+                random_seed=1,
+            ).fit(X_full[: min(args.rows, 1 << 16)])
+        model = _score_model["model"]
+        mesh = create_mesh(devices=jax.devices()[:n_dev])
+        X = X_full[:rows]
+        # at least two chunks per run so the measurement exercises the
+        # double-buffered pipeline, not just the single-shot path
+        chunk = resolve_chunk_rows(
+            args.chunk_rows
+            if args.chunk_rows is not None
+            else min(resolve_chunk_rows(platform=platform), max(rows // 2, 1)),
+            platform,
+            multiple=n_dev,
+        )
+        kw = dict(pipeline=True, chunk_rows=chunk)
+        sharded_score(mesh, model.forest, X, model.num_samples, **kw)  # warm
+        before = pipeline_stats("sharded")
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            sharded_score(mesh, model.forest, X, model.num_samples, **kw)
+            best = min(best, time.perf_counter() - t0)
+        after = pipeline_stats("sharded")
+        line = json.dumps(
+            {
+                "metric": f"{mode}_scaling_score",
+                "devices": n_dev,
+                "rows": rows,
+                "trees": args.trees,
+                "value": round(best, 4),
+                "unit": "s",
+                "rows_per_s": round(rows / best, 1),
+                "backend": platform,
+                "mesh": dict(mesh.shape),
+                "chunk_rows": chunk,
+                "pipeline": {
+                    "chunks": after["chunks"] - before["chunks"],
+                    "h2d_seconds": round(
+                        after["h2d_seconds"] - before["h2d_seconds"], 6
+                    ),
+                    "overlap_efficiency": after["overlap_efficiency"],
+                },
+            }
+        )
+        print(line, flush=True)
+        out = (
+            pathlib.Path(__file__).resolve().parent.parent
+            / "benchmarks"
+            / "scaling_score.jsonl"
+        )
+        with out.open("a") as fh:
+            fh.write(line + "\n")
+
     def northstar_dryrun(n_dev: int) -> None:
         """Compile the whole distributed train step at the north-star shape
         (BASELINE.json: 10M-row KDDCup99-HTTP, here with the 1000-tree
@@ -242,6 +341,15 @@ def main() -> None:
         # make_train_step requires rows/trees to divide the mesh axes;
         # rounding to a multiple of the device count satisfies any factoring
         return max(n_dev, value - value % n_dev)
+
+    if args.mode == "score":
+        # the linear-scaling scoring yardstick (ROADMAP item 3): rows/s vs
+        # device count through the streamed sharded path, weak then strong
+        for n_dev in dev_counts:
+            run_score(n_dev, fit_multiple(args.rows * n_dev // n_max, n_dev), "weak")
+        for n_dev in dev_counts:
+            run_score(n_dev, fit_multiple(args.rows, n_dev), "strong")
+        return
 
     for n_dev in dev_counts:
         # weak: per-device share constant
