@@ -86,6 +86,49 @@ def main() -> None:
             f"(bitwise-equal to model.score)"
         )
 
+    # --- the fleet: many tenants, one port, budgeted residency ---
+    # (docs/fleet.md; `python -m isoforest_tpu serve --models-dir` is the
+    # CLI form). Two tenants with different seeds score differently on the
+    # same rows; each answers its own /score/<model_id> route bitwise-equal
+    # to its own model, and GET /models lists the fleet.
+    from isoforest_tpu.fleet import serve_fleet
+
+    fleet_dir = os.path.join(workdir, "fleet")
+    os.makedirs(fleet_dir, exist_ok=True)
+    models = {}
+    for model_id, seed in (("surface-a", 1), ("surface-b", 2)):
+        m = IsolationForest(
+            num_estimators=50, contamination=0.01, random_seed=seed
+        ).fit(X[:50_000])
+        m.save(os.path.join(fleet_dir, model_id))
+        models[model_id] = m
+
+    with serve_fleet(fleet_dir, port=0) as fleet:
+        probe = [float(v) for v in X[0]]
+        scores = {}
+        for model_id, m in models.items():
+            req = urllib.request.Request(
+                f"{fleet.url}/score/{model_id}",
+                data=json.dumps({"row": probe}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            doc = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            assert doc["model_id"] == model_id
+            assert doc["scores"][0] == float(m.score(X[:1])[0])  # bitwise
+            scores[model_id] = doc["scores"][0]
+        assert scores["surface-a"] != scores["surface-b"]  # distinct models
+        listing = json.loads(
+            urllib.request.urlopen(fleet.url + "/models", timeout=30).read()
+        )
+        assert listing["resident_models"] == 2
+        print(
+            f"fleet {fleet.url}: "
+            + ", ".join(
+                f"{mid}={scores[mid]:.6f}" for mid in sorted(scores)
+            )
+            + f" ({listing['resident_bytes']:,} packed bytes resident)"
+        )
+
     # --- portable artifact: ONNX export + independent structural check ---
     from isoforest_tpu.onnx import check_model, convert_and_save
     from isoforest_tpu.onnx.runtime import run_model

@@ -23,6 +23,8 @@ operational surface here is a small CLI over CSV files:
     python -m isoforest_tpu serve /tmp/model --port 9100 \\
         [--batch-rows 1024] [--linger-ms 2] [--max-queue-rows 8192] \\
         [--queue-deadline-ms 2000] [--no-lifecycle] [--max-seconds N]
+    python -m isoforest_tpu serve --models-dir /tmp/models --port 9100 \\
+        [--fleet-budget-mb 64] [--preload]  # POST /score/<model_id>
 
 CSV rows are feature columns; ``--labeled`` treats the last column as a label
 (excluded from features; used to report AUROC after fit/score).
@@ -336,12 +338,25 @@ def cmd_serve(args) -> int:
     last swapped generation from ``CURRENT.json``), mount the scoring
     endpoint with dynamic micro-batch coalescing on the telemetry HTTP
     server, pre-warm the autotuned batch buckets, print one JSON ready
-    line, and serve until SIGTERM/SIGINT (or ``--max-seconds``)."""
+    line, and serve until SIGTERM/SIGINT (or ``--max-seconds``).
+
+    With ``--models-dir`` the process serves a multi-tenant **fleet**
+    instead (docs/fleet.md): every sealed model directory under the dir
+    becomes a tenant behind ``POST /score/<model_id>`` (+ ``GET /models``),
+    loaded lazily under the ``--fleet-budget-mb`` residency LRU, each with
+    its own coalescer, admission queue and lifecycle manager."""
     import signal
     import threading
 
     from .serving import ServingConfig, serve_model
 
+    if (args.model_dir is None) == (args.models_dir is None):
+        print(
+            "error: pass exactly one of <model_dir> (single-model serving) "
+            "or --models-dir (multi-tenant fleet)",
+            file=sys.stderr,
+        )
+        return 2
     config = ServingConfig(
         batch_rows=args.batch_rows,
         linger_ms=args.linger_ms,
@@ -360,38 +375,64 @@ def cmd_serve(args) -> int:
     }
     if args.threshold is not None:
         manager_kwargs["monitor_threshold"] = args.threshold
-    handle = serve_model(
-        args.model_dir,
-        port=args.port,
-        host=args.host,
-        config=config,
-        lifecycle=not args.no_lifecycle,
-        work_dir=args.work_dir,
-        warm_batch_sizes=warm or (1,),
-        manager_kwargs=manager_kwargs,
-    )
+    if args.models_dir is not None:
+        from .fleet import serve_fleet
+
+        budget = (
+            int(args.fleet_budget_mb * (1 << 20))
+            if args.fleet_budget_mb is not None
+            else None
+        )
+        handle = serve_fleet(
+            args.models_dir,
+            port=args.port,
+            host=args.host,
+            config=config,
+            budget_bytes=budget,
+            lifecycle=not args.no_lifecycle,
+            work_root=args.work_dir,
+            manager_kwargs=manager_kwargs,
+            preload=args.preload,
+        )
+        ready = {
+            "serving": True,
+            "fleet": True,
+            "url": handle.url,
+            "endpoint": handle.url + "/score/<model_id>",
+            "models": handle.registry.model_ids(),
+            "budget_bytes": budget,
+            "batch_rows": config.batch_rows,
+            "linger_ms": config.linger_ms,
+        }
+    else:
+        handle = serve_model(
+            args.model_dir,
+            port=args.port,
+            host=args.host,
+            config=config,
+            lifecycle=not args.no_lifecycle,
+            work_dir=args.work_dir,
+            warm_batch_sizes=warm or (1,),
+            manager_kwargs=manager_kwargs,
+        )
+        ready = {
+            "serving": True,
+            "url": handle.url,
+            "endpoint": handle.url + "/score",
+            "model": args.model_dir,
+            "lifecycle": handle.manager is not None,
+            "generation": (
+                handle.manager.generation if handle.manager is not None else None
+            ),
+            "batch_rows": config.batch_rows,
+            "linger_ms": config.linger_ms,
+        }
     stop = threading.Event()
     try:
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
     except ValueError:
         pass  # not the main thread (in-process tests drive stop themselves)
-    print(
-        json.dumps(
-            {
-                "serving": True,
-                "url": handle.url,
-                "endpoint": handle.url + "/score",
-                "model": args.model_dir,
-                "lifecycle": handle.manager is not None,
-                "generation": (
-                    handle.manager.generation if handle.manager is not None else None
-                ),
-                "batch_rows": config.batch_rows,
-                "linger_ms": config.linger_ms,
-            }
-        ),
-        flush=True,
-    )
+    print(json.dumps(ready), flush=True)
     try:
         stop.wait(args.max_seconds)  # None waits until SIGTERM/SIGINT
     except KeyboardInterrupt:
@@ -614,9 +655,37 @@ def build_parser() -> argparse.ArgumentParser:
 
     srv = sub.add_parser(
         "serve",
-        help="serve POST /score with dynamic micro-batch coalescing",
+        help="serve POST /score with dynamic micro-batch coalescing "
+        "(or a multi-tenant fleet with --models-dir)",
     )
-    srv.add_argument("model_dir")
+    srv.add_argument(
+        "model_dir",
+        nargs="?",
+        default=None,
+        help="single-model mode: the sealed model directory to serve "
+        "(mutually exclusive with --models-dir)",
+    )
+    srv.add_argument(
+        "--models-dir",
+        default=None,
+        help="fleet mode (docs/fleet.md): serve every sealed model "
+        "directory under this dir as a tenant behind POST "
+        "/score/<model_id> (the subdir name is the model id)",
+    )
+    srv.add_argument(
+        "--fleet-budget-mb",
+        type=float,
+        default=None,
+        help="fleet residency budget in MiB of packed scoring-layout "
+        "bytes: past it, least-recently-used tenants are evicted and "
+        "re-load lazily from their sealed dirs (default: unbounded)",
+    )
+    srv.add_argument(
+        "--preload",
+        action="store_true",
+        help="fleet mode: load every tenant at startup instead of lazily "
+        "on first request",
+    )
     srv.add_argument("--host", default="127.0.0.1")
     srv.add_argument(
         "--port",
@@ -681,7 +750,8 @@ def build_parser() -> argparse.ArgumentParser:
         "--work-dir",
         default=None,
         help="lifecycle artifact dir (default: <model_dir>.lifecycle); "
-        "CURRENT.json there resumes the last swapped generation",
+        "CURRENT.json there resumes the last swapped generation. In fleet "
+        "mode this is the work ROOT: each tenant gets <work-dir>/<model_id>",
     )
     srv.add_argument("--threshold", type=float, default=None)
     srv.add_argument("--debounce", type=int, default=3)

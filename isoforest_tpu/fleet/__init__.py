@@ -1,0 +1,61 @@
+"""Multi-tenant model fleet: registry, budgeted residency, per-tenant
+serving behind one port (ROADMAP item 2, docs/fleet.md).
+
+The serving layer built in PR 8 owns exactly one model; a real anti-abuse
+deployment (the reference library's own use case) runs *hundreds* — one
+per surface, region and entity type. This package turns the single-model
+service into a fleet: a :class:`ModelRegistry` maps ``model_id`` to a
+lazily loaded per-tenant stack (model + lifecycle manager + coalescing
+scoring service), a byte-budgeted LRU bounds how many packed scoring
+layouts stay resident (evicted tenants re-load from their sealed gen dirs,
+resuming the last swapped generation), and ``POST /score/<model_id>`` /
+``GET /models`` ride the same telemetry HTTP daemon as everything else —
+one port, one process, per-tenant isolation for backpressure, drift,
+retraining and hot-swaps.
+
+Start one with ``python -m isoforest_tpu serve --models-dir <dir>`` or
+:func:`serve_fleet`; load-test a tenant with
+``tools/serving_latency.py --model-id <id>``.
+"""
+
+from .registry import (
+    EVICT_BUDGET,
+    EVICT_CLOSE,
+    EVICT_EXPLICIT,
+    EVICT_FAULT,
+    ManagedEntry,
+    ModelLoadError,
+    ModelRegistry,
+    UnknownModelError,
+    layout_nbytes,
+)
+from .service import (
+    MODELS_PATH,
+    SCORE_PREFIX,
+    FleetHandle,
+    FleetService,
+    discover_models,
+    mount_fleet,
+    serve_fleet,
+    unmount_fleet,
+)
+
+__all__ = [
+    "EVICT_BUDGET",
+    "EVICT_CLOSE",
+    "EVICT_EXPLICIT",
+    "EVICT_FAULT",
+    "FleetHandle",
+    "FleetService",
+    "MODELS_PATH",
+    "ManagedEntry",
+    "ModelLoadError",
+    "ModelRegistry",
+    "SCORE_PREFIX",
+    "UnknownModelError",
+    "discover_models",
+    "layout_nbytes",
+    "mount_fleet",
+    "serve_fleet",
+    "unmount_fleet",
+]

@@ -1,0 +1,605 @@
+"""Model fleet registry: many tenants, one process, budgeted residency.
+
+The reference library's own deployment (LinkedIn anti-abuse) runs hundreds
+of isolation-forest models — one per surface, region and entity type — and
+the inductive-bias analysis (arXiv 2505.12825) says that is the *correct*
+unit of operation: per-tenant data distributions differ enough that each
+workload wants its own baseline, drift monitor and retrain loop rather
+than one global forest. FastForest (arXiv 2004.02423) supplies the other
+half of the argument: per-model footprints are small (the packed scoring
+layout is ~8 bytes/node, docs/scoring_layout.md), so high-density
+co-residency in one process is practical — *if* something manages which
+models are resident.
+
+:class:`ModelRegistry` is that something (docs/fleet.md):
+
+* **Registration is cheap.** ``register(model_id, model_dir)`` records the
+  sealed on-disk directory and the tenant's serving knobs; nothing loads.
+  The on-disk dirs stay authoritative forever — residency is a cache.
+* **Loads are lazy and resumable.** A tenant's first request (or the first
+  after an eviction) loads the model via the shared
+  :func:`~isoforest_tpu.io.persistence.load_model` path, wraps it in a
+  :class:`~isoforest_tpu.lifecycle.ModelManager` (which resumes the last
+  swapped generation from ``work_dir/CURRENT.json`` — a re-load lands on
+  the generation the tenant last swapped to, not its seed) and builds a
+  per-tenant :class:`~isoforest_tpu.serving.ScoringService` — its own
+  coalescer, its own admission queue, its own backpressure. One tenant's
+  429/503, drift debounce, retrain or hot-swap never perturbs another's.
+* **Residency is byte-budgeted LRU.** Each resident model pins its packed
+  scoring-layout bytes (:func:`layout_nbytes` — the planes every strategy
+  actually gathers from); when a load pushes the fleet past
+  ``budget_bytes``, the least-recently-used resident tenants are evicted
+  (coalescer drained first — in-flight flushes finish on their
+  point-in-time model reference, bitwise-exact) until the fleet fits. A
+  tenant mid-retrain is **pinned**: eviction is refused until the swap or
+  rollback completes, so a background refit is never torn down under a
+  cost-pressure race.
+* **Everything is observable.** ``fleet.load`` / ``fleet.evict`` /
+  ``fleet.evict_refused`` events, the
+  ``isoforest_fleet_{resident_models,resident_bytes,loads_total,
+  evictions_total}`` series, and two degradation rungs:
+  ``fleet_load_failed`` (a broken tenant refuses with a typed 503, the
+  rest of the fleet keeps serving) and ``fleet_evict_under_load`` (an
+  eviction drained in-flight work — operational note, scores exact).
+
+Lock discipline (audited by ``tools/analysis`` LCK001 and the runtime
+witness): the registry lock guards only the entry map and the residency
+accounting and never calls out while held; each entry's lock serialises
+that tenant's load/evict transitions and may acquire the registry lock
+(for accounting) but never another entry's. The scoring hot path holds
+neither — it submits to a point-in-time service reference.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..resilience import faults
+from ..resilience.degradation import degrade
+from ..serving.coalescer import CoalescerClosedError, ServingError
+from ..serving.service import ScoringService, ServingConfig
+from ..telemetry.events import record_event
+from ..telemetry.metrics import counter as _counter, gauge as _gauge
+from ..utils.logging import logger
+
+_RESIDENT_MODELS = _gauge(
+    "isoforest_fleet_resident_models",
+    "Models currently resident (packed scoring layout in memory) in the "
+    "fleet registry",
+)
+_RESIDENT_BYTES = _gauge(
+    "isoforest_fleet_resident_bytes",
+    "Packed scoring-layout bytes pinned by the resident fleet models "
+    "(the quantity the residency budget bounds)",
+)
+_LOADS_TOTAL = _counter(
+    "isoforest_fleet_loads_total",
+    "Fleet model loads (first-request lazy loads and post-eviction "
+    "re-loads), per tenant",
+    labelnames=("model_id",),
+)
+_EVICTIONS_TOTAL = _counter(
+    "isoforest_fleet_evictions_total",
+    "Fleet residency evictions by cause "
+    "(budget = LRU under byte pressure; explicit = operator/API call; "
+    "fault_injected = the evict_during_score seam; close = shutdown)",
+    labelnames=("cause",),
+)
+
+# eviction causes (the {cause=} label values)
+EVICT_BUDGET = "budget"
+EVICT_EXPLICIT = "explicit"
+EVICT_FAULT = "fault_injected"
+EVICT_CLOSE = "close"
+
+# a model id is a URL path segment (POST /score/<model_id>) and a metric
+# label value: keep it to a conservative, unescapable alphabet
+_MODEL_ID_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9._-]{0,63}")
+
+
+class UnknownModelError(ServingError):
+    """No tenant registered under this model id (HTTP 404)."""
+
+    status = 404
+
+
+class ModelLoadError(ServingError):
+    """The tenant's lazy (re)load failed; the registry will retry on its
+    next request (HTTP 503 — retriable; other tenants are unaffected)."""
+
+    status = 503
+
+
+def layout_nbytes(model) -> int:
+    """Bytes the model's finalized packed scoring layout pins while
+    resident — the planes every scoring strategy gathers from
+    (docs/scoring_layout.md): the interleaved record, the value plane and
+    (standard forests) the narrowed feature table. This is the quantity
+    the residency budget accounts; the raw growth arrays and Python object
+    overhead ride along but the packed planes dominate at fleet density."""
+    if getattr(model, "_scoring_layout", None) is None:
+        model.finalize_scoring()
+    return sum(
+        int(arr.size) * int(arr.dtype.itemsize) for arr in model._scoring_layout
+    )
+
+
+class ManagedEntry:
+    """One registered tenant: its sealed model dir (authoritative), its
+    lifecycle work dir, its serving knobs, and — while resident — its
+    loaded model, manager and per-tenant scoring service. The entry lock
+    serialises load/evict transitions for this tenant only."""
+
+    def __init__(
+        self,
+        model_id: str,
+        model_dir: str,
+        work_dir: str,
+        config: ServingConfig,
+        lifecycle: bool,
+        manager_kwargs: dict,
+    ) -> None:
+        self.model_id = model_id
+        self.model_dir = model_dir
+        self.work_dir = work_dir
+        self.config = config
+        self.lifecycle = lifecycle
+        self.manager_kwargs = manager_kwargs
+        self._lock = threading.Lock()
+        self.model = None
+        self.manager = None
+        self.service: Optional[ScoringService] = None
+        self.resident_bytes = 0
+        self.loads = 0
+        self.last_used = 0  # registry LRU sequence number
+        self.last_load_error: Optional[str] = None
+
+    @property
+    def resident(self) -> bool:
+        return self.service is not None
+
+    @property
+    def pinned(self) -> bool:
+        """True while this tenant's manager is mid-retrain — eviction is
+        refused until the swap/rollback completes (docs/fleet.md)."""
+        manager = self.manager
+        return manager is not None and manager.retrain_in_progress
+
+    @property
+    def generation(self) -> Optional[int]:
+        manager = self.manager
+        return manager.generation if manager is not None else None
+
+    def state(self) -> dict:
+        """Operator-facing tenant state (plain JSON types) — one row of
+        ``GET /models`` and of the ``/healthz`` fleet section."""
+        service = self.service
+        manager = self.manager
+        doc = {
+            "model_id": self.model_id,
+            "model_dir": self.model_dir,
+            "resident": service is not None,
+            "resident_bytes": self.resident_bytes,
+            "loads": self.loads,
+            "last_used_seq": self.last_used,
+            "pinned": self.pinned,
+            "lifecycle": manager is not None,
+            "generation": self.generation,
+            "queue_rows": service.coalescer.pending_rows if service else None,
+            "retrain_in_progress": (
+                manager.retrain_in_progress if manager is not None else False
+            ),
+            "last_load_error": self.last_load_error,
+        }
+        return doc
+
+
+class ModelRegistry:
+    """``model_id -> ManagedEntry`` with a byte-budgeted residency LRU
+    (module docstring; wire routes and policy tables: docs/fleet.md).
+
+    ``budget_bytes=None`` disables eviction (every registered tenant may
+    stay resident). ``config`` is the default per-tenant
+    :class:`ServingConfig` (override per tenant at :meth:`register`);
+    ``lifecycle``/``manager_kwargs`` likewise. ``clock`` is injectable for
+    tests.
+    """
+
+    def __init__(
+        self,
+        *,
+        budget_bytes: Optional[int] = None,
+        config: Optional[ServingConfig] = None,
+        lifecycle: bool = True,
+        manager_kwargs: Optional[dict] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if budget_bytes is not None and budget_bytes <= 0:
+            raise ValueError(f"budget_bytes must be positive, got {budget_bytes}")
+        self.budget_bytes = budget_bytes
+        self.default_config = config or ServingConfig()
+        self.default_lifecycle = bool(lifecycle)
+        self.default_manager_kwargs = dict(manager_kwargs or {})
+        self.closed = False
+        self._clock = clock
+        # guards the entry map, the LRU sequence and the residency totals;
+        # never held across a load/evict (those hold the entry lock and may
+        # acquire THIS lock for accounting — entry -> registry, one way)
+        self._lock = threading.Lock()
+        self._entries: Dict[str, ManagedEntry] = {}
+        self._seq = 0
+        self._resident_bytes = 0
+
+    # ------------------------------------------------------------------ #
+    # registration / lookup
+    # ------------------------------------------------------------------ #
+
+    def register(
+        self,
+        model_id: str,
+        model_dir: str,
+        *,
+        work_dir: Optional[str] = None,
+        config: Optional[ServingConfig] = None,
+        lifecycle: Optional[bool] = None,
+        manager_kwargs: Optional[dict] = None,
+    ) -> ManagedEntry:
+        """Register a tenant over a sealed model directory. Nothing loads
+        until the tenant's first request (or an explicit
+        :meth:`ensure_resident`). Refuses duplicate ids and ids that do not
+        fit the URL/label alphabet."""
+        model_id = str(model_id)
+        if not _MODEL_ID_RE.fullmatch(model_id):
+            raise ValueError(
+                f"model_id {model_id!r} must match {_MODEL_ID_RE.pattern} "
+                "(it becomes a URL path segment and a metric label)"
+            )
+        if not os.path.isdir(model_dir):
+            raise FileNotFoundError(
+                f"model_dir {model_dir!r} for tenant {model_id!r} does not exist"
+            )
+        entry = ManagedEntry(
+            model_id,
+            str(model_dir),
+            str(work_dir or model_dir + ".lifecycle"),
+            config or self.default_config,
+            self.default_lifecycle if lifecycle is None else bool(lifecycle),
+            dict(
+                self.default_manager_kwargs
+                if manager_kwargs is None
+                else manager_kwargs
+            ),
+        )
+        with self._lock:
+            if self.closed:
+                raise RuntimeError("the registry is closed")
+            if model_id in self._entries:
+                raise ValueError(f"model_id {model_id!r} is already registered")
+            self._entries[model_id] = entry
+        record_event("fleet.register", model_id=model_id, path=entry.model_dir)
+        return entry
+
+    def entry(self, model_id: str) -> ManagedEntry:
+        with self._lock:
+            entry = self._entries.get(str(model_id))
+        if entry is None:
+            raise UnknownModelError(
+                f"no model registered under id {str(model_id)!r}"
+            )
+        return entry
+
+    def model_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def models_state(self) -> List[dict]:
+        """Per-tenant state rows (``GET /models``), registration order
+        normalised to sorted ids."""
+        with self._lock:
+            entries = [self._entries[k] for k in sorted(self._entries)]
+        return [e.state() for e in entries]
+
+    def state(self) -> dict:
+        """Fleet-level state (plain JSON types)."""
+        with self._lock:
+            total = len(self._entries)
+            resident_bytes = self._resident_bytes
+            resident = sum(1 for e in self._entries.values() if e.resident)
+        return {
+            "models": total,
+            "resident_models": resident,
+            "resident_bytes": resident_bytes,
+            "budget_bytes": self.budget_bytes,
+        }
+
+    # ------------------------------------------------------------------ #
+    # residency
+    # ------------------------------------------------------------------ #
+
+    def ensure_resident(self, model_id: str) -> ManagedEntry:
+        """The tenant's entry with a live service, loading (and then
+        enforcing the residency budget) if needed; touches the LRU."""
+        entry = self.entry(model_id)
+        loaded = False
+        with entry._lock:
+            if entry.service is None:
+                self._load_entry_locked(entry)
+                loaded = True
+        with self._lock:
+            self._seq += 1
+            entry.last_used = self._seq
+        if loaded:
+            self._enforce_budget(exclude=entry.model_id)
+        return entry
+
+    def _load_entry_locked(self, entry: ManagedEntry) -> None:
+        """Load one tenant (caller holds the entry lock): sealed dir ->
+        model -> finalized packed layout -> lifecycle manager (resuming the
+        last swapped generation from CURRENT.json) -> per-tenant service.
+        Any failure takes the ``fleet_load_failed`` rung and refuses with a
+        typed 503; the entry stays non-resident and the NEXT request
+        retries — one broken tenant must never poison the fleet."""
+        from ..io.persistence import load_model
+        from ..lifecycle import ModelManager
+
+        t0 = time.perf_counter()
+        try:
+            faults.check_fleet_load(entry.model_id)
+            model = load_model(entry.model_dir)
+            manager = None
+            if entry.lifecycle and model.baseline is not None:
+                manager = ModelManager(
+                    model,
+                    work_dir=entry.work_dir,
+                    model_id=entry.model_id,
+                    **entry.manager_kwargs,
+                )
+            elif entry.lifecycle:
+                logger.warning(
+                    "fleet: %s (%s) has no _BASELINE.json sidecar — serving "
+                    "WITHOUT the lifecycle manager (no drift-triggered "
+                    "retraining); refit and re-save to enable it",
+                    entry.model_id,
+                    entry.model_dir,
+                )
+            active = manager.model if manager is not None else model
+            nbytes = layout_nbytes(active)
+            service = ScoringService(
+                model=None if manager is not None else model,
+                manager=manager,
+                config=entry.config,
+                model_id=entry.model_id,
+            )
+        except Exception as exc:
+            entry.last_load_error = repr(exc)
+            degrade(
+                "fleet_load_failed",
+                f"fleet tenant {entry.model_id!r} lazy load",
+                "typed 503 refusal (other tenants unaffected)",
+                detail=(
+                    f"loading {entry.model_dir} for tenant "
+                    f"{entry.model_id!r} failed: {exc!r}; the registry "
+                    "retries on the tenant's next request"
+                ),
+            )
+            raise ModelLoadError(
+                f"model {entry.model_id!r} failed to load ({exc!r}); "
+                "retriable — the registry reloads on the next request"
+            ) from exc
+        entry.model = active
+        entry.manager = manager
+        entry.service = service
+        entry.resident_bytes = nbytes
+        entry.loads += 1
+        entry.last_load_error = None
+        with self._lock:
+            self._resident_bytes += nbytes
+            resident = sum(1 for e in self._entries.values() if e.resident)
+            resident_bytes = self._resident_bytes
+        _RESIDENT_MODELS.set(resident)
+        _RESIDENT_BYTES.set(resident_bytes)
+        _LOADS_TOTAL.inc(model_id=entry.model_id)
+        record_event(
+            "fleet.load",
+            model_id=entry.model_id,
+            bytes=nbytes,
+            generation=entry.generation,
+            load_seconds=round(time.perf_counter() - t0, 6),
+            resident_models=resident,
+            resident_bytes=resident_bytes,
+        )
+        logger.info(
+            "fleet: loaded %s from %s (%d bytes packed, generation %s, "
+            "%d resident / %d bytes total)",
+            entry.model_id,
+            entry.model_dir,
+            nbytes,
+            entry.generation,
+            resident,
+            resident_bytes,
+        )
+
+    def _enforce_budget(self, exclude: Optional[str] = None) -> None:
+        """Evict least-recently-used resident tenants until the fleet fits
+        ``budget_bytes``. ``exclude`` protects the tenant whose load
+        triggered enforcement (evicting the model a request is about to
+        score would thrash). Pinned (mid-retrain) tenants are skipped; if
+        nothing is evictable the fleet stays over budget with a warning —
+        correctness over the budget, never a torn refit."""
+        if self.budget_bytes is None:
+            return
+        while True:
+            with self._lock:
+                if self._resident_bytes <= self.budget_bytes:
+                    return
+                victims = sorted(
+                    (
+                        e
+                        for e in self._entries.values()
+                        if e.resident and e.model_id != exclude
+                    ),
+                    key=lambda e: e.last_used,
+                )
+            evicted = False
+            for victim in victims:
+                if self.evict(victim.model_id, cause=EVICT_BUDGET):
+                    evicted = True
+                    break
+            if not evicted:
+                with self._lock:
+                    over = self._resident_bytes - self.budget_bytes
+                logger.warning(
+                    "fleet: %d bytes over the residency budget but no tenant "
+                    "is evictable (pinned mid-retrain, or only the active "
+                    "tenant remains); staying over budget",
+                    max(over, 0),
+                )
+                return
+
+    def evict(self, model_id: str, cause: str = EVICT_EXPLICIT) -> bool:
+        """Evict one tenant's resident state: drain its coalescer (every
+        in-flight flush completes on its point-in-time model reference,
+        bitwise-exact), close its manager, release the packed planes. The
+        sealed gen dirs stay authoritative — the next request re-loads,
+        resuming the last swapped generation. Returns False (and refuses)
+        when the tenant is not resident or is pinned mid-retrain."""
+        entry = self.entry(model_id)
+        with entry._lock:
+            service = entry.service
+            if service is None:
+                return False
+            manager = entry.manager
+            if manager is not None and manager.retrain_in_progress:
+                record_event(
+                    "fleet.evict_refused",
+                    model_id=entry.model_id,
+                    cause=cause,
+                    reason="retrain_in_progress",
+                )
+                logger.warning(
+                    "fleet: refusing to evict %s mid-retrain (pinned until "
+                    "the swap or rollback completes)",
+                    entry.model_id,
+                )
+                return False
+            in_flight = service.coalescer.pending_rows
+            if in_flight > 0:
+                degrade(
+                    "fleet_evict_under_load",
+                    f"fleet tenant {entry.model_id!r} resident with "
+                    f"{in_flight} in-flight row(s)",
+                    "drain coalescer, then evict",
+                    detail=(
+                        f"eviction ({cause}) drained {in_flight} queued "
+                        "row(s) first — in-flight flushes complete on their "
+                        "point-in-time model reference, bitwise-exact"
+                    ),
+                )
+            service.close()  # drain=True: no waiter is stranded
+            if manager is not None:
+                manager.close()
+            freed = entry.resident_bytes
+            entry.model = None
+            entry.manager = None
+            entry.service = None
+            entry.resident_bytes = 0
+        with self._lock:
+            self._resident_bytes -= freed
+            resident = sum(1 for e in self._entries.values() if e.resident)
+            resident_bytes = self._resident_bytes
+        _RESIDENT_MODELS.set(resident)
+        _RESIDENT_BYTES.set(resident_bytes)
+        _EVICTIONS_TOTAL.inc(cause=cause)
+        record_event(
+            "fleet.evict",
+            model_id=entry.model_id,
+            cause=cause,
+            bytes=freed,
+            resident_models=resident,
+            resident_bytes=resident_bytes,
+        )
+        logger.info(
+            "fleet: evicted %s (%s, %d bytes freed; %d resident / %d bytes "
+            "total; gen dirs on disk stay authoritative)",
+            entry.model_id,
+            cause,
+            freed,
+            resident,
+            resident_bytes,
+        )
+        return True
+
+    # ------------------------------------------------------------------ #
+    # scoring
+    # ------------------------------------------------------------------ #
+
+    def score(self, model_id: str, rows: np.ndarray) -> np.ndarray:
+        """Score through the tenant's own coalescer (loading it first if
+        cold). Raises the tenant's admission errors (429/503),
+        :class:`UnknownModelError` or :class:`ModelLoadError` — all typed,
+        all scoped to THIS tenant."""
+        scores, _ = self.score_detail(model_id, rows)
+        return scores
+
+    def score_detail(self, model_id: str, rows: np.ndarray):
+        """(scores, info) where info carries the flush accounting, the
+        generation and the active model reference the HTTP layer encodes.
+        A request that races an eviction (service closed between lookup
+        and submit) retries once against the re-loaded service."""
+        for attempt in (0, 1):
+            entry = self.ensure_resident(model_id)
+            service = entry.service  # point-in-time: eviction-safe
+            if service is None:
+                continue  # evicted between load and capture: reload
+            try:
+                pending = service.coalescer.submit(rows)
+            except CoalescerClosedError:
+                if attempt:
+                    raise
+                continue  # raced an eviction: one reload retry
+            if faults.evict_during_score():
+                # the eviction-under-load drill: drain-then-evict while this
+                # very request is in flight; its scores must still arrive,
+                # bitwise-exact, from the drained flush
+                self.evict(model_id, cause=EVICT_FAULT)
+            scores = service.coalescer.result(
+                pending, timeout_s=entry.config.request_timeout_s
+            )
+            model = service.model
+            manager = service.manager
+            info = {
+                "model": model,
+                "generation": manager.generation if manager is not None else None,
+                "flush_rows": pending.flush_rows,
+                "flush_requests": pending.flush_requests,
+            }
+            return scores, info
+        raise ModelLoadError(
+            f"model {model_id!r} was evicted twice while the request was "
+            "being admitted; retry"
+        )
+
+    # ------------------------------------------------------------------ #
+    # teardown
+    # ------------------------------------------------------------------ #
+
+    def close(self) -> None:
+        """Tear the whole fleet down: wait out in-flight retrains (a
+        shutdown never tears a refit), drain every coalescer, release
+        everything. Idempotent."""
+        with self._lock:
+            if self.closed:
+                return
+            self.closed = True
+            entries = list(self._entries.values())
+        for entry in entries:
+            manager = entry.manager
+            if manager is not None:
+                manager.wait_retrain()  # un-pins: shutdown is orderly
+            self.evict(entry.model_id, cause=EVICT_CLOSE)

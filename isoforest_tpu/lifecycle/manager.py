@@ -86,6 +86,15 @@ _RETRAIN_TOTAL = _counter(
     "(swapped | validation_failed | swap_failed | error)",
     labelnames=("outcome",),
 )
+# per-tenant twin of isoforest_model_generation for fleet deployments
+# (docs/fleet.md): managers constructed with model_id= report their
+# generation under that label so one scrape separates the tenants
+_FLEET_GENERATION = _gauge(
+    "isoforest_fleet_generation",
+    "Per-tenant active model generation under the fleet registry's "
+    "lifecycle managers (docs/fleet.md)",
+    labelnames=("model_id",),
+)
 
 # terminal retrain outcomes (the {outcome=} label values)
 OUTCOME_SWAPPED = "swapped"
@@ -167,6 +176,7 @@ class ModelManager:
         monitor_kwargs: Optional[dict] = None,
         hooks: Optional[Dict[str, Callable[[], None]]] = None,
         resume: bool = True,
+        model_id: Optional[str] = None,
     ) -> None:
         if model.baseline is None:
             raise ValueError(
@@ -182,6 +192,11 @@ class ModelManager:
             raise ValueError(
                 f"sliding_fraction must be in (0, 1], got {sliding_fraction}"
             )
+        # fleet tenant identity (docs/fleet.md): when set, every retrain.*
+        # / lifecycle.resume event carries model_id=, state() reports it,
+        # the attached monitor exports the per-tenant drift gauge, and the
+        # generation mirrors into isoforest_fleet_generation{model_id=}
+        self.model_id = None if model_id is None else str(model_id)
         self.work_dir = str(work_dir)
         os.makedirs(self.work_dir, exist_ok=True)
         self.mode = mode
@@ -220,8 +235,12 @@ class ModelManager:
         kwargs = dict(monitor_kwargs or {})
         if monitor_threshold is not None:
             kwargs["threshold"] = monitor_threshold
+        if self.model_id is not None:
+            kwargs.setdefault("model_id", self.model_id)
         self._monitor = self._model.enable_monitoring(**kwargs)
         _GENERATION.set(self.generation)
+        if self.model_id is not None:
+            _FLEET_GENERATION.set(self.generation, model_id=self.model_id)
         _RETRAIN_IN_PROGRESS.set(0)
         global _ACTIVE_REF
         _ACTIVE_REF = weakref.ref(self)
@@ -271,6 +290,7 @@ class ModelManager:
             generation=generation,
             path=path,
             swapped_unix_s=self.last_swap_unix_s,
+            **self._tenant_fields(),
         )
         logger.info(
             "lifecycle: resumed generation %d from %s (CURRENT.json)",
@@ -278,6 +298,12 @@ class ModelManager:
             path,
         )
         return True
+
+    def _tenant_fields(self) -> Dict[str, str]:
+        """``model_id=`` event field for fleet tenants; empty for the
+        single-model deployments every prior PR built (their event schema
+        is unchanged)."""
+        return {} if self.model_id is None else {"model_id": self.model_id}
 
     # ------------------------------------------------------------------ #
     # serving path
@@ -294,6 +320,14 @@ class ModelManager:
     @property
     def monitor(self):
         return self._monitor
+
+    @property
+    def retrain_in_progress(self) -> bool:
+        """True while a refit is in flight — the fleet registry refuses to
+        evict a tenant in this window (pinned until the swap or rollback
+        completes, docs/fleet.md)."""
+        with self._lock:
+            return self._retraining
 
     def score(
         self,
@@ -414,6 +448,7 @@ class ModelManager:
             mode=self.mode,
             rows=int(window_X.shape[0]),
             seed=seed,
+            **self._tenant_fields(),
         )
         if self.background:
             thread = threading.Thread(
@@ -466,6 +501,7 @@ class ModelManager:
                     generation=target,
                     reason="retrain_error",
                     error=repr(exc),
+                    **self._tenant_fields(),
                 )
                 logger.error("lifecycle refit r%d failed every attempt: %s", seq, exc)
                 self._finish(OUTCOME_ERROR)
@@ -482,6 +518,7 @@ class ModelManager:
                 passed=result.passed,
                 reference_rows=result.reference_rows,
                 gates=json.dumps(result.as_dict()["gates"]),
+                **self._tenant_fields(),
             )
             if not result.passed:
                 record_event(
@@ -490,6 +527,7 @@ class ModelManager:
                     generation=target,
                     reason="validation_failed",
                     failed_gates=",".join(result.failed_gates()),
+                    **self._tenant_fields(),
                 )
                 logger.warning(
                     "lifecycle: candidate gen %d failed validation (%s); the "
@@ -509,6 +547,7 @@ class ModelManager:
                     generation=target,
                     reason="swap_failed",
                     error=repr(exc),
+                    **self._tenant_fields(),
                 )
                 logger.error(
                     "lifecycle: swap to gen %d failed mid-flight (%s); the "
@@ -741,6 +780,8 @@ class ModelManager:
             self.last_swap_unix_s = float(self._clock())
             self._consecutive = 0
         _GENERATION.set(target)
+        if self.model_id is not None:
+            _FLEET_GENERATION.set(target, model_id=self.model_id)
         self._write_current(target, gen_dir)
         record_event(
             "retrain.swap",
@@ -748,6 +789,7 @@ class ModelManager:
             generation=target,
             path=gen_dir,
             trees=candidate.forest.num_trees,
+            **self._tenant_fields(),
         )
         logger.info(
             "lifecycle: generation %d swapped in from %s (monitor rebound, "
@@ -785,6 +827,7 @@ class ModelManager:
             uid = self._model.uid
         last = self.last_retrain
         return {
+            "model_id": self.model_id,
             "generation": self.generation,
             "mode": self.mode,
             "model_uid": uid,

@@ -106,6 +106,23 @@ LADDER: Dict[str, str] = {
         "fallback, so strict scoring is deliberately unaffected "
         "(docs/observability.md §8)"
     ),
+    # fleet-registry rungs (fleet/registry.py, docs/fleet.md)
+    "fleet_load_failed": (
+        "a tenant's lazy (re)load from its sealed model dir failed -> that "
+        "tenant's request is refused with a typed 503 (ModelLoadError) and "
+        "the registry retries the load on its next request; every OTHER "
+        "tenant's scoring path is untouched (per-tenant isolation), so no "
+        "score is ever computed from a partially loaded model"
+    ),
+    "fleet_evict_under_load": (
+        "residency-budget pressure (or an injected fault) evicted a tenant "
+        "that still had in-flight requests -> the eviction drains the "
+        "tenant's coalescer first, so every in-flight flush completes on "
+        "its point-in-time model reference with BITWISE-exact scores; only "
+        "subsequent requests pay the re-load from the sealed gen dir — "
+        "like drift_alert, this rung flags an operational event, not a "
+        "compute fallback, so it is deliberately strict-exempt"
+    ),
     # load-time rung (io/persistence.py, on_corrupt='drop')
     "dropped_trees": (
         "corrupt trees dropped at load -> valid smaller forest: path-length "

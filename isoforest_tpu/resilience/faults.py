@@ -32,6 +32,13 @@ single dict lookup when no fault is armed):
   :func:`check_swap` — ``fail_swap`` raises mid-swap, after the candidate
   is durably saved but before it reaches the scoring path (the
   crash-between-save-and-flip case rollback must survive);
+* the model fleet registry (``fleet/registry.py``) -> two seams:
+  :func:`check_fleet_load` — ``fail_fleet_load[=<model_id>]`` makes the
+  named tenant's (or any) lazy load raise, proving one tenant's broken
+  artifacts refuse with a typed 503 while the rest of the fleet serves;
+  :func:`evict_during_score` — ``evict_during_score`` forces an eviction
+  immediately after a request enqueues, proving in-flight flushes finish
+  on their point-in-time service reference (docs/fleet.md);
 * scoring execution (``ops.traversal.score_matrix``) and the multihost
   worker body -> :func:`maybe_slow_collective` — ``slow_collective`` (all
   strategies), ``slow_collective=<seconds>`` (stall cap) or
@@ -78,6 +85,8 @@ KNOWN_FAULTS = frozenset(
         "fail_distributed_init",
         "slow_collective",
         "break_pipeline_stage",
+        "fail_fleet_load",
+        "evict_during_score",
     }
 )
 
@@ -266,6 +275,31 @@ def check_swap() -> None:
             "injected fault: model hot-swap forced to fail mid-swap "
             "(fail_swap) — rolling back to the incumbent"
         )
+
+
+def check_fleet_load(model_id: str) -> None:
+    """Raise :class:`FaultInjectedError` while ``fail_fleet_load`` is armed
+    (optionally ``fail_fleet_load=<model_id>`` to fail only that tenant's
+    lazy load) — the fleet registry must refuse that tenant's request with
+    a typed 503 (``fleet_load_failed`` rung) while every other tenant keeps
+    serving, and retry the load on the tenant's next request."""
+    value = get("fail_fleet_load")
+    if value is None or value is False:
+        return
+    if value is True or str(value) == str(model_id):
+        raise FaultInjectedError(
+            f"injected fault: fleet lazy load of model {model_id!r} forced "
+            f"to fail (fail_fleet_load={value!r})"
+        )
+
+
+def evict_during_score() -> bool:
+    """True while ``evict_during_score`` is armed — the fleet registry then
+    evicts the tenant right after a request enqueues, proving the waiter's
+    in-flight flush finishes on its point-in-time service reference
+    (drained, bitwise-exact scores) and only subsequent requests pay the
+    re-load (``fleet_evict_under_load`` rung)."""
+    return active("evict_during_score")
 
 
 # env-armed fail_distributed_init consumes across calls within the process

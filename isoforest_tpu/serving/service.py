@@ -65,11 +65,16 @@ class ScoringService:
         config: Optional[ServingConfig] = None,
         clock: Callable[[], float] = time.monotonic,
         start: bool = True,
+        model_id: Optional[str] = None,
     ) -> None:
         if (model is None) == (manager is None):
             raise ValueError("pass exactly one of model= or manager=")
         self._bare_model = model
         self.manager = manager
+        # fleet tenant identity (docs/fleet.md): the registry constructs
+        # one service per tenant; None keeps the single-model deployments
+        # every prior PR built byte-identical
+        self.model_id = None if model_id is None else str(model_id)
         self.config = config or ServingConfig()
         from ..ops.traversal import batch_bucket
 
@@ -181,6 +186,7 @@ class ScoringService:
         """Operator-facing service state (plain JSON types), merged into
         ``/healthz`` alongside the lifecycle section."""
         doc = {
+            "model_id": self.model_id,
             "batch_rows": self.config.batch_rows,
             "linger_ms": self.config.linger_ms,
             "max_queue_rows": self.config.max_queue_rows,

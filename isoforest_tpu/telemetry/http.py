@@ -78,7 +78,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler contract
         owner: "MetricsServer" = self.server.owner  # type: ignore[attr-defined]
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/metrics":
             self._reply(
                 200,
@@ -104,6 +104,16 @@ class _Handler(BaseHTTPRequestHandler):
             )
         elif path == "/":
             self._reply(200, "text/plain; charset=utf-8", _INDEX)
+        elif path in owner.get_routes:
+            try:
+                status, content_type, payload = owner.get_routes[path](query)
+            except Exception as exc:
+                status, content_type, payload = (
+                    500,
+                    "application/json",
+                    json.dumps({"error": repr(exc), "status": 500}) + "\n",
+                )
+            self._reply(status, content_type, payload)
         else:
             self._reply(
                 404, "text/plain; charset=utf-8", f"unknown path {path}\n{_INDEX}"
@@ -118,10 +128,33 @@ class _Handler(BaseHTTPRequestHandler):
         path, _, query = self.path.partition("?")
         handler = owner.post_routes.get(path)
         if handler is None:
+            # parameterised routes: longest registered prefix wins, the
+            # remainder of the path is passed to the handler (the fleet
+            # mounts /score/ here and reads the model id off the suffix)
+            for prefix in sorted(owner.post_prefix_routes, key=len, reverse=True):
+                if path.startswith(prefix) and len(path) > len(prefix):
+                    suffix = path[len(prefix):]
+                    prefix_handler = owner.post_prefix_routes[prefix]
+                    handler = (
+                        lambda body, headers, query="", _h=prefix_handler,
+                        _s=suffix: _h(_s, body, headers, query)
+                    )
+                    break
+        if handler is None:
+            # a JSON body, not a bare text error: clients of the scoring
+            # wire speak JSON and should not need a second parser for 404s
             self._reply(
                 404,
-                "text/plain; charset=utf-8",
-                f"no POST route at {path}\n{_INDEX}",
+                "application/json",
+                json.dumps(
+                    {
+                        "error": f"no POST route at {path}",
+                        "status": 404,
+                        "routes": sorted(owner.post_routes)
+                        + sorted(p + "<suffix>" for p in owner.post_prefix_routes),
+                    }
+                )
+                + "\n",
             )
             return
         try:
@@ -181,9 +214,15 @@ class MetricsServer:
         self.heartbeat_dir = heartbeat_dir
         self.stale_after_s = float(stale_after_s)
         # POST routes (path -> (body, headers, query) -> (status, ctype,
-        # body)): the serving layer mounts /score here. serving_state is an
-        # optional zero-arg callable merged into /healthz.
+        # body)): the serving layer mounts /score here. post_prefix_routes
+        # are parameterised (prefix -> (suffix, body, headers, query) ->
+        # same triple): the fleet mounts /score/ and reads the model id off
+        # the suffix. get_routes (path -> (query) -> triple) host listing
+        # endpoints like the fleet's /models. serving_state is an optional
+        # zero-arg callable merged into /healthz.
         self.post_routes: dict = {}
+        self.post_prefix_routes: dict = {}
+        self.get_routes: dict = {}
         self.serving_state = None
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
         self._httpd.daemon_threads = True
@@ -219,6 +258,25 @@ class MetricsServer:
 
     def unregister_post(self, path: str) -> None:
         self.post_routes.pop(str(path), None)
+
+    def register_post_prefix(self, prefix: str, handler) -> None:
+        """Mount a parameterised POST route: every ``POST <prefix><suffix>``
+        (non-empty suffix; longest prefix wins over other prefixes, exact
+        routes always win) dispatches ``handler(suffix, body, headers,
+        query)``. The fleet mounts ``/score/`` here (docs/fleet.md)."""
+        self.post_prefix_routes[str(prefix)] = handler
+
+    def unregister_post_prefix(self, prefix: str) -> None:
+        self.post_prefix_routes.pop(str(prefix), None)
+
+    def register_get(self, path: str, handler) -> None:
+        """Mount a GET route (``handler(query) -> (status, content_type,
+        body_str)``) consulted before the built-in paths' 404 (built-ins
+        themselves are not overridable)."""
+        self.get_routes[str(path)] = handler
+
+    def unregister_get(self, path: str) -> None:
+        self.get_routes.pop(str(path), None)
 
     def health(self) -> Tuple[dict, bool]:
         """``(payload, healthy)`` for ``/healthz``: heartbeat ages from the

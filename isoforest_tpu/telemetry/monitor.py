@@ -80,6 +80,16 @@ _MONITORED_ROWS_TOTAL = _counter(
     "isoforest_monitored_rows_total",
     "Rows folded into the serving drift monitor",
 )
+# per-tenant twin of the score-drift gauge for fleet deployments
+# (docs/fleet.md): the unlabelled gauges above stay the single-model
+# schema; a monitor constructed with model_id= additionally exports its
+# score PSI under that label so one scrape separates the tenants
+_FLEET_DRIFT_PSI = _gauge(
+    "isoforest_fleet_drift_psi",
+    "Per-tenant PSI of the serving score distribution vs the tenant "
+    "model's training baseline (fleet deployments, docs/fleet.md)",
+    labelnames=("model_id",),
+)
 
 
 def _fold(values: np.ndarray, lo: float, hi: float, bins: int) -> np.ndarray:
@@ -318,9 +328,14 @@ class ScoreMonitor:
         min_rows: int = 512,
         max_score_rows_per_batch: int = 32768,
         max_feature_rows_per_batch: int = 2048,
+        model_id: Optional[str] = None,
     ) -> None:
         if threshold <= 0:
             raise ValueError(f"threshold must be positive, got {threshold}")
+        # fleet tenant identity: when set, score PSI is additionally
+        # exported as isoforest_fleet_drift_psi{model_id=...} and drift
+        # alerts carry the tenant (docs/fleet.md)
+        self.model_id = None if model_id is None else str(model_id)
         self.threshold = float(threshold)
         self.feature_threshold = float(
             feature_threshold if feature_threshold is not None else threshold
@@ -562,6 +577,8 @@ class ScoreMonitor:
         if "score" in d:
             _SCORE_DRIFT_PSI.set(d["score"]["psi"])
             _SCORE_DRIFT_KS.set(d["score"]["ks"])
+            if self.model_id is not None:
+                _FLEET_DRIFT_PSI.set(d["score"]["psi"], model_id=self.model_id)
             self._check("score", d["score"]["psi"], self.threshold, d["rows"])
         if "features" in d:
             for i, value in d["features"].items():
@@ -586,6 +603,8 @@ class ScoreMonitor:
                 "threshold": threshold,
                 "rows": rows,
             }
+            if self.model_id is not None:
+                alert["model_id"] = self.model_id
             self._alerts.append(alert)
         record_event("drift.alert", **alert)
         if self.ladder:

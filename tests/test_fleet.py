@@ -1,0 +1,726 @@
+"""Multi-tenant model fleet (ISSUE 11, docs/fleet.md).
+
+Acceptance matrix:
+  * the registry is lazy (nothing loads at register), loads resume from the
+    sealed dirs, and per-tenant scores are BITWISE ``model.score``;
+  * the byte-budgeted LRU strictly respects the budget, the resident-bytes
+    gauge matches the packed-layout accounting, a re-load after eviction is
+    bitwise-identical to the pre-eviction model, and a tenant mid-retrain
+    is pinned (eviction refused until the swap completes);
+  * the ``fail_fleet_load`` / ``evict_during_score`` fault seams land on
+    the ``fleet_load_failed`` / ``fleet_evict_under_load`` rungs with the
+    documented typed-503 / drained-bitwise semantics;
+  * ``POST /score/<model_id>`` + ``GET /models`` over real HTTP:
+    per-tenant bitwise parity, 404 JSON for unknown ids, per-tenant
+    ``{model_id=}`` serving series, per-tenant ``/healthz`` sections;
+  * cross-tenant isolation chaos: a hook-stalled hot-swap plus a saturated
+    admission queue (429) on tenant A leaves tenant B's concurrent HTTP
+    scores all-200 and bitwise-identical to direct ``model.score``.
+
+Zero real sleeps: swaps are event-gated, HTTP requests block on their own
+response, the eviction-under-load drill drains synchronously.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from isoforest_tpu import IsolationForest, telemetry
+from isoforest_tpu.fleet import (
+    FleetService,
+    ModelLoadError,
+    ModelRegistry,
+    UnknownModelError,
+    discover_models,
+    layout_nbytes,
+    mount_fleet,
+    serve_fleet,
+)
+from isoforest_tpu.resilience import faults
+from isoforest_tpu.resilience.degradation import (
+    degradation_report,
+    reset_degradations,
+)
+from isoforest_tpu.serving import ServingConfig
+from isoforest_tpu.telemetry.http import MetricsServer
+
+N_TREES = 10
+TENANTS = ("tenant-a", "tenant-b", "tenant-c")
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    reset_degradations()
+    yield
+    telemetry.reset()
+    reset_degradations()
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(4096, 5)).astype(np.float32)
+    X[:80] += 4.0
+    return X
+
+
+@pytest.fixture(scope="module")
+def fleet_dirs(data, tmp_path_factory):
+    """Three sealed tenant model dirs (distinct seeds -> distinct scores)
+    plus the in-memory models for bitwise cross-checks."""
+    root = tmp_path_factory.mktemp("fleet-models")
+    out = {}
+    for i, model_id in enumerate(TENANTS):
+        model = IsolationForest(
+            num_estimators=N_TREES, max_samples=64.0, random_seed=i + 1
+        ).fit(data)
+        path = str(root / model_id)
+        model.save(path)
+        out[model_id] = (path, model)
+    return out
+
+
+def _fast_config(**kw):
+    kw.setdefault("linger_ms", 0.0)
+    kw.setdefault("request_timeout_s", 120.0)
+    return ServingConfig(**kw)
+
+
+def _registry(fleet_dirs, tmp_path, ids=TENANTS[:2], **kw):
+    kw.setdefault("config", _fast_config())
+    registry = ModelRegistry(**kw)
+    for model_id in ids:
+        registry.register(
+            model_id,
+            fleet_dirs[model_id][0],
+            work_dir=str(tmp_path / f"wd-{model_id}"),
+        )
+    return registry
+
+
+def _gauge_value(name):
+    metric = telemetry.snapshot()["metrics"].get(name)
+    assert metric and metric["series"], f"gauge {name} has no series"
+    return metric["series"][0]["value"]
+
+
+# --------------------------------------------------------------------------- #
+# registry basics
+# --------------------------------------------------------------------------- #
+
+
+class TestRegistryBasics:
+    def test_register_is_lazy_and_first_score_loads(self, fleet_dirs, tmp_path, data):
+        registry = _registry(fleet_dirs, tmp_path)
+        try:
+            assert all(not e["resident"] for e in registry.models_state())
+            assert not telemetry.get_events(kind="fleet.load")
+            scores = registry.score("tenant-a", data[:32])
+            np.testing.assert_array_equal(
+                scores, fleet_dirs["tenant-a"][1].score(data[:32])
+            )
+            entry = registry.entry("tenant-a")
+            assert entry.resident and entry.loads == 1
+            assert entry.resident_bytes == layout_nbytes(entry.model)
+            loads = telemetry.get_events(kind="fleet.load")
+            assert len(loads) == 1
+            assert loads[0].fields["model_id"] == "tenant-a"
+            assert loads[0].fields["bytes"] == entry.resident_bytes
+            # tenant-b still cold: one tenant's traffic loads one tenant
+            assert not registry.entry("tenant-b").resident
+        finally:
+            registry.close()
+
+    def test_tenants_score_their_own_model(self, fleet_dirs, tmp_path, data):
+        registry = _registry(fleet_dirs, tmp_path)
+        try:
+            sa = registry.score("tenant-a", data[:64])
+            sb = registry.score("tenant-b", data[:64])
+            np.testing.assert_array_equal(
+                sa, fleet_dirs["tenant-a"][1].score(data[:64])
+            )
+            np.testing.assert_array_equal(
+                sb, fleet_dirs["tenant-b"][1].score(data[:64])
+            )
+            assert not np.array_equal(sa, sb)
+        finally:
+            registry.close()
+
+    def test_unknown_id_and_bad_registrations(self, fleet_dirs, tmp_path):
+        registry = _registry(fleet_dirs, tmp_path)
+        try:
+            with pytest.raises(UnknownModelError) as exc:
+                registry.score("nope", np.zeros((1, 5), np.float32))
+            assert exc.value.status == 404
+            with pytest.raises(ValueError, match="already registered"):
+                registry.register("tenant-a", fleet_dirs["tenant-a"][0])
+            with pytest.raises(ValueError, match="model_id"):
+                registry.register("bad/id", fleet_dirs["tenant-a"][0])
+            with pytest.raises(FileNotFoundError):
+                registry.register("ghost", str(tmp_path / "missing"))
+        finally:
+            registry.close()
+
+    def test_close_evicts_everything(self, fleet_dirs, tmp_path, data):
+        registry = _registry(fleet_dirs, tmp_path)
+        registry.score("tenant-a", data[:16])
+        registry.score("tenant-b", data[:16])
+        registry.close()
+        assert all(not e["resident"] for e in registry.models_state())
+        evicts = telemetry.get_events(kind="fleet.evict")
+        assert sorted(e.fields["model_id"] for e in evicts) == [
+            "tenant-a",
+            "tenant-b",
+        ]
+        assert all(e.fields["cause"] == "close" for e in evicts)
+
+
+# --------------------------------------------------------------------------- #
+# residency LRU edges (the ISSUE 11 satellite checklist)
+# --------------------------------------------------------------------------- #
+
+
+class TestResidencyLRU:
+    def _one_model_bytes(self, fleet_dirs):
+        return layout_nbytes(fleet_dirs["tenant-a"][1])
+
+    def test_eviction_strictly_respects_byte_budget(
+        self, fleet_dirs, tmp_path, data
+    ):
+        one = self._one_model_bytes(fleet_dirs)
+        budget = int(one * 1.5)  # fits exactly one resident model
+        registry = _registry(fleet_dirs, tmp_path, budget_bytes=budget)
+        try:
+            registry.score("tenant-a", data[:16])
+            registry.score("tenant-b", data[:16])  # pushes past the budget
+            state = registry.state()
+            assert state["resident_bytes"] <= budget
+            assert state["resident_models"] == 1
+            assert not registry.entry("tenant-a").resident  # LRU victim
+            assert registry.entry("tenant-b").resident  # the active tenant
+            evicts = telemetry.get_events(kind="fleet.evict")
+            assert len(evicts) == 1
+            assert evicts[0].fields["model_id"] == "tenant-a"
+            assert evicts[0].fields["cause"] == "budget"
+        finally:
+            registry.close()
+
+    def test_lru_order_respects_recency(self, fleet_dirs, tmp_path, data):
+        one = self._one_model_bytes(fleet_dirs)
+        registry = _registry(
+            fleet_dirs, tmp_path, ids=TENANTS, budget_bytes=int(one * 2.2)
+        )
+        try:
+            registry.score("tenant-a", data[:16])
+            registry.score("tenant-b", data[:16])
+            registry.score("tenant-a", data[:16])  # touch: a newer than b
+            registry.score("tenant-c", data[:16])  # over budget -> evict LRU
+            assert registry.entry("tenant-a").resident
+            assert not registry.entry("tenant-b").resident
+            assert registry.entry("tenant-c").resident
+        finally:
+            registry.close()
+
+    def test_resident_bytes_gauge_matches_packed_accounting(
+        self, fleet_dirs, tmp_path, data
+    ):
+        registry = _registry(fleet_dirs, tmp_path)
+        try:
+            registry.score("tenant-a", data[:16])
+            registry.score("tenant-b", data[:16])
+            expected = sum(
+                layout_nbytes(registry.entry(t).model) for t in TENANTS[:2]
+            )
+            assert registry.state()["resident_bytes"] == expected
+            assert _gauge_value("isoforest_fleet_resident_bytes") == expected
+            assert _gauge_value("isoforest_fleet_resident_models") == 2
+            registry.evict("tenant-a")
+            assert (
+                _gauge_value("isoforest_fleet_resident_bytes")
+                == layout_nbytes(registry.entry("tenant-b").model)
+            )
+            assert _gauge_value("isoforest_fleet_resident_models") == 1
+        finally:
+            registry.close()
+
+    def test_reload_after_eviction_is_bitwise_identical(
+        self, fleet_dirs, tmp_path, data
+    ):
+        registry = _registry(fleet_dirs, tmp_path)
+        try:
+            before = registry.score("tenant-a", data[:256])
+            assert registry.evict("tenant-a")
+            assert not registry.entry("tenant-a").resident
+            after = registry.score("tenant-a", data[:256])
+            np.testing.assert_array_equal(before, after)
+            assert registry.entry("tenant-a").loads == 2
+        finally:
+            registry.close()
+
+    def test_evict_mid_retrain_refused_until_swap_completes(
+        self, fleet_dirs, tmp_path, data
+    ):
+        """The pin: a tenant whose manager is mid-retrain cannot be evicted
+        (a budget race must never tear down a background refit); once the
+        stalled swap completes the same eviction succeeds. Event-gated."""
+        swap_entered, swap_release = threading.Event(), threading.Event()
+
+        def slow_swap():
+            swap_entered.set()
+            assert swap_release.wait(timeout=300)
+
+        fc = faults.FakeClock()
+        registry = ModelRegistry(config=_fast_config())
+        registry.register(
+            "tenant-a",
+            fleet_dirs["tenant-a"][0],
+            work_dir=str(tmp_path / "wd-a"),
+            manager_kwargs={
+                "auto_retrain": False,
+                "background": True,
+                "checkpoint_every": 4,
+                "clock": fc.now,
+                "sleep": fc.sleep,
+                "hooks": {"mid_swap": slow_swap},
+            },
+        )
+        try:
+            for i in range(4):  # fill the retrain reservoir past min rows
+                registry.score("tenant-a", data[i * 512 : (i + 1) * 512])
+            entry = registry.entry("tenant-a")
+            assert entry.manager is not None
+            assert entry.manager.retrain(reason="pin-test", wait=False)
+            assert swap_entered.wait(timeout=300)
+            assert entry.pinned
+            assert registry.evict("tenant-a") is False  # pinned: refused
+            refused = telemetry.get_events(kind="fleet.evict_refused")
+            assert len(refused) == 1
+            assert refused[0].fields["reason"] == "retrain_in_progress"
+            assert entry.resident
+            swap_release.set()
+            assert entry.manager.wait_retrain(timeout_s=300)
+            assert entry.manager.generation == 2
+            assert registry.evict("tenant-a") is True  # un-pinned: evicts
+            # the re-load resumes the SWAPPED generation from CURRENT.json,
+            # bitwise — the sealed gen dirs stay authoritative
+            reloaded = registry.score("tenant-a", data[:128])
+            fresh = registry.entry("tenant-a")
+            assert fresh.generation == 2
+            np.testing.assert_array_equal(
+                reloaded, fresh.manager.model.score(data[:128])
+            )
+        finally:
+            swap_release.set()
+            registry.close()
+
+
+# --------------------------------------------------------------------------- #
+# fault seams -> rungs
+# --------------------------------------------------------------------------- #
+
+
+class TestFaultSeams:
+    def test_fail_fleet_load_refuses_503_others_serve(
+        self, fleet_dirs, tmp_path, data
+    ):
+        """One tenant's broken load answers a typed 503 on the
+        ``fleet_load_failed`` rung; the OTHER tenant keeps serving through
+        the same registry, and the broken tenant recovers on its next
+        request once the fault clears."""
+        registry = _registry(fleet_dirs, tmp_path)
+        try:
+            with faults.inject(fail_fleet_load="tenant-a"):
+                with pytest.raises(ModelLoadError) as exc:
+                    registry.score("tenant-a", data[:8])
+                assert exc.value.status == 503
+                assert degradation_report().count("fleet_load_failed") == 1
+                # isolation: tenant-b loads and scores while a is broken
+                np.testing.assert_array_equal(
+                    registry.score("tenant-b", data[:8]),
+                    fleet_dirs["tenant-b"][1].score(data[:8]),
+                )
+            # fault cleared: the registry retries the load on next request
+            np.testing.assert_array_equal(
+                registry.score("tenant-a", data[:8]),
+                fleet_dirs["tenant-a"][1].score(data[:8]),
+            )
+            assert registry.entry("tenant-a").last_load_error is None
+        finally:
+            registry.close()
+
+    def test_evict_during_score_drains_bitwise(self, fleet_dirs, tmp_path, data):
+        """The eviction-under-load drill: the tenant is evicted while a
+        request is in flight; the waiter's scores still arrive from the
+        drained flush, bitwise-exact, on the ``fleet_evict_under_load``
+        rung; the next request pays the re-load."""
+        # a huge linger + bucket keeps the submitted request queued until
+        # the eviction's close(drain=True) flushes it — deterministic,
+        # no real sleeps
+        registry = _registry(
+            fleet_dirs,
+            tmp_path,
+            config=_fast_config(
+                batch_rows=4096, linger_ms=60_000.0, max_queue_rows=8192
+            ),
+        )
+        try:
+            with faults.inject(evict_during_score=True):
+                scores = registry.score("tenant-a", data[:64])
+            np.testing.assert_array_equal(
+                scores, fleet_dirs["tenant-a"][1].score(data[:64])
+            )
+            assert degradation_report().count("fleet_evict_under_load") == 1
+            assert not registry.entry("tenant-a").resident
+            evicts = telemetry.get_events(kind="fleet.evict")
+            assert evicts and evicts[-1].fields["cause"] == "fault_injected"
+            # next request re-loads and serves normally
+            np.testing.assert_array_equal(
+                registry.score("tenant-a", data[:64]),
+                fleet_dirs["tenant-a"][1].score(data[:64]),
+            )
+            assert registry.entry("tenant-a").loads == 2
+        finally:
+            registry.close()
+
+
+# --------------------------------------------------------------------------- #
+# HTTP: /score/<model_id>, /models, routing
+# --------------------------------------------------------------------------- #
+
+
+def _post(url, path, payload, content_type="application/json", timeout=60):
+    body = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url + path, data=body, headers={"Content-Type": content_type}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode()
+
+
+@pytest.fixture()
+def served_fleet(fleet_dirs, tmp_path):
+    handle = serve_fleet(
+        models={t: fleet_dirs[t][0] for t in TENANTS[:2]},
+        port=0,
+        config=_fast_config(),
+        work_root=str(tmp_path / "work"),
+    )
+    yield handle
+    handle.close()
+
+
+class TestHTTPFleet:
+    def test_each_tenant_route_scores_its_own_model(
+        self, served_fleet, fleet_dirs, data
+    ):
+        for model_id in TENANTS[:2]:
+            status, body = _post(
+                served_fleet.url,
+                f"/score/{model_id}",
+                {"rows": [[float(v) for v in r] for r in data[:5]]},
+            )
+            assert status == 200, body
+            doc = json.loads(body)
+            assert doc["model_id"] == model_id
+            assert doc["scores"] == [
+                float(s) for s in fleet_dirs[model_id][1].score(data[:5])
+            ]
+            assert doc["flush_rows"] >= 5
+
+    def test_unknown_model_id_is_json_404_naming_models(self, served_fleet):
+        status, body = _post(
+            served_fleet.url, "/score/ghost", {"row": [1.0, 2.0, 3.0, 4.0, 5.0]}
+        )
+        assert status == 404
+        doc = json.loads(body)  # a JSON body, not a bare text error
+        assert doc["status"] == 404
+        assert doc["model_id"] == "ghost"
+        assert doc["models"] == ["tenant-a", "tenant-b"]
+
+    def test_csv_per_tenant(self, served_fleet, fleet_dirs, data):
+        body = "\n".join(
+            ",".join(repr(float(v)) for v in r) for r in data[:3]
+        ).encode()
+        status, out = _post(
+            served_fleet.url, "/score/tenant-b", body, content_type="text/csv"
+        )
+        assert status == 200
+        got = [float(s) for s in out.strip().splitlines()[1:]]
+        assert got == [float(s) for s in fleet_dirs["tenant-b"][1].score(data[:3])]
+
+    def test_models_listing_and_healthz_sections(self, served_fleet, data):
+        _post(
+            served_fleet.url,
+            "/score/tenant-a",
+            {"row": [float(v) for v in data[0]]},
+        )
+        with urllib.request.urlopen(
+            served_fleet.url + "/models", timeout=30
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert doc["resident_models"] == 1
+        rows = {r["model_id"]: r for r in doc["models"]}
+        assert rows["tenant-a"]["resident"] is True
+        assert rows["tenant-a"]["generation"] == 1
+        assert rows["tenant-b"]["resident"] is False
+        with urllib.request.urlopen(
+            served_fleet.url + "/healthz", timeout=30
+        ) as resp:
+            hz = json.loads(resp.read())
+        assert hz["serving"]["fleet"] is True
+        tenants = hz["serving"]["tenants"]
+        assert tenants["tenant-a"]["resident"] is True
+        assert tenants["tenant-a"]["retrain_in_progress"] is False
+        assert tenants["tenant-b"]["resident"] is False
+
+    def test_per_tenant_series_labelled_in_snapshot(self, served_fleet, data):
+        _post(
+            served_fleet.url,
+            "/score/tenant-a",
+            {"row": [float(v) for v in data[0]]},
+        )
+        with urllib.request.urlopen(
+            served_fleet.url + "/snapshot", timeout=30
+        ) as resp:
+            doc = json.loads(resp.read())
+        for name in (
+            "isoforest_fleet_request_seconds",
+            "isoforest_fleet_responses_total",
+        ):
+            series = doc["metrics"][name]["series"]
+            assert any(
+                s["labels"].get("model_id") == "tenant-a" for s in series
+            ), name
+        gen = doc["metrics"]["isoforest_fleet_generation"]["series"]
+        assert any(s["labels"].get("model_id") == "tenant-a" for s in gen)
+
+    def test_prefix_routing_and_json_404(self):
+        """The telemetry HTTP satellite: parameterised POST routes (the
+        suffix reaches the handler) and a JSON body for unknown POST
+        paths."""
+        server = MetricsServer(port=0).start()
+        try:
+            server.register_post_prefix(
+                "/echo/",
+                lambda suffix, body, headers, query="": (
+                    200,
+                    "application/json",
+                    json.dumps({"suffix": suffix, "bytes": len(body)}) + "\n",
+                ),
+            )
+            status, body = _post(server.url, "/echo/some-id", {"x": 1})
+            assert status == 200
+            assert json.loads(body)["suffix"] == "some-id"
+            # bare prefix (empty suffix) is NOT a match -> JSON 404
+            status, body = _post(server.url, "/echo/", {"x": 1})
+            assert status == 404
+            assert json.loads(body)["status"] == 404
+            # unknown POST path -> JSON 404 naming the routes
+            status, body = _post(server.url, "/nope", {"x": 1})
+            assert status == 404
+            doc = json.loads(body)
+            assert doc["status"] == 404 and "/echo/<suffix>" in doc["routes"]
+            # exact routes win over a matching prefix
+            server.register_post(
+                "/echo/exact",
+                lambda body, headers, query="": (200, "text/plain", "exact"),
+            )
+            status, body = _post(server.url, "/echo/exact", {"x": 1})
+            assert (status, body) == (200, "exact")
+            server.unregister_post_prefix("/echo/")
+            status, _ = _post(server.url, "/echo/some-id", {"x": 1})
+            assert status == 404
+        finally:
+            server.stop()
+
+
+# --------------------------------------------------------------------------- #
+# cross-tenant isolation chaos (the ISSUE 11 acceptance proof)
+# --------------------------------------------------------------------------- #
+
+
+class TestCrossTenantIsolation:
+    def test_stalled_swap_and_saturated_queue_on_a_leave_b_exact(
+        self, fleet_dirs, tmp_path, data
+    ):
+        """Tenant A: hot-swap stalled mid-flight by the ``mid_swap`` hook
+        AND admission saturated (an over-quota batch answers 429). Tenant
+        B, concurrently over real HTTP: every response 200 and BITWISE
+        equal to direct ``model.score`` — one tenant's lifecycle churn and
+        backpressure never perturb another's scores. Event-gated, zero
+        real sleeps."""
+        swap_entered, swap_release = threading.Event(), threading.Event()
+
+        def slow_swap():
+            swap_entered.set()
+            assert swap_release.wait(timeout=300)
+
+        fc = faults.FakeClock()
+        registry = ModelRegistry(config=_fast_config())
+        registry.register(
+            "tenant-a",
+            fleet_dirs["tenant-a"][0],
+            work_dir=str(tmp_path / "wd-a"),
+            config=_fast_config(batch_rows=64, max_queue_rows=64),
+            manager_kwargs={
+                "auto_retrain": False,
+                "background": True,
+                "checkpoint_every": 4,
+                "clock": fc.now,
+                "sleep": fc.sleep,
+                "hooks": {"mid_swap": slow_swap},
+            },
+        )
+        registry.register(
+            "tenant-b",
+            fleet_dirs["tenant-b"][0],
+            work_dir=str(tmp_path / "wd-b"),
+        )
+        server = MetricsServer(port=0).start()
+        fleet = FleetService(registry)
+        mount_fleet(server, fleet)
+        model_b = fleet_dirs["tenant-b"][1]
+        direct_b = model_b.score(data[:8])
+        try:
+            registry.score("tenant-a", data[:16])  # lazy-load tenant A
+            entry_a = registry.entry("tenant-a")
+            for i in range(4):  # reservoir past min_window_rows (the
+                # manager path: A's tiny admission quota is for the HTTP
+                # saturation proof, not the fixture fill)
+                entry_a.manager.score(data[i * 512 : (i + 1) * 512])
+            assert entry_a.manager.retrain(reason="chaos", wait=False)
+            assert swap_entered.wait(timeout=300)
+
+            # A saturated: one batch over its admission quota answers 429
+            too_many = 65
+            rows = np.resize(data, (too_many, data.shape[1]))
+            status, body = _post(
+                server.url,
+                "/score/tenant-a",
+                {"rows": [[float(v) for v in r] for r in rows]},
+            )
+            assert status == 429, body
+            assert json.loads(body)["status"] == 429
+
+            # B concurrently: all 200, all bitwise, while A is stalled+full
+            results, errors = [None] * 8, []
+            go = threading.Barrier(8)
+
+            def worker(i):
+                try:
+                    go.wait(timeout=120)
+                    status, body = _post(
+                        server.url,
+                        "/score/tenant-b",
+                        {"row": [float(v) for v in data[i]]},
+                    )
+                    assert status == 200, body
+                    results[i] = json.loads(body)["scores"][0]
+                except Exception as exc:  # surfaced below
+                    errors.append(exc)
+
+            threads = [
+                threading.Thread(target=worker, args=(i,)) for i in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert not errors, errors
+            assert results == [float(s) for s in direct_b]
+
+            swap_release.set()
+            assert entry_a.manager.wait_retrain(timeout_s=300)
+            assert entry_a.manager.generation == 2
+            # B is still generation 1 and still bitwise after A's swap
+            assert registry.entry("tenant-b").generation == 1
+            status, body = _post(
+                server.url,
+                "/score/tenant-b",
+                {"rows": [[float(v) for v in r] for r in data[:8]]},
+            )
+            assert status == 200
+            assert json.loads(body)["scores"] == [float(s) for s in direct_b]
+        finally:
+            swap_release.set()
+            server.stop()
+            registry.close()
+
+
+# --------------------------------------------------------------------------- #
+# assembly: serve_fleet discovery + CLI
+# --------------------------------------------------------------------------- #
+
+
+class TestServeFleetAssembly:
+    def test_discovery_skips_non_model_dirs(self, fleet_dirs, tmp_path):
+        import shutil
+
+        root = tmp_path / "models"
+        root.mkdir()
+        for t in TENANTS[:2]:
+            shutil.copytree(fleet_dirs[t][0], str(root / t))
+        (root / "tenant-a.lifecycle").mkdir()  # work dirs are skipped
+        (root / "notes").mkdir()  # not a sealed model dir
+        assert sorted(discover_models(str(root))) == ["tenant-a", "tenant-b"]
+
+    def test_serve_fleet_requires_exactly_one_source(self):
+        with pytest.raises(ValueError, match="exactly one"):
+            serve_fleet()
+
+    def test_cli_fleet_smoke(self, fleet_dirs, tmp_path, capsys):
+        """`serve --models-dir --max-seconds 0`: comes up, prints a fleet
+        ready line naming the tenants, exits 0."""
+        import shutil
+
+        from isoforest_tpu.__main__ import main
+
+        root = tmp_path / "models"
+        root.mkdir()
+        for t in TENANTS[:2]:
+            shutil.copytree(fleet_dirs[t][0], str(root / t))
+        rc = main(
+            [
+                "serve",
+                "--models-dir",
+                str(root),
+                "--port",
+                "0",
+                "--max-seconds",
+                "0",
+                "--fleet-budget-mb",
+                "64",
+                "--work-dir",
+                str(tmp_path / "work"),
+            ]
+        )
+        assert rc == 0
+        ready = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert ready["fleet"] is True
+        assert ready["models"] == ["tenant-a", "tenant-b"]
+        assert ready["endpoint"].endswith("/score/<model_id>")
+        assert len(telemetry.get_events(kind="fleet.start")) == 1
+
+    def test_cli_refuses_both_modes(self, fleet_dirs, tmp_path, capsys):
+        from isoforest_tpu.__main__ import main
+
+        rc = main(
+            [
+                "serve",
+                fleet_dirs["tenant-a"][0],
+                "--models-dir",
+                str(tmp_path),
+                "--max-seconds",
+                "0",
+            ]
+        )
+        assert rc == 2
+        assert "exactly one" in capsys.readouterr().err

@@ -26,6 +26,12 @@ Typical CI smoke (the serving step in ci.yml):
     python tools/serving_latency.py --url http://127.0.0.1:9321 \\
         --model /tmp/model --duration 2 --concurrency 8 --gate 1.2
 
+With ``--model-id <id>`` the generator drives a fleet tenant route
+``/score/<id>`` instead (docs/fleet.md) — same phases, parity checked
+against that tenant's model dir — and additionally asserts the per-tenant
+``isoforest_fleet_{request_seconds,responses_total}{model_id=}`` series
+exist in ``/snapshot``.
+
 Every phase prints one JSON line; the final line carries the verdict.
 Exits non-zero on parity failure, a missed gate, or missing serving series.
 """
@@ -45,11 +51,16 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 import numpy as np  # noqa: E402
 
 
+# the scoring route this run drives: "/score" (single-model) or
+# "/score/<model_id>" (fleet tenant, docs/fleet.md) — set once in main()
+SCORE_ROUTE = "/score"
+
+
 def _post(url: str, rows, timeout: float = 30.0):
     """POST one JSON batch; returns (status, parsed-body-or-None)."""
     body = json.dumps({"rows": [[float(v) for v in r] for r in rows]}).encode()
     req = urllib.request.Request(
-        url + "/score", data=body, headers={"Content-Type": "application/json"}
+        url + SCORE_ROUTE, data=body, headers={"Content-Type": "application/json"}
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -252,6 +263,26 @@ SERVING_SERIES = (
 )
 
 
+def _check_tenant_series(url, model_id):
+    """With --model-id, the deployment's /snapshot must carry the
+    per-tenant fleet serving series labelled with THIS tenant
+    (docs/fleet.md) — the proof the named route actually scored here."""
+    with urllib.request.urlopen(url + "/snapshot", timeout=10) as resp:
+        doc = json.loads(resp.read())
+    metrics = doc.get("metrics", {})
+    missing = []
+    for name in (
+        "isoforest_fleet_request_seconds",
+        "isoforest_fleet_responses_total",
+    ):
+        series = (metrics.get(name) or {}).get("series") or []
+        if not any(
+            s.get("labels", {}).get("model_id") == model_id for s in series
+        ):
+            missing.append(name)
+    return missing
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--url", required=True, help="base URL of a running serve")
@@ -260,6 +291,15 @@ def main() -> None:
         default=None,
         help="model dir for the bitwise parity cross-check (and synthetic "
         "row widths when --input is not given)",
+    )
+    ap.add_argument(
+        "--model-id",
+        default=None,
+        help="drive a fleet tenant route /score/<model-id> instead of the "
+        "single-model /score, and assert the per-tenant "
+        "isoforest_fleet_* serving series exist in /snapshot "
+        "(docs/fleet.md; pair with --model <that tenant's dir> for the "
+        "bitwise parity phase)",
     )
     ap.add_argument("--input", default=None, help="CSV of rows to score")
     ap.add_argument("--duration", type=float, default=2.0, help="seconds per phase")
@@ -281,6 +321,9 @@ def main() -> None:
     )
     args = ap.parse_args()
     url = args.url.rstrip("/")
+    if args.model_id:
+        global SCORE_ROUTE
+        SCORE_ROUTE = f"/score/{args.model_id}"
 
     if args.input:
         rows_pool = np.loadtxt(
@@ -328,6 +371,25 @@ def main() -> None:
     missing_series = [s for s in SERVING_SERIES if s not in metrics_body]
     if missing_series:
         failed.append(f"missing_series:{missing_series}")
+
+    if args.model_id:
+        try:
+            missing_tenant = _check_tenant_series(url, args.model_id)
+        except Exception as exc:
+            missing_tenant = [f"snapshot_fetch:{exc!r}"]
+        print(
+            json.dumps(
+                {
+                    "phase": "tenant_series",
+                    "model_id": args.model_id,
+                    "missing": missing_tenant,
+                    "pass": not missing_tenant,
+                }
+            ),
+            flush=True,
+        )
+        if missing_tenant:
+            failed.append(f"missing_tenant_series:{missing_tenant}")
 
     ratio = (
         concurrent["rows_per_s"] / sequential["rows_per_s"]
