@@ -48,7 +48,7 @@ def _strategy_candidates() -> list:
     headline auto-tune and the --full EIF ranking use."""
     import jax
 
-    candidates = ["gather", "dense"]
+    candidates = ["gather", "dense", "q16"]
     if jax.devices()[0].platform == "tpu":
         candidates.extend(["pallas", "walk"])
     else:
@@ -101,12 +101,36 @@ def _pick_strategy(model, X: np.ndarray) -> tuple:
     return best, timings
 
 
+def _layout_report(model, num_features: int, strategy: str) -> dict:
+    """Actually-resident scoring-plane bytes for the representation the
+    winning strategy reads: the quantized u32 plane (+ edges/LUT) when q16
+    won, the exact f32/i32 packed planes otherwise. ``layout_bytes`` in the
+    JSON line is therefore the byte footprint the reported throughput was
+    measured AGAINST, not a hypothetical."""
+    from isoforest_tpu.ops import scoring_layout as sl
+
+    if strategy == "q16" and sl.quantized_eligible(model.forest):
+        layout = sl.get_layout_q(model.forest)
+        return {
+            "layout_kind": "q16",
+            "layout_bytes": sl.layout_nbytes(layout),
+            "layout_plane_bytes": sl.quantized_plane_nbytes(layout),
+        }
+    layout = sl.get_layout(model.forest, num_features=num_features)
+    return {
+        "layout_kind": "f32",
+        "layout_bytes": sl.layout_nbytes(layout),
+        "layout_plane_bytes": sl.layout_nbytes(layout),
+    }
+
+
 def bench_ours(
     X: np.ndarray, strategy: str | None = None
-) -> tuple[float, float, float, np.ndarray, str, dict]:
-    """Returns (total_s, fit_s, score_s, scores, strategy, strategy_timings).
-    Pass ``strategy`` to pin a pre-measured winner (tools/tpu_session.py
-    ranks strategies itself and must not burn chip time re-ranking here)."""
+) -> tuple[float, float, float, np.ndarray, str, dict, dict]:
+    """Returns (total_s, fit_s, score_s, scores, strategy, strategy_timings,
+    layout_report). Pass ``strategy`` to pin a pre-measured winner
+    (tools/tpu_session.py ranks strategies itself and must not burn chip
+    time re-ranking here)."""
     import os
 
     from isoforest_tpu import IsolationForest
@@ -124,6 +148,7 @@ def bench_ours(
     else:
         os.environ["ISOFOREST_TPU_STRATEGY"] = strategy
     model.score(X)
+    layout_report = _layout_report(model, X.shape[1], strategy)
 
     # best of two timed passes: the shared build host adds run-to-run noise
     # (observed ~15% spread) that a single sample reports as regression
@@ -135,7 +160,15 @@ def bench_ours(
         scores = model.score(X)
         total_s = time.perf_counter() - start
         if best is None or total_s < best[0]:
-            best = (total_s, fit_s, total_s - fit_s, scores, strategy, timings)
+            best = (
+                total_s,
+                fit_s,
+                total_s - fit_s,
+                scores,
+                strategy,
+                timings,
+                layout_report,
+            )
     return best
 
 
@@ -385,6 +418,29 @@ def _roofline(strategy: str, n: int, f: int, elapsed_s: float, platform: str) ->
         # finalized layout: 2 tables/tree (feature i32 + merged value f32)
         # instead of the pre-layout feature/threshold/leaf triple
         bytes_moved = 4.0 * n * f + 8.0 * t * m * blocks + 4.0 * n
+    elif strategy == "q16":
+        # quantized packed-record walk (ops/scoring_layout.py §quantized):
+        # 4 B/node u32 record (rank code<<16 | feature u16) — half the f32
+        # plane — and the walk compares u16 RANKS, so per-tree-tile row
+        # traffic is the 2 B/element rank plane, not 4 B f32. The exact X is
+        # still read once (f32) to binarize via searchsorted, and the rank
+        # plane is written once; edges + leaf LUT are <=256 KB and tiled
+        # cache-resident, so they are omitted like the f32 model omits its
+        # LUT fold.
+        rec_bytes = 4.0
+        table_bytes = rec_bytes * t * m
+        tile_bytes = 768.0 * 1024.0  # scorer.cpp TILE_BYTES
+        n_tree_tiles = max(1.0, np.ceil(table_bytes / tile_bytes))
+        row_tile = 16.0 * 1024.0
+        # walk comparisons + binarization (searchsorted over E<=64k edges)
+        flops = 4.0 * n * t * h + n * f * np.log2(65536.0)
+        bytes_moved = (
+            4.0 * n * f  # one exact f32 read of X for binarization
+            + 2.0 * n * f  # rank-plane write
+            + n_tree_tiles * 2.0 * n * f  # rank plane per tree tile
+            + table_bytes * np.ceil(n / row_tile)
+            + 4.0 * n
+        )
     else:  # gather / native packed-record walks (ops/scoring_layout.py)
         # 8 B/node record (merged value f32 + feature i32; the leaf LUT is
         # folded into value, so no third array and no end-of-walk gather),
@@ -450,7 +506,15 @@ def main() -> None:
     backend = _ensure_live_backend()
     platform = backend if backend != "cpu_fallback" else "cpu"
     X, y = make_data()
-    ours_s, fit_s, score_s, ours_scores, strategy, strategy_timings = bench_ours(X)
+    (
+        ours_s,
+        fit_s,
+        score_s,
+        ours_scores,
+        strategy,
+        strategy_timings,
+        layout_report,
+    ) = bench_ours(X)
     ours_rps = NUM_ROWS / ours_s
     ours_auroc = auroc(ours_scores, y)
     roof = _roofline(strategy, NUM_ROWS, NUM_FEATURES, score_s, platform)
@@ -502,6 +566,9 @@ def main() -> None:
                 "vs_baseline": round(vs_baseline, 3),
                 "backend": backend,
                 "strategy": strategy,
+                "layout_kind": layout_report["layout_kind"],
+                "layout_bytes": layout_report["layout_bytes"],
+                "layout_plane_bytes": layout_report["layout_plane_bytes"],
                 "auroc": round(ours_auroc, 4),
                 "fit_s": round(fit_s, 3),
                 "score_s": round(score_s, 3),

@@ -117,12 +117,21 @@ class ModelLoadError(ServingError):
 
 
 def layout_nbytes(model) -> int:
-    """Bytes the model's finalized packed scoring layout pins while
-    resident — the planes every scoring strategy gathers from
-    (docs/scoring_layout.md): the interleaved record, the value plane and
-    (standard forests) the narrowed feature table. This is the quantity
-    the residency budget accounts; the raw growth arrays and Python object
-    overhead ride along but the packed planes dominate at fleet density."""
+    """Bytes the model's finalized scoring layout pins while resident — the
+    planes of the representation the tenant actually serves from
+    (docs/scoring_layout.md). For the default exact representation that is
+    the f32 layout: interleaved record, value plane and (standard forests)
+    the narrowed feature table. Tenants preferring the quantized plane
+    (``scoring_representation == "q16"``) pin the packed u32 records plus
+    the shared edge/LUT tables instead — roughly half the bytes — and the
+    residency budget must see THAT number, or a fleet standardised on q16
+    evicts at f32 density. The raw growth arrays and Python object overhead
+    ride along, but the packed planes dominate at fleet density."""
+    if getattr(model, "scoring_representation", "f32") == "q16":
+        from ..ops.scoring_layout import get_layout_q
+        from ..ops.scoring_layout import layout_nbytes as _q16_nbytes
+
+        return _q16_nbytes(get_layout_q(model.forest))
     if getattr(model, "_scoring_layout", None) is None:
         model.finalize_scoring()
     return sum(

@@ -958,7 +958,7 @@ def _check_class(metadata: dict, expected: str) -> None:
 
 
 def _model_metadata(model, class_name: str) -> dict:
-    return {
+    meta = {
         "class": class_name,
         "timestamp": int(time.time() * 1000),
         "sparkVersion": SPARK_VERSION_STRING,
@@ -972,6 +972,15 @@ def _model_metadata(model, class_name: str) -> dict:
         "numFeatures": model.num_features,
         "totalNumFeatures": model.total_num_features,
     }
+    # tolerated extra: the preferred serving representation ("f32" | "q16",
+    # docs/scoring_layout.md). The node table itself is ALWAYS the exact f32
+    # Avro form — readers that don't know the key (the reference, older
+    # versions of this library) ignore it and lose nothing but the warm-up
+    # preference.
+    representation = getattr(model, "scoring_representation", "f32")
+    if representation != "f32":
+        meta["scoringRepresentation"] = representation
+    return meta
 
 
 def _write_data_raw(path: str, schema: dict, body: bytes, count: int) -> None:
@@ -1154,6 +1163,25 @@ def _load_common(
     return metadata, total_num_features, data_issues
 
 
+def _restore_representation(model, metadata: dict) -> None:
+    """Restore the persisted ``scoringRepresentation`` extra (absent or
+    unknown values fall back to the exact "f32" default — a forest edited
+    on disk, or one salvaged smaller by a degraded load, may no longer pass
+    the q16 capacity fence, and the representation is a preference, never a
+    correctness input)."""
+    representation = metadata.get("scoringRepresentation", "f32")
+    if representation == "f32":
+        return
+    try:
+        model.set_scoring_representation(representation)
+    except ValueError as exc:
+        logger.warning(
+            "ignoring persisted scoringRepresentation=%r: %s",
+            representation,
+            exc,
+        )
+
+
 def _expected_trees(metadata: dict):
     try:
         n = int(metadata["paramMap"]["numEstimators"])
@@ -1211,6 +1239,7 @@ def load_standard_model(
     )
     model.load_report = load_report
     model.baseline = _read_baseline(path)
+    _restore_representation(model, metadata)
     threshold = float(metadata.get("outlierScoreThreshold", -1.0))
     if threshold >= 0:
         model.set_outlier_score_threshold(threshold)
@@ -1266,6 +1295,7 @@ def load_extended_model(
     )
     model.load_report = load_report
     model.baseline = _read_baseline(path)
+    _restore_representation(model, metadata)
     threshold = float(metadata.get("outlierScoreThreshold", -1.0))
     if threshold >= 0:
         model.set_outlier_score_threshold(threshold)

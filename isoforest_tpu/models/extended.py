@@ -38,6 +38,7 @@ from .isolation_forest import (
     _capture_fit_baseline,
     _compute_and_set_threshold,
     _new_uid,
+    _resolve_subsample_trees,
 )
 
 _REFERENCE_MODEL_CLASS = (
@@ -75,12 +76,21 @@ class ExtendedIsolationForest(_ParamSetters):
         resume: bool = False,
         baseline: bool = True,
         block_callback=None,
+        subsample_trees=None,
     ) -> "ExtendedIsolationForestModel":
         """Train; same knobs as :meth:`IsolationForest.fit`, including the
         preemption-safe ``checkpoint_dir``/``checkpoint_every``/``resume``
-        block-wise growth (docs/resilience.md §5) and the drift-monitoring
-        ``baseline`` capture (docs/observability.md §8)."""
+        block-wise growth (docs/resilience.md §5), the drift-monitoring
+        ``baseline`` capture (docs/observability.md §8) and the
+        FastForest-style ``subsample_trees`` subbagging knob."""
         p = self.params
+        if subsample_trees is not None:
+            effective = _resolve_subsample_trees(subsample_trees, p.num_estimators)
+            logger.info(
+                "subsample_trees=%r: growing %d of %d trees",
+                subsample_trees, effective, p.num_estimators,
+            )
+            p = p.replace(num_estimators=effective)
         X, _ = extract_features(data, p.features_col, nonfinite=nonfinite)
         total_rows, total_feats = int(X.shape[0]), int(X.shape[1])
         resolved = resolve_params(p, total_feats, total_rows)

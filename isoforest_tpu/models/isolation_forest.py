@@ -59,6 +59,44 @@ def _new_uid(prefix: str) -> str:
     return f"{prefix}_{uuid.uuid4().hex[:12]}"
 
 
+# Scoring representations a model can prefer (persisted as the tolerated
+# `scoringRepresentation` metadata extra): the exact f32 packed plane, or
+# the rank-quantized q16 plane (ops/scoring_layout.pack_standard_q —
+# decision-identical to f32 by construction, docs/scoring_layout.md).
+SCORING_REPRESENTATIONS = ("f32", "q16")
+
+
+def _resolve_subsample_trees(subsample_trees, num_estimators: int) -> int:
+    """FastForest-style fit-time subbagging knob (arxiv 2004.02423): an int
+    is an absolute tree count, a float in (0, 1] a fraction of
+    ``numEstimators``. Returns the effective tree count (>= 1). Scoring
+    normalisation rescales automatically — path lengths average over the
+    grown trees, the same soundness argument as the dropped-tree degraded
+    load (io/persistence._load_forest_tolerant)."""
+    if isinstance(subsample_trees, bool) or not isinstance(
+        subsample_trees, (int, float)
+    ):
+        raise ValueError(
+            f"subsample_trees must be an int tree count or a float fraction "
+            f"in (0, 1], got {subsample_trees!r}"
+        )
+    if isinstance(subsample_trees, int):
+        count = subsample_trees
+    else:
+        if not 0.0 < subsample_trees <= 1.0:
+            raise ValueError(
+                f"fractional subsample_trees must be in (0, 1], got "
+                f"{subsample_trees!r}"
+            )
+        count = int(round(subsample_trees * num_estimators))
+    if not 1 <= count <= num_estimators:
+        raise ValueError(
+            f"subsample_trees resolves to {count} trees, outside "
+            f"[1, numEstimators={num_estimators}]"
+        )
+    return count
+
+
 # Fit-time drift-baseline capture (docs/observability.md §8): scored rows
 # are capped so the capture stays a few percent of fit even at bench scale;
 # the subsample is a deterministic stride (no RNG — checkpointed and plain
@@ -268,6 +306,7 @@ class IsolationForest(_ParamSetters):
         resume: bool = False,
         baseline: bool = True,
         block_callback=None,
+        subsample_trees=None,
     ) -> "IsolationForestModel":
         """Train. With ``mesh`` (a `jax.sharding.Mesh` with a ``'trees'`` axis),
         tree growth is sharded across devices (SURVEY.md §2.4 tree parallelism);
@@ -297,8 +336,23 @@ class IsolationForest(_ParamSetters):
         called as ``callback(index, start, stop, resumed)`` after each tree
         block becomes durable (freshly sealed, or loaded from a previous
         session's seal) — the lifecycle manager uses it to emit
-        ``retrain.block`` events live (docs/resilience.md §8)."""
+        ``retrain.block`` events live (docs/resilience.md §8).
+
+        ``subsample_trees`` (FastForest-style subbagging, arxiv 2004.02423)
+        grows only a subset of ``numEstimators`` trees — an int tree count
+        or a float fraction in (0, 1] — trading a proportional fit-time cut
+        for a small, bounded AUROC impact (pinned in
+        tests/test_quality_gates.py). The fitted model records the reduced
+        ensemble size, so scoring normalisation and persistence stay
+        consistent."""
         p = self.params
+        if subsample_trees is not None:
+            effective = _resolve_subsample_trees(subsample_trees, p.num_estimators)
+            logger.info(
+                "subsample_trees=%r: growing %d of %d trees",
+                subsample_trees, effective, p.num_estimators,
+            )
+            p = p.replace(num_estimators=effective)
         X, _ = extract_features(data, p.features_col, nonfinite=nonfinite)
         total_rows, total_feats = int(X.shape[0]), int(X.shape[1])
         resolved = resolve_params(p, total_feats, total_rows)
@@ -481,6 +535,12 @@ class IsolationForestModel:
         # models — the on-disk format stays the reference Avro node arrays
         # and the layout is rebuilt from them after load
         self._scoring_layout = None
+        # preferred serving representation ("f32" | "q16"): persisted as the
+        # tolerated `scoringRepresentation` metadata extra and restored on
+        # load, so a fleet that standardised on the quantized plane keeps it
+        # across save/load without re-deciding per process. The on-disk node
+        # table is always the exact f32 Avro form; q16 is rebuilt from it.
+        self.scoring_representation = "f32"
 
     def set_outlier_score_threshold(self, value: float) -> "IsolationForestModel":
         """Manually override the threshold (IsolationForestModel.scala:86-95)."""
@@ -493,12 +553,51 @@ class IsolationForestModel:
 
     # ------------------------------------------------------------------ #
 
+    def set_scoring_representation(self, value: str) -> "IsolationForestModel":
+        """Record the preferred serving representation (``"f32"`` default,
+        or ``"q16"`` — the rank-quantized plane, decision-identical to f32).
+        Persisted with the model and restored on load. ``"q16"`` requires
+        the forest to pass the quantized capacity fence
+        (:func:`~isoforest_tpu.ops.scoring_layout.quantized_eligible`);
+        scoring with ``strategy="auto"`` still measures — the preference
+        warms the quantized layout eagerly at :meth:`finalize_scoring` and
+        travels with the model, it does not pin the kernel. Returns self."""
+        if value not in SCORING_REPRESENTATIONS:
+            raise ValueError(
+                f"scoring representation must be one of "
+                f"{'/'.join(SCORING_REPRESENTATIONS)}, got {value!r}"
+            )
+        if value == "q16":
+            from ..ops.scoring_layout import quantized_unsupported_reason
+
+            reason = quantized_unsupported_reason(self.forest)
+            if reason is not None:
+                raise ValueError(
+                    f"this forest cannot take the q16 representation: {reason}"
+                )
+        self.scoring_representation = value
+        if value == "q16":
+            # release the exact f32 plane (rebuilt lazily if a non-q16
+            # strategy runs) and warm the quantized one, so residency
+            # accounting reflects the switch immediately
+            self._scoring_layout = None
+            from ..ops.scoring_layout import get_layout_q
+
+            get_layout_q(self.forest)
+        return self
+
     def finalize_scoring(self) -> "IsolationForestModel":
         """Build the finalized scoring layout (packed node records + leaf
         path-length LUT, :mod:`~isoforest_tpu.ops.scoring_layout`) once for
         this forest. ``fit`` calls this; loaded models hit it lazily on the
         first :meth:`score` — persistence round-trips through the reference
-        Avro node arrays unchanged and rebuilds the layout here. Returns
+        Avro node arrays unchanged and rebuilds the layout here. Models
+        preferring the ``"q16"`` representation warm ONLY the quantized
+        plane: the exact f32 layout stays lazy (``score_matrix`` resolves
+        it on demand if a non-q16 strategy actually runs), so a quantized
+        tenant's resident bytes really are the compressed plane + shared
+        tables (fleet residency accounting,
+        :func:`~isoforest_tpu.fleet.registry.layout_nbytes`). Returns
         self."""
         from ..ops.scoring_layout import get_layout
 
@@ -508,7 +607,12 @@ class IsolationForestModel:
             else None
         )
         with _telemetry_span("model.finalize_scoring", trees=self.forest.num_trees):
-            self._scoring_layout = get_layout(self.forest, num_features=width)
+            if self.scoring_representation == "q16":
+                from ..ops.scoring_layout import get_layout_q
+
+                get_layout_q(self.forest)
+            else:
+                self._scoring_layout = get_layout(self.forest, num_features=width)
         return self
 
     def score(
@@ -557,7 +661,10 @@ class IsolationForestModel:
                     chunk_rows=chunk_size,
                 )
             else:
-                if self._scoring_layout is None:
+                if (
+                    self._scoring_layout is None
+                    and self.scoring_representation != "q16"
+                ):
                     self.finalize_scoring()
                 expected = (
                     self.total_num_features

@@ -110,6 +110,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.if_score_extended.argtypes = [
         f32p, i64, i32, i32p, f32p, f32p, i64, i64, i32, i32, f32p,
     ]
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.if_score_standard_q16.restype = None
+    lib.if_score_standard_q16.argtypes = [
+        u16p, i64, i32, u32p, f32p, i64, i64, i32, f32p,
+    ]
+    lib.if_binarize_ranks.restype = None
+    lib.if_binarize_ranks.argtypes = [f32p, i64, f32p, i64, u16p]
     lib.if_encode_standard.restype = i64
     lib.if_encode_standard.argtypes = [
         i32p, i32p, i32p, i32p, i32p, f64p, i64p, i64, i8p, i64,
@@ -319,6 +327,85 @@ def score_standard(feature, threshold, num_instances, X, height: int):
     out = np.empty(n, np.float32)
     lib.if_score_standard(
         _f32ptr(X), n, f, _i32ptr(feature), _f32ptr(value),
+        t, m, height, _f32ptr(out),
+    )
+    return out
+
+
+# Distinct cache-key sentinel for the quantized prep: score_standard and
+# score_standard_q16 key on the SAME forest arrays, and _cached compares by
+# identity, so without a marker the two preps would evict each other.
+_Q16_KEY = object()
+
+
+def score_standard_q16(feature, threshold, num_instances, X, height: int):
+    """Quantized (q16) mean path length f32[N]; None if unavailable.
+
+    Host prep (cached per forest): rank-space packed plane — sorted deduped
+    threshold ``edges``, per-node u32 ``code << 16 | feature`` (0xFFFF at
+    leaves/holes, where code indexes the deduped leaf LUT instead of the
+    edge table). Per call X is binarized into u16 ranks with one vectorized
+    searchsorted; the 4 B/node walk is decision-identical to f32 by
+    construction (rank > code  <=>  x >= threshold) and credits the same
+    leaf bits as score_standard's merged value plane.
+    """
+    lib = get_library()
+    if lib is None or not hasattr(lib, "if_score_standard_q16"):
+        return None
+
+    def build():
+        from ..utils.math import leaf_value_table
+
+        feat = np.ascontiguousarray(feature, np.int64)
+        thr = np.asarray(threshold, np.float32)
+        internal = feat >= 0
+        edges = np.unique(thr[internal]).astype(np.float32)
+        leaf_vals = np.asarray(
+            leaf_value_table(num_instances, height), np.float32
+        )
+        lut = np.unique(
+            np.concatenate([[np.float32(0.0)], leaf_vals[~internal]])
+        ).astype(np.float32)
+        code = np.empty(feat.shape, np.uint32)
+        code[internal] = np.searchsorted(edges, thr[internal]).astype(np.uint32)
+        code[~internal] = np.searchsorted(lut, leaf_vals[~internal]).astype(
+            np.uint32
+        )
+        packed = np.ascontiguousarray(
+            (code << np.uint32(16))
+            | np.where(internal, feat, 0xFFFF).astype(np.uint32)
+        )
+        return packed, edges, lut
+
+    packed, edges, lut = _cached(
+        (feature, threshold, num_instances, _Q16_KEY), build
+    )
+    X = np.ascontiguousarray(X, np.float32)
+    n, f = X.shape
+    t, m = packed.shape
+    # +32 trailing u16: the SIMD rank gather reads 4 bytes at 2-byte offsets
+    # and the register-resident rank slab rounds its loads up to full zmm
+    # registers (worst case 32 bytes past an odd-F slab), so the last
+    # block's over-read must stay inside the allocation
+    xr = np.empty(n * f + 32, np.uint16)
+    if n * f:
+        if hasattr(lib, "if_binarize_ranks"):
+            # threaded native binary search, bitwise np.searchsorted
+            # (side='right') semantics incl. NaN -> n_edges; numpy's
+            # generic kernel was the q16 path's dominant per-call cost
+            lib.if_binarize_ranks(
+                _f32ptr(X), n * f, _f32ptr(edges), edges.size,
+                xr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            )
+        else:
+            xr[: n * f] = np.searchsorted(
+                edges, X.reshape(-1), side="right"
+            ).astype(np.uint16)
+    xr[n * f :] = 0
+    out = np.empty(n, np.float32)
+    lib.if_score_standard_q16(
+        xr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)), n, f,
+        packed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), _f32ptr(lut),
         t, m, height, _f32ptr(out),
     )
     return out

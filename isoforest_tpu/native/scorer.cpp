@@ -122,6 +122,75 @@ void score_standard_rows_scalar(const float* X, int64_t r0, int64_t r1,
   }
 }
 
+// Quantized (q16) standard walk over the rank-space plane
+// (ops/scoring_layout.py pack_standard_q): the caller binarizes rows once
+// to u16 threshold ranks (rx = #edges <= x), each node is ONE u32 record
+// `code << 16 | feature` (0xFFFF feature marks leaves/holes), and the
+// branch test is the integer compare `rx > code` — exactly equivalent to
+// `x >= threshold`, so the walk visits the same leaves as the f32 kernel.
+// Leaves credit the shared f32 LUT (the same bits the f32 merged plane
+// holds). Tiling uses the f32 plane's 8 B/node budget, NOT the real
+// 4 B/node: the per-tile f64 fold grouping must match if_score_standard's
+// exactly for q16 scores to stay bitwise-equal to the f32 walker's.
+void score_standard_q16_rows_scalar(const uint16_t* XR, int64_t r0, int64_t r1,
+                                    int32_t n_features, const uint32_t* packed,
+                                    const float* lut, int64_t n_trees,
+                                    int64_t m_nodes, int32_t height,
+                                    float* out) {
+  const int64_t tile = tile_trees(m_nodes * 8);
+  std::vector<double> acc_buf;
+  double* acc = nullptr;
+  if (n_trees > tile) {
+    acc_buf.assign(r1 - r0, 0.0);
+    acc = acc_buf.data();
+  }
+  for (int64_t g0 = 0; g0 < n_trees; g0 += tile) {
+    const int64_t g1 = std::min(n_trees, g0 + tile);
+    for (int64_t r = r0; r < r1; ++r) {
+      const uint16_t* xr = XR + r * n_features;
+      double total = 0.0;
+      int64_t t0 = g0;
+      for (; t0 + TREE_BLOCK <= g1; t0 += TREE_BLOCK) {
+        int32_t nd[TREE_BLOCK] = {0};
+        for (int32_t s = 0; s < height; ++s) {
+          for (int j = 0; j < TREE_BLOCK; ++j) {
+            const int64_t base = (t0 + j) * m_nodes;
+            const int32_t n = nd[j];
+            const uint32_t rec = packed[base + n];
+            const uint32_t f = rec & 0xFFFFu;
+            const bool internal = f != 0xFFFFu;
+            const uint32_t rv = xr[internal ? f : 0];
+            const int32_t nxt = 2 * n + 1 + (rv > (rec >> 16) ? 1 : 0);
+            nd[j] = internal ? nxt : n;
+          }
+        }
+        for (int j = 0; j < TREE_BLOCK; ++j)
+          total += lut[packed[(t0 + j) * m_nodes + nd[j]] >> 16];
+      }
+      for (; t0 < g1; ++t0) {
+        const int64_t base = t0 * m_nodes;
+        int32_t n = 0;
+        for (int32_t s = 0; s < height; ++s) {
+          const uint32_t rec = packed[base + n];
+          const uint32_t f = rec & 0xFFFFu;
+          if (f == 0xFFFFu) break;
+          n = 2 * n + 1 + (xr[f] > (rec >> 16) ? 1 : 0);
+        }
+        total += lut[packed[base + n] >> 16];
+      }
+      if (acc) {
+        acc[r - r0] += total;
+      } else {
+        out[r] = static_cast<float>(total / static_cast<double>(n_trees));
+      }
+    }
+  }
+  if (acc) {
+    for (int64_t r = r0; r < r1; ++r)
+      out[r] = static_cast<float>(acc[r - r0] / static_cast<double>(n_trees));
+  }
+}
+
 void score_extended_rows_scalar(const float* X, int64_t r0, int64_t r1,
                                 int32_t n_features, const int32_t* indices,
                                 const float* weights, const float* value,
@@ -686,6 +755,335 @@ __attribute__((target("avx512f,avx512dq"))) void score_extended_rows_avx512(
     score_extended_rows_scalar(X, r, r1, n_features, indices, weights, value,
                                n_trees, m_nodes, k, height, out);
 }
+// Quantized (q16) AVX-512 walk. The 4 B/node record plane halves every
+// node-table footprint relative to f32's feature+threshold pair: the
+// 32-record table of heap levels 0..4 is TWO zmm (vs four), level 6's
+// 64-record table four (vs eight) — so the same permute trick covers the
+// same levels at half the register cost. Better still, 16-bit ranks halve
+// the row slab: 16 rows x F u16 = 8F dwords, so the WHOLE slab is
+// register-resident up to F <= 8 (QTAB_MAX_FEATURES, double f32's F <= 4
+// xtable budget) and permute-level steps issue no gathers at all. When a
+// gather does remain, the rank gather reads 4 bytes at each u16 offset and
+// masks the low half; the caller pads the rank buffer (>= 32 trailing u16)
+// so the last slab's register loads and the last element's over-read stay
+// in-bounds. Same f64 lane accumulation in ascending-tree order and the
+// SAME tile grouping as the f32 kernel, so scalar q16, SIMD q16 and the
+// f32 walker all produce bitwise-identical scores.
+constexpr int32_t QTAB_MAX_FEATURES = 8;
+
+struct RankTable128 {
+  __m512i r0, r1, r2, r3;
+  bool narrow;  // F <= 4: dword ids < 32, single vpermi2d
+};
+
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline RankTable128
+load_rtable(const uint16_t* XRb, int32_t f) {
+  // slab = 8f dwords; load only registers it reaches (aliasing the rest)
+  // so the worst-case over-read is 8 dwords, inside the caller's padding
+  const int32_t* p = reinterpret_cast<const int32_t*>(XRb);
+  const __m512i r0 = _mm512_loadu_si512(p);
+  const __m512i r1 = f > 2 ? _mm512_loadu_si512(p + 16) : r0;
+  const __m512i r2 = f > 4 ? _mm512_loadu_si512(p + 32) : r1;
+  const __m512i r3 = f > 6 ? _mm512_loadu_si512(p + 48) : r2;
+  return {r0, r1, r2, r3, f <= 4};
+}
+
+// rank at flat u16 index xi: permute the containing dword, then shift the
+// odd/even half down — pure register traffic, no gather
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
+rlookup(const RankTable128& rt, __m512i xi) {
+  const __m512i di = _mm512_srli_epi32(xi, 1);
+  const __m512i sh =
+      _mm512_slli_epi32(_mm512_and_si512(xi, _mm512_set1_epi32(1)), 4);
+  __m512i w = _mm512_permutex2var_epi32(rt.r0, di, rt.r1);
+  if (!rt.narrow) {
+    const __m512i w_hi = _mm512_permutex2var_epi32(rt.r2, di, rt.r3);
+    const __mmask16 top =
+        _mm512_cmp_epi32_mask(di, _mm512_set1_epi32(31), _MM_CMPINT_NLE);
+    w = _mm512_mask_blend_epi32(top, w, w_hi);
+  }
+  return _mm512_and_si512(_mm512_srlv_epi32(w, sh),
+                          _mm512_set1_epi32(0xFFFF));
+}
+
+// Shared tail of every q16 step: unpack the record, fetch the row's rank
+// for the split feature (register slab or gather), advance internal lanes.
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
+advance_q16(__m512i nd, __m512i rec, const uint16_t* XRb, __m512i vroff,
+            bool use_rt, const RankTable128& rt) {
+  const __m512i fmask = _mm512_set1_epi32(0xFFFF);
+  const __m512i one = _mm512_set1_epi32(1);
+  const __m512i f = _mm512_and_si512(rec, fmask);
+  const __mmask16 internal = _mm512_cmp_epi32_mask(f, fmask, _MM_CMPINT_NE);
+  const __m512i code = _mm512_srli_epi32(rec, 16);
+  const __m512i xi = _mm512_add_epi32(
+      vroff, _mm512_mask_mov_epi32(_mm512_setzero_si512(), internal, f));
+  const __m512i rv =
+      use_rt ? rlookup(rt, xi)
+             : _mm512_and_si512(
+                   _mm512_i32gather_epi32(
+                       xi, reinterpret_cast<const int*>(XRb), 2),
+                   fmask);
+  const __mmask16 b = _mm512_cmp_epu32_mask(rv, code, _MM_CMPINT_NLE);
+  __m512i nxt = _mm512_add_epi32(_mm512_slli_epi32(nd, 1), one);
+  nxt = _mm512_mask_add_epi32(nxt, b, nxt, one);
+  return _mm512_mask_mov_epi32(nd, internal, nxt);
+}
+
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
+step_standard_q16(__m512i nd, const uint32_t* packedb, const uint16_t* XRb,
+                  __m512i vroff, bool use_rt, const RankTable128& rt) {
+  const __m512i rec =
+      _mm512_i32gather_epi32(nd, reinterpret_cast<const int*>(packedb), 4);
+  return advance_q16(nd, rec, XRb, vroff, use_rt, rt);
+}
+
+struct QNodeTable32 {
+  __m512i lo, hi;
+};
+
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline QNodeTable32
+load_qtable32(const uint32_t* packedb) {
+  return {_mm512_loadu_si512(packedb), _mm512_loadu_si512(packedb + 16)};
+}
+
+// Levels 0..4 (node ids < 31): the record table lives in one zmm pair.
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
+step_q16_perm(__m512i nd, const QNodeTable32& tab, const uint16_t* XRb,
+              __m512i vroff, bool use_rt, const RankTable128& rt) {
+  const __m512i rec = _mm512_permutex2var_epi32(tab.lo, nd, tab.hi);
+  return advance_q16(nd, rec, XRb, vroff, use_rt, rt);
+}
+
+// Level 5 (node ids 31..62), indexed nd-31; lanes that went leaf earlier
+// alias into the table, so their record is forced to the leaf sentinel
+// (feature 0xFFFF) before the advance. Requires m_nodes >= 63.
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
+step_q16_perm_l5(__m512i nd, const QNodeTable32& tab, const uint16_t* XRb,
+                 __m512i vroff, bool use_rt, const RankTable128& rt) {
+  const __m512i vbase = _mm512_set1_epi32(31);
+  const __m512i idx = _mm512_sub_epi32(nd, vbase);
+  const __mmask16 in_level =
+      _mm512_cmp_epi32_mask(nd, vbase, _MM_CMPINT_NLT);  // nd >= 31
+  const __m512i rec = _mm512_mask_mov_epi32(
+      _mm512_set1_epi32(0xFFFF), in_level,
+      _mm512_permutex2var_epi32(tab.lo, idx, tab.hi));
+  return advance_q16(nd, rec, XRb, vroff, use_rt, rt);
+}
+
+// Level 6 (node ids 63..126, 64 records): two zmm pairs with the same
+// 64-entry blended lookup as xlookup/rlookup. Requires m_nodes >= 127.
+struct QNodeTable64 {
+  __m512i p0, p1, p2, p3;
+};
+
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline QNodeTable64
+load_qtable64(const uint32_t* packedb) {
+  return {_mm512_loadu_si512(packedb), _mm512_loadu_si512(packedb + 16),
+          _mm512_loadu_si512(packedb + 32), _mm512_loadu_si512(packedb + 48)};
+}
+
+__attribute__((target("avx512f,avx512dq"), always_inline)) inline __m512i
+step_q16_perm_l6(__m512i nd, const QNodeTable64& tab, const uint16_t* XRb,
+                 __m512i vroff, bool use_rt, const RankTable128& rt) {
+  const __m512i vbase = _mm512_set1_epi32(63);
+  const __m512i idx = _mm512_sub_epi32(nd, vbase);
+  const __mmask16 in_level =
+      _mm512_cmp_epi32_mask(nd, vbase, _MM_CMPINT_NLT);  // nd >= 63
+  const __mmask16 top =
+      _mm512_cmp_epi32_mask(idx, _mm512_set1_epi32(31), _MM_CMPINT_NLE);
+  const __m512i rec_lo = _mm512_permutex2var_epi32(tab.p0, idx, tab.p1);
+  const __m512i rec_hi = _mm512_permutex2var_epi32(tab.p2, idx, tab.p3);
+  const __m512i rec = _mm512_mask_mov_epi32(
+      _mm512_set1_epi32(0xFFFF), in_level,
+      _mm512_mask_blend_epi32(top, rec_lo, rec_hi));
+  return advance_q16(nd, rec, XRb, vroff, use_rt, rt);
+}
+
+__attribute__((target("avx512f,avx512dq"))) void score_standard_q16_rows_avx512(
+    const uint16_t* XR, int64_t r0, int64_t r1, int32_t n_features,
+    const uint32_t* packed, const float* lut, int64_t n_trees,
+    int64_t m_nodes, int32_t height, float* out) {
+  const int64_t tile = tile_trees(m_nodes * 8);  // match the f32 fold grouping
+  const __m512i zero = _mm512_setzero_si512();
+  alignas(64) int32_t roff_arr[LANES];
+  for (int j = 0; j < LANES; ++j) roff_arr[j] = j * n_features;
+  const __m512i vroff = _mm512_load_si512(roff_arr);
+
+  int64_t r = r0;
+  for (; r + LANES <= r1; r += LANES) {
+    const uint16_t* XRb = XR + r * n_features;
+    __m512d acc_lo = _mm512_setzero_pd();
+    __m512d acc_hi = _mm512_setzero_pd();
+    // same level scheduling as the f32 kernel: levels 0..4 resolve records
+    // by register permute when the tree has >= 32 nodes, levels 5/6 by the
+    // offset tables, the rest by gather; the rank slab is register-resident
+    // whenever F <= QTAB_MAX_FEATURES
+    const int32_t perm = m_nodes >= 32 ? std::min(height, PERM_LEVELS) : 0;
+    const bool use_rt = n_features <= QTAB_MAX_FEATURES;
+    const RankTable128 rt =
+        use_rt ? load_rtable(XRb, n_features) : RankTable128{};
+    for (int64_t g0 = 0; g0 < n_trees; g0 += tile) {
+      const int64_t g1 = std::min(n_trees, g0 + tile);
+      __m512d tot_lo = _mm512_setzero_pd();
+      __m512d tot_hi = _mm512_setzero_pd();
+      int64_t t = g0;
+      for (; t + TREE_IL <= g1; t += TREE_IL) {
+        __m512i nd[TREE_IL];
+        QNodeTable32 tab[TREE_IL];
+        for (int u = 0; u < TREE_IL; ++u) {
+          nd[u] = zero;
+          if (perm) tab[u] = load_qtable32(packed + (t + u) * m_nodes);
+        }
+        for (int32_t s = 0; s < perm; ++s)
+          for (int u = 0; u < TREE_IL; ++u)
+            nd[u] = step_q16_perm(nd[u], tab[u], XRb, vroff, use_rt, rt);
+        int32_t deep = perm;
+        if (perm == PERM_LEVELS && height > PERM_LEVELS && m_nodes >= 63) {
+          for (int u = 0; u < TREE_IL; ++u)
+            tab[u] = load_qtable32(packed + (t + u) * m_nodes + 31);
+          for (int u = 0; u < TREE_IL; ++u)
+            nd[u] = step_q16_perm_l5(nd[u], tab[u], XRb, vroff, use_rt, rt);
+          deep = perm + 1;
+          if (height > deep && m_nodes >= 127) {
+            for (int u = 0; u < TREE_IL; ++u) {
+              const QNodeTable64 l6 =
+                  load_qtable64(packed + (t + u) * m_nodes + 63);
+              nd[u] = step_q16_perm_l6(nd[u], l6, XRb, vroff, use_rt, rt);
+            }
+            deep += 1;
+          }
+        }
+        for (int32_t s = deep; s < height; ++s)
+          for (int u = 0; u < TREE_IL; ++u)
+            nd[u] = step_standard_q16(nd[u], packed + (t + u) * m_nodes, XRb,
+                                      vroff, use_rt, rt);
+        for (int u = 0; u < TREE_IL; ++u) {
+          const __m512i rec = _mm512_i32gather_epi32(
+              nd[u], reinterpret_cast<const int*>(packed + (t + u) * m_nodes),
+              4);
+          acc_leaf_f64(
+              _mm512_i32gather_ps(_mm512_srli_epi32(rec, 16), lut, 4),
+              tot_lo, tot_hi);
+        }
+      }
+      for (; t < g1; ++t) {
+        __m512i nd = zero;
+        if (perm) {
+          const QNodeTable32 tab = load_qtable32(packed + t * m_nodes);
+          for (int32_t s = 0; s < perm; ++s)
+            nd = step_q16_perm(nd, tab, XRb, vroff, use_rt, rt);
+        }
+        int32_t deep = perm;
+        if (perm == PERM_LEVELS && height > PERM_LEVELS && m_nodes >= 63) {
+          const QNodeTable32 l5 = load_qtable32(packed + t * m_nodes + 31);
+          nd = step_q16_perm_l5(nd, l5, XRb, vroff, use_rt, rt);
+          deep = perm + 1;
+          if (height > deep && m_nodes >= 127) {
+            const QNodeTable64 l6 = load_qtable64(packed + t * m_nodes + 63);
+            nd = step_q16_perm_l6(nd, l6, XRb, vroff, use_rt, rt);
+            deep += 1;
+          }
+        }
+        for (int32_t s = deep; s < height; ++s)
+          nd = step_standard_q16(nd, packed + t * m_nodes, XRb, vroff, use_rt,
+                                 rt);
+        const __m512i rec = _mm512_i32gather_epi32(
+            nd, reinterpret_cast<const int*>(packed + t * m_nodes), 4);
+        acc_leaf_f64(_mm512_i32gather_ps(_mm512_srli_epi32(rec, 16), lut, 4),
+                     tot_lo, tot_hi);
+      }
+      acc_lo = _mm512_add_pd(acc_lo, tot_lo);
+      acc_hi = _mm512_add_pd(acc_hi, tot_hi);
+    }
+    const __m512d vn = _mm512_set1_pd(static_cast<double>(n_trees));
+    _mm256_storeu_ps(out + r, _mm512_cvtpd_ps(_mm512_div_pd(acc_lo, vn)));
+    _mm256_storeu_ps(out + r + 8, _mm512_cvtpd_ps(_mm512_div_pd(acc_hi, vn)));
+  }
+  if (r < r1)
+    score_standard_q16_rows_scalar(XR, r, r1, n_features, packed, lut,
+                                   n_trees, m_nodes, height, out);
+}
+#endif  // IF_X86
+
+// ---------------------------------------------------------------------------
+// Rank binarization (the q16 plane's per-call prep).
+// ---------------------------------------------------------------------------
+
+// Scalar searchsorted(edges, v, side='right'): count of edges <= v. The
+// `v < edges[mid]` comparison is false for NaN, so NaN converges to
+// n_edges — numpy's exact behaviour (NaN sorts past every edge).
+void binarize_cells_scalar(const float* X, int64_t c0, int64_t c1,
+                           const float* edges, int64_t n_edges,
+                           uint16_t* out) {
+  for (int64_t c = c0; c < c1; ++c) {
+    const float v = X[c];
+    int64_t lo = 0, hi = n_edges;
+    while (lo < hi) {
+      const int64_t mid = (lo + hi) >> 1;
+      if (v < edges[mid]) {
+        hi = mid;
+      } else {
+        lo = mid + 1;
+      }
+    }
+    out[c] = static_cast<uint16_t>(lo);
+  }
+}
+
+#if IF_X86
+// 16-lane binary search, BIN_IL vectors interleaved: each search step is a
+// serial add -> gather -> compare -> blend chain (~35 cycles of latency on
+// an L1-resident edge table), so a single vector would run at latency, not
+// throughput — interleaving 4 independent vectors keeps ~4 gathers in
+// flight and quarters the effective per-step cost, the same trick as
+// TREE_IL in the walkers. Same integer algorithm as the scalar loop
+// (masked lanes stop moving once lo == hi), so any ISA/interleave combo
+// produces identical u16 ranks.
+constexpr int BIN_IL = 4;
+
+__attribute__((target("avx512f,avx512dq"))) void binarize_cells_avx512(
+    const float* X, int64_t c0, int64_t c1, const float* edges,
+    int64_t n_edges, uint16_t* out) {
+  const __m512i one = _mm512_set1_epi32(1);
+  const __m512i vend = _mm512_set1_epi32(static_cast<int32_t>(n_edges));
+  int64_t c = c0;
+  for (; c + BIN_IL * LANES <= c1; c += BIN_IL * LANES) {
+    __m512 v[BIN_IL];
+    __m512i lo[BIN_IL], hi[BIN_IL];
+    for (int u = 0; u < BIN_IL; ++u) {
+      v[u] = _mm512_loadu_ps(X + c + u * LANES);
+      lo[u] = _mm512_setzero_si512();
+      hi[u] = vend;
+    }
+    while (true) {
+      __mmask16 active[BIN_IL];
+      int any = 0;
+      for (int u = 0; u < BIN_IL; ++u) {
+        active[u] = _mm512_cmp_epi32_mask(lo[u], hi[u], _MM_CMPINT_LT);
+        any |= active[u];
+      }
+      if (!any) break;
+      for (int u = 0; u < BIN_IL; ++u) {
+        const __m512i mid =
+            _mm512_srli_epi32(_mm512_add_epi32(lo[u], hi[u]), 1);
+        const __m512 e =
+            _mm512_mask_i32gather_ps(v[u], active[u], mid, edges, 4);
+        // ordered-quiet <: false for NaN lanes, matching the scalar loop
+        const __mmask16 less =
+            _mm512_mask_cmp_ps_mask(active[u], v[u], e, _CMP_LT_OQ);
+        hi[u] = _mm512_mask_mov_epi32(hi[u], less, mid);
+        lo[u] = _mm512_mask_mov_epi32(
+            lo[u], static_cast<__mmask16>(active[u] & ~less),
+            _mm512_add_epi32(mid, one));
+      }
+    }
+    for (int u = 0; u < BIN_IL; ++u)
+      _mm256_storeu_si256(reinterpret_cast<__m256i*>(out + c + u * LANES),
+                          _mm512_cvtepi32_epi16(lo[u]));
+  }
+  if (c < c1) binarize_cells_scalar(X, c, c1, edges, n_edges, out);
+}
 #endif  // IF_X86
 
 // ---------------------------------------------------------------------------
@@ -822,6 +1220,56 @@ void if_score_extended(const float* X, int64_t n_rows, int32_t n_features,
     (void)simd;
     score_extended_rows_scalar(X, r0, r1, n_features, indices, weights, value,
                                n_trees, m_nodes, k, height, out);
+  });
+}
+
+// Quantized (q16) standard walk. The caller pre-binarizes X into per-cell
+// ranks xrank[n_rows, n_features] u16 (count of forest threshold edges <=
+// x, computed host-side with one vectorized searchsorted) and ships the
+// 4 B/node packed plane packed[T, M] u32 (code << 16 | feature, feature
+// 0xFFFF at leaves/holes) plus the deduped leaf LUT lut[U] f32. Decisions
+// are exact by construction: rank(x) > code  <=>  x >= threshold. xrank
+// must carry >= 2 u16 of trailing padding (the SIMD rank gather reads 4
+// bytes per lane at 2-byte offsets).
+void if_score_standard_q16(const uint16_t* xrank, int64_t n_rows,
+                           int32_t n_features, const uint32_t* packed,
+                           const float* lut, int64_t n_trees, int64_t m_nodes,
+                           int32_t height, float* out) {
+  const bool simd = use_simd();
+  run_row_ranges(n_rows, [=](int64_t r0, int64_t r1) {
+#if IF_X86
+    if (simd) {
+      score_standard_q16_rows_avx512(xrank, r0, r1, n_features, packed, lut,
+                                     n_trees, m_nodes, height, out);
+      return;
+    }
+#endif
+    (void)simd;
+    score_standard_q16_rows_scalar(xrank, r0, r1, n_features, packed, lut,
+                                   n_trees, m_nodes, height, out);
+  });
+}
+
+// Rank binarization for the q16 plane: out[c] = searchsorted(edges, X[c],
+// side='right') — the count of forest threshold edges <= X[c]. This is the
+// q16 path's per-call host cost; numpy's generic searchsorted runs
+// ~80ns/element at bench scale, so the binarization — not the 16-bit walk
+// — dominated the strategy until it moved here (interleaved 16-lane
+// AVX-512 search over an L1-resident edge table, scalar fallback,
+// row-range threaded). Cell-independent and integer-exact, so every
+// ISA/thread combination produces identical ranks.
+void if_binarize_ranks(const float* X, int64_t n_cells, const float* edges,
+                       int64_t n_edges, uint16_t* out) {
+  const bool simd = use_simd();
+  run_row_ranges(n_cells, [=](int64_t c0, int64_t c1) {
+#if IF_X86
+    if (simd) {
+      binarize_cells_avx512(X, c0, c1, edges, n_edges, out);
+      return;
+    }
+#endif
+    (void)simd;
+    binarize_cells_scalar(X, c0, c1, edges, n_edges, out);
   });
 }
 

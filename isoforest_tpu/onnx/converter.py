@@ -195,6 +195,12 @@ class IsolationForestConverter:
         self.num_features = int(metadata["numFeatures"])
         self.num_samples = int(metadata["numSamples"])
         self.threshold = float(metadata.get("outlierScoreThreshold", -1.0))
+        # serving-representation extra (docs/scoring_layout.md §quantized):
+        # surfaced for operators; the export itself always encodes the exact
+        # f32 thresholds — the q16 plane is decision-identical to them by
+        # construction, so portable inference is faithful for either
+        # preference without a quantized ONNX variant
+        self.scoring_representation = metadata.get("scoringRepresentation", "f32")
 
     def convert(self) -> bytes:
         """Build the serialized ModelProto."""
@@ -240,6 +246,9 @@ class ExtendedIsolationForestConverter:
         self.num_features = int(metadata["numFeatures"])
         self.num_samples = int(metadata["numSamples"])
         self.threshold = float(metadata.get("outlierScoreThreshold", -1.0))
+        # same representation carry as the standard converter: recorded, and
+        # the export stays the exact f32 form q16 is decision-identical to
+        self.scoring_representation = metadata.get("scoringRepresentation", "f32")
 
     def _lift(self):
         """Assign lifted columns; returns (W [F, n_cols], per-node column map)."""

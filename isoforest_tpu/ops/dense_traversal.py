@@ -229,6 +229,78 @@ def extended_path_lengths_dense(
     )
 
 
+def standard_path_lengths_dense_q(
+    forest: StandardForest, X: jax.Array, qlayout=None
+) -> jax.Array:
+    """Dense level-walk over the QUANTIZED plane (scoring_layout
+    ``pack_standard_q``): rows binarize once to threshold ranks and the
+    per-node go-right bit becomes the integer compare ``rx[c, feat] >
+    code`` — decision-identical to ``x >= threshold`` — while leaves credit
+    the shared LUT's f32 bits (the f32 plane's own leaf values), so scores
+    are bitwise equal to :func:`standard_path_lengths_dense`. Ranks are
+    <= 65535 < 2^24, exactly representable in f32, so the one-hot HIGHEST
+    contraction stays exact on the wide-F branch."""
+    from .scoring_layout import _Q16_FEATURE_SENTINEL, get_layout_q
+
+    if qlayout is None:
+        qlayout = get_layout_q(forest)
+    h = _height_of(forest.max_nodes)
+    C, F = X.shape
+    packed = jnp.asarray(qlayout.packed)
+    lut = jnp.asarray(qlayout.lut)
+    rx = jnp.searchsorted(jnp.asarray(qlayout.edges), X, side="right").astype(
+        jnp.int32
+    )
+    feat_u = (packed & jnp.uint32(_Q16_FEATURE_SENTINEL)).astype(jnp.int32)
+    feature = jnp.where(feat_u == _Q16_FEATURE_SENTINEL, -1, feat_u)  # [T, M]
+    code = (packed >> jnp.uint32(16)).astype(jnp.int32)  # [T, M]
+    # leaf credit plane: lut[code] at leaves, 0 at internal slots (internal
+    # codes are ranks — mask them out before the take)
+    value = jnp.where(
+        feature >= 0,
+        0.0,
+        jnp.take(lut, jnp.where(feature >= 0, 0, code)),
+    ).astype(jnp.float32)
+
+    def one_tree(feature_t, code_t, value_t):
+        internal = feature_t >= 0
+
+        if F <= _SELECT_MAX_FEATURES:
+
+            def bits(start, width):
+                feat_l = feature_t[start : start + width]
+                code_l = code_t[start : start + width]
+                rxv = jnp.zeros((C, width), jnp.int32)
+                for f in range(F):
+                    rxv = jnp.where(feat_l[None, :] == f, rx[:, f][:, None], rxv)
+                return rxv > code_l[None, :]
+
+        else:
+            foh = jax.nn.one_hot(
+                jnp.maximum(feature_t, 0).astype(jnp.int32), F, dtype=X.dtype
+            )
+            rxv_all = jnp.einsum(
+                "cf,mf->cm",
+                rx.astype(jnp.float32),
+                foh,
+                precision=lax.Precision.HIGHEST,
+            )
+            B_all = rxv_all > code_t[None, :].astype(jnp.float32)
+
+            def bits(start, width):
+                return B_all[:, start : start + width]
+
+        return _level_walk(bits, internal, value_t, C, h)
+
+    return _scan_tree_blocks(
+        one_tree,
+        (feature, code, value),
+        (-1, 0, 0.0),
+        forest.num_trees,
+        C,
+    )
+
+
 def path_lengths_dense(forest, X: jax.Array, layout=None) -> jax.Array:
     if isinstance(forest, StandardForest):
         return standard_path_lengths_dense(forest, X, layout)

@@ -193,6 +193,235 @@ def pack_forest(forest, num_features: Optional[int] = None):
     return pack_extended(forest, num_features)
 
 
+# ---------------------------------------------------------------------------
+# Quantized (q16) scoring plane — rank-space thresholds + shared leaf LUT.
+#
+# The f32 packed plane is 8 B/node (value + bitcast feature). The quantized
+# standard plane stores one u32 per node — ``code << 16 | feature`` — for an
+# exact 2.0x plane shrink, PLUS one shared per-forest edge table and one
+# shared deduplicated leaf LUT:
+#
+#   * ``edges`` — the sorted, deduplicated f32 array of EVERY internal
+#     threshold in the forest. An internal node's 16-bit ``code`` is its
+#     threshold's rank in ``edges``. Rows are binarized once per chunk to
+#     ranks ``rx = searchsorted(edges, x, side='right')`` (= #edges <= x),
+#     and the traversal decision becomes ``rx[c, feat] > code`` — EXACTLY
+#     equivalent to ``x >= threshold`` because searchsorted counts every
+#     edge <= x and the threshold itself sits at rank ``code``. No affine
+#     grid, no rounding, no tie ambiguity: split DECISIONS are preserved
+#     bit-for-bit by construction (docs/scoring_layout.md has the proof).
+#   * ``lut`` — the deduplicated f32 leaf values ``depth + c(numInstances)``
+#     shared across ALL trees; a leaf node's ``code`` is its LUT index.
+#     ``lut[0]`` is forced to 0.0 so holes/padding (code 0) credit exactly
+#     the f32 plane's 0.
+#
+# Leaves/holes carry the 0xFFFF feature sentinel (the quantized twin of the
+# f32 record's -1). Every traversal family credits the SAME f32 leaf bits
+# the f32 plane holds and takes the SAME branch at every node, so scores
+# are bitwise-identical per strategy family (pinned in tests).
+#
+# Unlike the f32 packers these builders are host-side numpy (np.unique /
+# searchsorted are not jittable); the eager score_matrix q16 path caches
+# them per forest via get_layout_q, mirroring get_layout.
+# ---------------------------------------------------------------------------
+
+# u16 code capacity: ranks 0..E fit u16 only when E <= 65535; LUT indices
+# when U <= 65535; feature ids must stay below the 0xFFFF leaf sentinel.
+_Q16_MAX_EDGES = 65535
+_Q16_MAX_LUT = 65535
+_Q16_FEATURE_SENTINEL = 0xFFFF
+_Q16_MAX_FEATURE_ID = _Q16_FEATURE_SENTINEL - 1  # ids 0..65534
+# extended indices narrow to i16 (-1 padding sentinel): ids 0..32767
+_Q16_EXT_MAX_FEATURE_ID = 32767
+
+
+class QuantizedStandardLayout(NamedTuple):
+    """Quantized standard-forest scoring plane (see the section comment).
+
+    Array-only fields on purpose: fleet residency accounting
+    (``fleet.registry.layout_nbytes``) sums ``size * itemsize`` over every
+    field, so the bytes reported are exactly the bytes resident.
+    """
+
+    packed: jax.Array  # u32 [T, M] — code<<16 | feature (0xFFFF leaf/hole)
+    edges: jax.Array  # f32 [E] sorted unique internal thresholds
+    lut: jax.Array  # f32 [U] shared dedup leaf values; lut[0] == 0.0
+
+    @property
+    def num_trees(self) -> int:
+        return self.packed.shape[0]
+
+    @property
+    def max_nodes(self) -> int:
+        return self.packed.shape[1]
+
+
+class QuantizedExtendedLayout(NamedTuple):
+    """Quantized extended-forest plane: hyperplane coordinate indices
+    narrowed i32 -> i16 (halving the index stream); weights and the merged
+    value plane stay exact f32 — the rank trick does not apply to
+    hyperplane dots, so the decision math is the f32 math unchanged and
+    bitwise parity is trivial. Array-only fields (fleet accounting)."""
+
+    indices: jax.Array  # i16 [T, M, k], -1 padding
+    weights: jax.Array  # f32 [T, M, k]
+    value: jax.Array  # f32 [T, M] merged plane (offset | leaf LUT | 0)
+
+    @property
+    def num_trees(self) -> int:
+        return self.value.shape[0]
+
+    @property
+    def max_nodes(self) -> int:
+        return self.value.shape[1]
+
+    @property
+    def k(self) -> int:
+        return self.indices.shape[2]
+
+
+# Forest -> quantization-eligibility verdict, cached by array identity (the
+# unique-threshold count is a host reduction over [T, M]; serving loops must
+# not pay it per call). Bounded FIFO, same policy as _MIN_FEATURES_CACHE.
+_Q_ELIGIBLE_CACHE: dict = {}
+_Q_ELIGIBLE_CACHE_MAX = 16
+
+
+def quantized_unsupported_reason(forest) -> Optional[str]:
+    """None when the forest fits the q16 representation, else a human
+    reason. The fences mirror what the u16 code/feature lanes can hold:
+    distinct internal thresholds <= 65535, distinct leaf values <= 65535,
+    feature ids below the 0xFFFF sentinel (i16's 32767 for extended)."""
+    arrays = tuple(forest)
+    key = tuple(id(a) for a in arrays)
+    hit = _Q_ELIGIBLE_CACHE.get(key)
+    if hit is not None and all(a is b for a, b in zip(hit[0], arrays)):
+        return hit[1]
+    reason = _quantized_unsupported_reason_uncached(forest)
+    if len(_Q_ELIGIBLE_CACHE) >= _Q_ELIGIBLE_CACHE_MAX:
+        _Q_ELIGIBLE_CACHE.pop(next(iter(_Q_ELIGIBLE_CACHE)))
+    _Q_ELIGIBLE_CACHE[key] = (arrays, reason)
+    return reason
+
+
+def _quantized_unsupported_reason_uncached(forest) -> Optional[str]:
+    if isinstance(forest, StandardForest):
+        feat = np.asarray(forest.feature)
+        internal = feat >= 0
+        max_id = int(feat.max()) if feat.size else -1
+        if max_id > _Q16_MAX_FEATURE_ID:
+            return (
+                f"feature id {max_id} exceeds the u16 plane's maximum "
+                f"{_Q16_MAX_FEATURE_ID}"
+            )
+        n_edges = np.unique(np.asarray(forest.threshold)[internal]).size
+        if n_edges > _Q16_MAX_EDGES:
+            return (
+                f"{n_edges} distinct thresholds exceed the u16 rank "
+                f"capacity {_Q16_MAX_EDGES}"
+            )
+    else:
+        idx = np.asarray(forest.indices)
+        max_id = int(idx.max()) if idx.size else -1
+        if max_id > _Q16_EXT_MAX_FEATURE_ID:
+            return (
+                f"hyperplane coordinate {max_id} exceeds the i16 index "
+                f"maximum {_Q16_EXT_MAX_FEATURE_ID}"
+            )
+        return None
+    n_lut = np.unique(
+        np.asarray(
+            leaf_lut(np.asarray(forest.num_instances), forest.max_nodes)
+        )
+    ).size
+    if n_lut > _Q16_MAX_LUT:
+        return (
+            f"{n_lut} distinct leaf values exceed the u16 LUT capacity "
+            f"{_Q16_MAX_LUT}"
+        )
+    return None
+
+
+def quantized_eligible(forest) -> bool:
+    return quantized_unsupported_reason(forest) is None
+
+
+def pack_standard_q(forest: StandardForest) -> QuantizedStandardLayout:
+    """Build the rank-space quantized plane for a standard forest.
+
+    Host-side numpy build (cached via :func:`get_layout_q`); the leaf LUT
+    entries are the f32 plane's own leaf bits (``leaf_lut``), so every
+    strategy credits identical float bits at identical leaves.
+    """
+    feat = np.asarray(forest.feature, np.int64)
+    internal = feat >= 0
+    thr = np.asarray(forest.threshold, np.float32)
+    # leaf/hole values exactly as the f32 plane computes them (jnp leaf_lut
+    # pulled to host), so lut[code] is bit-identical to the f32 value lane
+    leaf_vals = np.asarray(
+        leaf_lut(np.asarray(forest.num_instances), forest.max_nodes)
+    ).astype(np.float32)
+    edges = np.unique(thr[internal]).astype(np.float32)
+    # lut[0] == 0.0 (all leaf values are >= 0, and holes contribute 0.0)
+    lut = np.unique(np.concatenate([[np.float32(0.0)], leaf_vals[~internal]]))
+    lut = lut.astype(np.float32)
+    code = np.zeros(feat.shape, np.uint32)
+    code[internal] = np.searchsorted(edges, thr[internal]).astype(np.uint32)
+    code[~internal] = np.searchsorted(lut, leaf_vals[~internal]).astype(
+        np.uint32
+    )
+    feat_u16 = np.where(internal, feat, _Q16_FEATURE_SENTINEL).astype(np.uint32)
+    packed = (code << np.uint32(16)) | feat_u16
+    return QuantizedStandardLayout(
+        packed=jnp.asarray(packed.astype(np.uint32)),
+        edges=jnp.asarray(edges),
+        lut=jnp.asarray(lut),
+    )
+
+
+def pack_extended_q(forest: ExtendedForest) -> QuantizedExtendedLayout:
+    """Quantized extended plane: i16 hyperplane indices, exact f32 weights
+    and merged value plane (identical bits to :func:`pack_extended`'s)."""
+    f32 = pack_extended(forest)
+    return QuantizedExtendedLayout(
+        indices=jnp.asarray(forest.indices, jnp.int16),
+        weights=jnp.asarray(forest.weights, jnp.float32),
+        value=f32.value,
+    )
+
+
+def pack_forest_q(forest):
+    if isinstance(forest, StandardForest):
+        return pack_standard_q(forest)
+    return pack_extended_q(forest)
+
+
+def layout_nbytes(layout) -> int:
+    """Total bytes of a layout NamedTuple's resident arrays (f32 or
+    quantized) — the one formula fleet residency accounting and bench byte
+    reporting share."""
+    return sum(
+        int(np.asarray(a).size) * int(np.asarray(a).dtype.itemsize)
+        # NamedTuple fields only — properties are derived, not resident
+        for a in tuple(layout)
+    )
+
+
+def quantized_plane_nbytes(layout) -> int:
+    """Bytes of the per-node plane alone (excludes the shared edge/LUT
+    side tables) — the number the >= 1.8x shrink acceptance gate measures,
+    because the side tables are O(distinct values), not O(T*M)."""
+    if isinstance(layout, QuantizedStandardLayout):
+        a = layout.packed
+    elif isinstance(layout, QuantizedExtendedLayout):
+        return layout_nbytes(layout)
+    elif isinstance(layout, PackedStandardLayout):
+        a = layout.packed
+    else:  # PackedExtendedLayout
+        a = layout.packed
+    return int(np.asarray(a).size) * int(np.asarray(a).dtype.itemsize)
+
+
 # Per-forest layout cache for the eager score_matrix path, keyed by the
 # identities of ALL forest arrays (a _replace of any field must miss) plus
 # the feature width (it picks the narrow dtype). Holding strong references
@@ -202,20 +431,37 @@ _LAYOUT_CACHE: dict = {}
 _LAYOUT_CACHE_MAX = 8
 
 
-def get_layout(forest, num_features: Optional[int] = None):
-    """Cached :func:`pack_forest`: serving loops that score many batches
-    against one fitted model build the layout exactly once."""
+def _layout_cached(cache: dict, forest, num_features, build):
     arrays = tuple(forest)
     key = (
         tuple(id(a) for a in arrays),
         tuple(forest[0].shape),
         num_features,
     )
-    hit = _LAYOUT_CACHE.get(key)
+    hit = cache.get(key)
     if hit is not None and all(a is b for a, b in zip(hit[0], arrays)):
         return hit[1]
-    layout = pack_forest(forest, num_features)
-    if len(_LAYOUT_CACHE) >= _LAYOUT_CACHE_MAX:
-        _LAYOUT_CACHE.pop(next(iter(_LAYOUT_CACHE)))
-    _LAYOUT_CACHE[key] = (arrays, layout)
+    layout = build()
+    if len(cache) >= _LAYOUT_CACHE_MAX:
+        cache.pop(next(iter(cache)))
+    cache[key] = (arrays, layout)
     return layout
+
+
+def get_layout(forest, num_features: Optional[int] = None):
+    """Cached :func:`pack_forest`: serving loops that score many batches
+    against one fitted model build the layout exactly once."""
+    return _layout_cached(
+        _LAYOUT_CACHE, forest, num_features, lambda: pack_forest(forest, num_features)
+    )
+
+
+# Separate cache for the quantized plane: a model serving both f32 and q16
+# strategies (e.g. during an autotune probe) must not thrash one cache.
+_LAYOUT_Q_CACHE: dict = {}
+
+
+def get_layout_q(forest):
+    """Cached :func:`pack_forest_q` (quantized plane), mirroring
+    :func:`get_layout`."""
+    return _layout_cached(_LAYOUT_Q_CACHE, forest, None, lambda: pack_forest_q(forest))

@@ -44,10 +44,13 @@ from ..utils.math import avg_path_length, height_of as _height_of, score_from_pa
 from ..utils.validation import validate_feature_vector_size
 from .ext_growth import ExtendedForest
 from .scoring_layout import (
+    _Q16_FEATURE_SENTINEL,
     PackedStandardLayout,
     bitcast_f32_to_i32,
     get_layout,
+    get_layout_q,
     pack_forest,
+    quantized_unsupported_reason,
 )
 from .streaming import PLATFORM_DEFAULT_CHUNK, StreamingExecutor, pipeline_enabled
 from .tree_growth import StandardForest
@@ -220,6 +223,159 @@ def path_lengths(
     return extended_path_lengths(forest, X, layout, expected_features)
 
 
+# -- quantized (q16) walk ---------------------------------------------------
+# The rank-space plane of scoring_layout.pack_standard_q: rows binarize once
+# per chunk to threshold ranks, each step gathers ONE u32 node record (4 B
+# vs the f32 record's 8), and the branch test becomes an integer compare
+# `rx > code` — exactly equivalent to `x >= threshold`, so the walk visits
+# the same nodes and credits the same f32 leaf bits as the f32 plane
+# (bitwise score parity pinned in tests/test_strategies.py).
+
+
+def binarize_ranks(edges: jax.Array, X: jax.Array) -> jax.Array:
+    """``rx[c, f]`` = number of edges <= ``X[c, f]`` (``side='right'``
+    counts the edge itself, which is what makes ``rx > code`` identical to
+    ``x >= threshold``)."""
+    return jnp.searchsorted(jnp.asarray(edges), X, side="right").astype(
+        jnp.int32
+    )
+
+
+def _pad_tree_axis(arr: jax.Array, block: int, fill) -> jax.Array:
+    pad = (-arr.shape[0]) % block
+    if not pad:
+        return arr
+    return jnp.concatenate(
+        [arr, jnp.full((pad,) + arr.shape[1:], fill, arr.dtype)], axis=0
+    )
+
+
+def _walk_one_standard_q(
+    packed: jax.Array, rx: jax.Array, lut: jax.Array, h: int
+) -> jax.Array:
+    """Early-exit rank walk of one quantized tree; ``packed: u32[M]``."""
+    C = rx.shape[0]
+    sentinel = jnp.uint32(_Q16_FEATURE_SENTINEL)
+
+    def cond(carry):
+        i, node, out, done = carry
+        return (i < h + 1) & ~jnp.all(done)
+
+    def body(carry):
+        i, node, out, done = carry
+        rec = jnp.take(packed, node, axis=0)  # [C] u32 — one 4 B gather
+        f = (rec & sentinel).astype(jnp.int32)
+        code = (rec >> jnp.uint32(16)).astype(jnp.int32)
+        leaf = f == _Q16_FEATURE_SENTINEL
+        # internal codes are ranks, not LUT indices — mask before the take
+        out = jnp.where(
+            leaf & ~done, jnp.take(lut, jnp.where(leaf, code, 0)), out
+        )
+        rxv = jnp.take_along_axis(
+            rx, jnp.where(leaf, 0, f)[:, None], axis=1
+        )[:, 0]
+        go_right = (rxv > code).astype(jnp.int32)
+        node = jnp.where(leaf | done, node, 2 * node + 1 + go_right)
+        return i + 1, node, out, done | leaf
+
+    init = (
+        jnp.zeros((), jnp.int32),
+        jnp.zeros((C,), jnp.int32),
+        jnp.zeros((C,), jnp.float32),
+        jnp.zeros((C,), jnp.bool_),
+    )
+    _, _, out, _ = lax.while_loop(cond, body, init)
+    return out
+
+
+def standard_path_lengths_q(
+    forest: StandardForest, X: jax.Array, qlayout=None
+) -> jax.Array:
+    """Quantized-plane mean path lengths; bitwise equal to
+    :func:`standard_path_lengths` (same block schedule, same leaf bits)."""
+    if qlayout is None:
+        qlayout = get_layout_q(forest)
+    h = _height_of(forest.max_nodes)
+    rx = binarize_ranks(qlayout.edges, X)
+    # neutral padding record: leaf sentinel + code 0 -> credits lut[0] == 0
+    padded = _pad_tree_axis(
+        jnp.asarray(qlayout.packed), _TREE_BLOCK, np.uint32(_Q16_FEATURE_SENTINEL)
+    )
+    g = min(_TREE_BLOCK, padded.shape[0])
+    blocks = padded.reshape(padded.shape[0] // g, g, *padded.shape[1:])
+    lut = jnp.asarray(qlayout.lut)
+
+    def block_step(total, blk):
+        pl = jax.vmap(lambda p: _walk_one_standard_q(p, rx, lut, h))(blk)
+        return total + jnp.sum(pl, axis=0), None
+
+    total, _ = lax.scan(
+        block_step, jnp.zeros((X.shape[0],), jnp.float32), blocks
+    )
+    return total / forest.num_trees
+
+
+def extended_path_lengths_q(
+    forest: ExtendedForest, X: jax.Array, qlayout=None
+) -> jax.Array:
+    """Quantized extended walk: i16 hyperplane indices (half the index
+    stream), exact f32 weights/offsets — the decision arithmetic is the f32
+    arithmetic unchanged, so parity with :func:`extended_path_lengths` is
+    bitwise by construction."""
+    if qlayout is None:
+        qlayout = get_layout_q(forest)
+    h = _height_of(forest.max_nodes)
+    C = X.shape[0]
+    idx_p = _pad_tree_axis(jnp.asarray(qlayout.indices), _TREE_BLOCK, np.int16(-1))
+    w_p = _pad_tree_axis(jnp.asarray(qlayout.weights), _TREE_BLOCK, 0.0)
+    v_p = _pad_tree_axis(jnp.asarray(qlayout.value), _TREE_BLOCK, 0.0)
+    g = min(_TREE_BLOCK, idx_p.shape[0])
+    blocks = tuple(
+        a.reshape(a.shape[0] // g, g, *a.shape[1:]) for a in (idx_p, w_p, v_p)
+    )
+
+    def one_tree(idx, w, val):
+        def cond(carry):
+            i, node, out, done = carry
+            return (i < h + 1) & ~jnp.all(done)
+
+        def body(carry):
+            i, node, out, done = carry
+            value = jnp.take(val, node)
+            sub = jnp.take(idx, node, axis=0).astype(jnp.int32)  # [C, k]
+            w_n = jnp.take(w, node, axis=0)
+            leaf = sub[:, 0] < 0
+            out = jnp.where(leaf & ~done, value, out)
+            xv = jnp.take_along_axis(X, jnp.maximum(sub, 0), axis=1)
+            # same reduce as _walk_one_extended — tie routing identical
+            dot = jnp.sum(xv * w_n, axis=1)
+            go_right = (dot >= value).astype(jnp.int32)
+            node = jnp.where(leaf | done, node, 2 * node + 1 + go_right)
+            return i + 1, node, out, done | leaf
+
+        init = (
+            jnp.zeros((), jnp.int32),
+            jnp.zeros((C,), jnp.int32),
+            jnp.zeros((C,), jnp.float32),
+            jnp.zeros((C,), jnp.bool_),
+        )
+        _, _, out, _ = lax.while_loop(cond, body, init)
+        return out
+
+    def block_step(total, blk):
+        pl = jax.vmap(one_tree)(*blk)
+        return total + jnp.sum(pl, axis=0), None
+
+    total, _ = lax.scan(block_step, jnp.zeros((C,), jnp.float32), blocks)
+    return total / forest.num_trees
+
+
+def path_lengths_q(forest, X: jax.Array, qlayout=None) -> jax.Array:
+    if isinstance(forest, StandardForest):
+        return standard_path_lengths_q(forest, X, qlayout)
+    return extended_path_lengths_q(forest, X, qlayout)
+
+
 # Per-backend winners for strategy="auto", both MEASURED. CPU: the
 # hand-scheduled C++ walker beats the XLA gather path ~4x single-core,
 # which itself beats dense ~50x (benchmarks/README.md). TPU (measured
@@ -246,7 +402,7 @@ PLATFORM_DEFAULT_STRATEGY = {
 # real TPU (see the fence in :func:`score_matrix`).
 PALLAS_MAX_ROWS = 1 << 18
 
-STRATEGIES = ("gather", "dense", "pallas", "walk", "native")
+STRATEGIES = ("gather", "dense", "pallas", "walk", "native", "q16")
 
 # Scoring telemetry (docs/observability.md): per-strategy wall-clock of the
 # RESOLVED strategy's execution (post-ladder, so a native→gather fallback
@@ -403,6 +559,50 @@ def _score_chunk_impl(
     return score_from_path_length(pl, num_samples)
 
 
+def _score_chunk_q_impl(
+    forest, qlayout, X, num_samples: int, formulation: str = "gather"
+) -> jax.Array:
+    """Quantized-plane chunk scorer: the gather-style rank walk everywhere,
+    or the dense rank level-walk on TPU (where per-lane gathers serialise —
+    the same dispatch logic as the f32 auto default)."""
+    if formulation == "dense" and isinstance(forest, StandardForest):
+        from .dense_traversal import standard_path_lengths_dense_q
+
+        pl = standard_path_lengths_dense_q(forest, X, qlayout)
+    else:
+        pl = path_lengths_q(forest, X, qlayout)
+    return score_from_path_length(pl, num_samples)
+
+
+_score_chunk_q = jax.jit(
+    _score_chunk_q_impl, static_argnames=("num_samples", "formulation")
+)
+_score_chunk_q_donated = jax.jit(
+    _score_chunk_q_impl,
+    static_argnames=("num_samples", "formulation"),
+    donate_argnums=(2,),
+)
+
+
+def _score_native_q16(forest, X, num_samples: int):
+    """Native q16 walker path (standard forests): host-side rank
+    binarization + the 16-bit-gather C++ kernel. None when the native
+    library (or the q16 symbol) is unavailable."""
+    from .. import native
+
+    if not isinstance(forest, StandardForest):
+        return None
+    h = _height_of(forest.max_nodes)
+    X = np.ascontiguousarray(X, np.float32)
+    pl = native.score_standard_q16(
+        forest.feature, forest.threshold, forest.num_instances, X, h
+    )
+    if pl is None:
+        return None
+    c = float(avg_path_length(num_samples))
+    return np.exp2(-pl / c).astype(np.float32)
+
+
 _score_chunk = jax.jit(
     _score_chunk_impl, static_argnames=("num_samples", "strategy")
 )
@@ -491,6 +691,13 @@ def score_matrix(
         wider than 16 coordinates.
       * ``"native"`` — hand-scheduled C++ walker (:mod:`..native` scorer),
         the CPU fast path; no jax involvement at all.
+      * ``"q16"`` — quantized scoring plane
+        (:func:`~isoforest_tpu.ops.scoring_layout.pack_standard_q`): 4-byte
+        rank-coded node records + shared leaf LUT, decision-identical (and
+        score-bitwise-identical per family) to the f32 plane. On CPU it
+        runs the native 16-bit-gather walker when available, else the jax
+        rank walk; on TPU the dense rank level-walk. Forests past the u16
+        capacity fences take the ``q16_unsupported`` rung onto gather.
       * ``"auto"`` — resolved by the measured autotuner
         (:mod:`~isoforest_tpu.tuning`, docs/autotune.md): an
         ``ISOFOREST_TPU_STRATEGY`` pin always wins; else the persisted
@@ -620,6 +827,67 @@ def score_matrix(
             ),
             strict=strict,
         )
+    if strategy == "q16":
+        q_reason = quantized_unsupported_reason(forest)
+        if q_reason is not None:
+            # capacity fence: the u16 code/feature lanes cannot represent
+            # this forest (docs/scoring_layout.md §quantization); gather is
+            # the always-eligible portable stand-in
+            strategy = degrade(
+                "q16_unsupported",
+                "q16",
+                "gather",
+                detail=(
+                    f"strategy='q16' does not cover this forest ({q_reason}); "
+                    "scoring with the gather strategy instead"
+                ),
+                strict=strict,
+            )
+    if (
+        strategy == "q16"
+        and isinstance(forest, StandardForest)
+        and _live_platform() == "cpu"
+    ):
+        # CPU q16 executor: the native 16-bit-gather walker when the C++
+        # toolchain is present. An absent library is NOT a rung — the jax
+        # rank walk below is the same strategy on the same representation,
+        # just the portable executor for it.
+        faults.check_strategy("q16")
+        timed_out = False
+        t0 = time.perf_counter() if _scoring_metrics_on() else 0.0
+        if timeout_s is None:
+            out = _score_native_q16(forest, X, num_samples)
+        else:
+            from ..resilience import watchdog as _watchdog
+
+            def _native_q16_run():
+                faults.maybe_slow_collective("q16")
+                return _score_native_q16(forest, X, num_samples)
+
+            try:
+                out = _watchdog.run_with_deadline(
+                    _native_q16_run, timeout_s, describe="scoring strategy 'q16'"
+                )
+            except _watchdog.WatchdogTimeout:
+                timed_out = True
+                out = None
+        if out is not None:
+            if _scoring_metrics_on():
+                _SCORING_SECONDS.observe(time.perf_counter() - t0, strategy="q16")
+                _SCORED_ROWS_TOTAL.inc(n, strategy="q16")
+            return out
+        if timed_out:
+            strategy = degrade(
+                "scoring_timeout",
+                "q16",
+                "gather",
+                detail=(
+                    f"scoring strategy 'q16' missed its {timeout_s:g}s "
+                    "watchdog deadline (stalled walker abandoned); retrying "
+                    "the batch once on the portable gather kernel"
+                ),
+                strict=strict,
+            )
     if strategy == "native":
         faults.check_strategy("native")
         timed_out = False
@@ -692,6 +960,18 @@ def score_matrix(
         def run_chunk(chunk, owned=False):
             pl_len = path_lengths_walk(forest, chunk, interpret=interpret)
             return score_from_path_length(pl_len, num_samples)
+
+    elif strategy == "q16":
+        # the q16 path resolves its OWN cached quantized layout — the
+        # caller's `layout=` contract (f32 plane) is untouched, so models
+        # serving mixed strategies keep one f32 layout and one q16 plane
+        qlayout = get_layout_q(forest)
+        formulation = "dense" if _live_platform() == "tpu" else "gather"
+        donate_ok = donation_supported()
+
+        def run_chunk(chunk, owned=False):
+            fn = _score_chunk_q_donated if (owned and donate_ok) else _score_chunk_q
+            return fn(forest, qlayout, chunk, num_samples, formulation)
 
     else:
         if layout is None:

@@ -63,6 +63,12 @@ LADDER: Dict[str, str] = {
         "HIGHEST-precision hyperplane contractions; the fenced kernel would "
         "run bf16-mantissa matmuls (measured up to 0.24 path-length error)"
     ),
+    "q16_unsupported": (
+        "q16 -> gather for forests outside the quantized fences "
+        "(scoring_layout.quantized_unsupported_reason): bit-identical to an "
+        "explicit gather run; an ELIGIBLE q16 run is itself bitwise-equal "
+        "to its f32 traversal family, so this rung only ever changes speed"
+    ),
     "env_strategy_unknown": (
         "unrecognised ISOFOREST_TPU_STRATEGY pin -> per-backend default: "
         "scores are the default strategy's, within cross-strategy f32 "

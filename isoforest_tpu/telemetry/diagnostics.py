@@ -75,12 +75,17 @@ def forest_diagnostics(model) -> dict:
     ``path_length`` (expected ``c(n)`` vs realised weighted mean, per-tree
     min/max, ratio) and ``imbalance`` (depth spread + height utilisation).
     """
-    from ..ops.scoring_layout import PackedStandardLayout
+    from ..ops.scoring_layout import PackedStandardLayout, get_layout
     from ..utils.math import avg_path_length, height_of
 
     if model._scoring_layout is None:
         model.finalize_scoring()
     layout = model._scoring_layout
+    if layout is None:
+        # q16-preference models keep the exact f32 layout lazy (it is not
+        # part of their resident working set); diagnostics read the exact
+        # planes, so resolve them through the shared cache here
+        layout = get_layout(model.forest)
     forest = model.forest
     ni = np.asarray(forest.num_instances)
     num_trees, max_nodes = ni.shape

@@ -169,6 +169,16 @@ def decision_key(
     )
     if restrict is not None:
         key += "|jittable"
+    else:
+        from ..ops.scoring_layout import quantized_eligible
+
+        # quantized-plane facet: forests that can take the q16 strategy key
+        # separately from ones that cannot, so a winner probed WITH q16 in
+        # the pool is never served to a forest whose pool lacks it (and
+        # pre-q16 table entries go stale instead of silently excluding the
+        # new candidate)
+        if quantized_eligible(forest):
+            key += "|q16"
     return key
 
 
@@ -194,15 +204,18 @@ def eligible_strategies(
     walker; ``pallas``/``walk`` need a real TPU (off-TPU they only run in
     interpret mode — minutes per batch, never a serving candidate); the EIF
     Pallas kernels are precision-fenced on TPU; ``walk`` additionally
-    consults :func:`~isoforest_tpu.ops.pallas_walk.unsupported_reason`.
+    consults :func:`~isoforest_tpu.ops.pallas_walk.unsupported_reason`;
+    ``q16`` consults the quantized-plane capacity fence
+    (:func:`~isoforest_tpu.ops.scoring_layout.quantized_eligible` — 16-bit
+    feature ids, <= 65535 distinct thresholds / leaf values).
     """
     from ..ops.tree_growth import StandardForest
 
     extended = not isinstance(forest, StandardForest)
     order = (
-        ("pallas", "dense", "walk", "native", "gather")
+        ("pallas", "dense", "q16", "walk", "native", "gather")
         if platform == "tpu"
-        else ("native", "gather", "dense")
+        else ("native", "q16", "gather", "dense")
     )
     out = []
     for s in order:
@@ -222,6 +235,11 @@ def eligible_strategies(
             from ..ops import pallas_walk
 
             if pallas_walk.unsupported_reason(forest) is not None:
+                continue
+        elif s == "q16":
+            from ..ops.scoring_layout import quantized_eligible
+
+            if not quantized_eligible(forest):
                 continue
         out.append(s)
     return tuple(out)

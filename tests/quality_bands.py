@@ -27,6 +27,8 @@ BANDS = {
     "smtp_eif_max": (0.83, 0.93),
     "pima_std": (0.58, 0.72),
     "pima_eif_max": (0.52, 0.66),
+    # TestSubsampledFit (FastForest-style subsample_trees, arxiv 2004.02423)
+    "mammography_subsample_std": (0.82, 0.88),
     # TestAUPRCGates (published mammography/shuttle AUPRC rows)
     "mammography_auprc_std": (0.19, 0.28),
     "mammography_auprc_eif": (0.16, 0.26),

@@ -60,6 +60,18 @@ def _decision_events():
     return [e for e in telemetry.get_events() if e.kind == "autotune.decision"]
 
 
+def _wide_forest():
+    """One-split forest whose feature id (65535) is past the u16 quantized
+    fence — ineligible for q16, cheap to key/probe."""
+    from isoforest_tpu.ops.tree_growth import StandardForest
+
+    return StandardForest(
+        feature=np.array([[65535, -1, -1]], np.int32),
+        threshold=np.zeros((1, 3), np.float32),
+        num_instances=np.array([[-1, 4, 4]], np.int32),
+    )
+
+
 class TestKeys:
     def test_batch_bucket_edges(self):
         assert batch_bucket(1) == 1024
@@ -88,13 +100,32 @@ class TestKeys:
 
     def test_extended_and_restricted_key_separation(self, models):
         _, std, ext = models
+        # both module forests are quantized-eligible, so their unrestricted
+        # keys carry the |q16 facet after the formulation facet
         k_std = tuning.decision_key("cpu", std.forest, 1024, 5)
         k_ext = tuning.decision_key("cpu", ext.forest, 1024, 5)
-        assert k_std.endswith("|std") and k_ext.endswith("|ext")
+        assert k_std.endswith("|std|q16") and k_ext.endswith("|ext|q16")
         k_jit = tuning.decision_key(
             "cpu", std.forest, 1024, 5, restrict=tuning.JITTABLE_STRATEGIES
         )
-        assert k_jit == k_std + "|jittable"
+        # restricted pools never contain q16, so the jittable key drops the
+        # facet: the two tables must never clobber each other's entries
+        assert k_jit == k_std.removesuffix("|q16") + "|jittable"
+        assert "q16" not in k_jit
+
+    def test_q16_facet_tracks_eligibility(self, models):
+        from isoforest_tpu.ops.scoring_layout import quantized_eligible
+
+        _, std, _ = models
+        assert quantized_eligible(std.forest)
+        assert "|q16" in tuning.decision_key("cpu", std.forest, 1024, 5)
+        # a forest past the u16 feature-id fence keys WITHOUT the facet —
+        # its probe pool lacks q16, so it must not share table entries with
+        # forests whose pool has it
+        wide = _wide_forest()
+        assert not quantized_eligible(wide)
+        k_wide = tuning.decision_key("cpu", wide, 1024, 65536)
+        assert "q16" not in k_wide
 
 
 class TestEligibility:
@@ -113,6 +144,16 @@ class TestEligibility:
         _, std, _ = models
         monkeypatch.setattr(native, "available", lambda: False)
         assert "native" not in tuning.eligible_strategies(std.forest, "cpu")
+
+    def test_q16_pooled_only_when_quantized_eligible(self, models):
+        _, std, ext = models
+        assert "q16" in tuning.eligible_strategies(std.forest, "cpu")
+        assert "q16" in tuning.eligible_strategies(ext.forest, "cpu")
+        assert "q16" not in tuning.eligible_strategies(_wide_forest(), "cpu")
+        # jittable restriction (shard_map) excludes it regardless
+        assert "q16" not in tuning.eligible_strategies(
+            std.forest, "cpu", restrict=tuning.JITTABLE_STRATEGIES
+        )
 
     def test_restrict_narrows_pool(self, models):
         _, std, _ = models
@@ -139,6 +180,32 @@ class TestResolutionAndParity:
                 model.forest, X, model.num_samples, strategy=d1.strategy
             )
             np.testing.assert_array_equal(s_auto, s_win)
+
+    def test_q16_winner_round_trips_with_bitwise_parity(
+        self, models, autotune, monkeypatch
+    ):
+        # force the timed ranking to crown q16, then prove the faceted key
+        # survives a disk round trip and the tuned pick scores bitwise like
+        # the explicit strategy
+        X, std, _ = models
+        monkeypatch.setattr(
+            autotuner,
+            "_probe",
+            lambda forest, Xp, n, eligible, layout=None: {
+                s: (1e-6 if s == "q16" else 1.0) for s in eligible
+            },
+        )
+        d1 = tuning.resolve_decision(std.forest, X, std.num_samples)
+        assert (d1.strategy, d1.source) == ("q16", "probe")
+        assert d1.key.endswith("|q16")
+        doc = json.loads(autotune.read_text())
+        assert doc["entries"][d1.key]["strategy"] == "q16"
+        tuning.reset_cost_model()  # drop in-memory state; reload from disk
+        d2 = tuning.resolve_decision(std.forest, X, std.num_samples)
+        assert (d2.strategy, d2.source, d2.key) == ("q16", "table", d1.key)
+        s_auto = score_matrix(std.forest, X, std.num_samples, strategy="auto")
+        s_q16 = score_matrix(std.forest, X, std.num_samples, strategy="q16")
+        np.testing.assert_array_equal(s_auto, s_q16)
 
     def test_table_persisted_and_valid(self, models, autotune):
         X, std, _ = models

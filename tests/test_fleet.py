@@ -319,6 +319,77 @@ class TestResidencyLRU:
             registry.close()
 
 
+class TestQuantizedResidency:
+    """ISSUE 13 satellite: residency accounting sees the representation a
+    tenant actually serves from — a q16 fleet packs roughly 2x the tenants
+    per byte budget, and the SAME budget that keeps two quantized tenants
+    co-resident evicts under their f32 twins."""
+
+    def test_quantized_tenants_fit_where_f32_twins_evict(
+        self, data, tmp_path
+    ):
+        model = IsolationForest(
+            num_estimators=N_TREES, max_samples=64.0, random_seed=9
+        ).fit(data)
+        f32_paths = [str(tmp_path / f"f32-{i}") for i in range(2)]
+        for p in f32_paths:
+            model.save(p)
+        f32_bytes = layout_nbytes(model)
+        model.set_scoring_representation("q16")
+        q16_bytes = layout_nbytes(model)
+        q16_paths = [str(tmp_path / f"q16-{i}") for i in range(2)]
+        for p in q16_paths:
+            model.save(p)
+        # the accounting itself: the quantized plane + shared tables are
+        # less than half the f32 layout for this forest
+        assert f32_bytes / q16_bytes >= 1.8, (f32_bytes, q16_bytes)
+
+        # one budget, two fleets: fits two q16 tenants, not two f32 twins
+        budget = int(f32_bytes * 1.2)
+        assert 2 * q16_bytes <= budget < 2 * f32_bytes
+
+        reg_q = ModelRegistry(config=_fast_config(), budget_bytes=budget)
+        reg_f = ModelRegistry(config=_fast_config(), budget_bytes=budget)
+        for i in range(2):
+            reg_q.register(
+                f"q{i}", q16_paths[i], work_dir=str(tmp_path / f"wd-q{i}")
+            )
+            reg_f.register(
+                f"f{i}", f32_paths[i], work_dir=str(tmp_path / f"wd-f{i}")
+            )
+        try:
+            want = model.score(data[:64])
+            for i in range(2):
+                np.testing.assert_array_equal(
+                    reg_q.score(f"q{i}", data[:64]), want
+                )
+            # loads restored the persisted representation, and residency
+            # accounts the quantized bytes — so BOTH tenants stay resident
+            for i in range(2):
+                entry = reg_q.entry(f"q{i}")
+                assert entry.resident
+                assert entry.model.scoring_representation == "q16"
+                assert entry.resident_bytes == q16_bytes
+            assert reg_q.state()["resident_bytes"] == 2 * q16_bytes <= budget
+
+            # the f32 twins: same budget, same traffic -> the LRU evicts
+            for i in range(2):
+                np.testing.assert_array_equal(
+                    reg_f.score(f"f{i}", data[:64]), want
+                )
+            assert not reg_f.entry("f0").resident  # LRU victim
+            assert reg_f.entry("f1").resident
+            evicted = [
+                e.fields["model_id"]
+                for e in telemetry.get_events(kind="fleet.evict")
+                if e.fields["cause"] == "budget"
+            ]
+            assert evicted == ["f0"]  # no q-tenant ever paid an eviction
+        finally:
+            reg_q.close()
+            reg_f.close()
+
+
 # --------------------------------------------------------------------------- #
 # fault seams -> rungs
 # --------------------------------------------------------------------------- #

@@ -284,6 +284,58 @@ class TestModelRoundTrip:
             ExtendedIsolationForestModel.load(str(tmp_path / "s"))
 
 
+class TestScoringRepresentationRoundTrip:
+    """ISSUE 13: the ``scoringRepresentation`` tolerated metadata extra —
+    written only for non-default representations, restored on load, and the
+    node table stays the exact f32 Avro form either way (a reader that
+    doesn't know the key loses nothing but the warm-up preference)."""
+
+    def test_q16_round_trips_with_bitwise_scores(self, small_data, tmp_path):
+        model = IsolationForest(
+            num_estimators=8, max_samples=64.0, random_seed=3
+        ).fit(small_data)
+        before = model.score(small_data[:256])
+        model.set_scoring_representation("q16")
+        path = tmp_path / "q"
+        model.save(str(path))
+        meta = json.loads((path / "metadata" / "part-00000").read_text())
+        assert meta["scoringRepresentation"] == "q16"
+        back = IsolationForestModel.load(str(path))
+        assert back.scoring_representation == "q16"
+        # the preference changes residency, never scores: bitwise across
+        # the round trip AND against the pre-switch f32 scores
+        after = back.score(small_data[:256])
+        np.testing.assert_array_equal(after, model.score(small_data[:256]))
+        np.testing.assert_array_equal(after, before)
+
+    def test_default_f32_writes_no_extra(self, std_model, tmp_path):
+        path = tmp_path / "f"
+        std_model.save(str(path))
+        meta = json.loads((path / "metadata" / "part-00000").read_text())
+        assert "scoringRepresentation" not in meta
+        back = IsolationForestModel.load(str(path))
+        assert back.scoring_representation == "f32"
+
+    def test_unknown_persisted_value_falls_back_to_f32(
+        self, small_data, tmp_path
+    ):
+        # a dir written by a future version: the unknown preference is
+        # ignored with a warning, never an import failure
+        model = IsolationForest(num_estimators=4, random_seed=1).fit(small_data)
+        path = tmp_path / "u"
+        model.save(str(path))
+        meta_file = path / "metadata" / "part-00000"
+        meta = json.loads(meta_file.read_text())
+        meta["scoringRepresentation"] = "q4"
+        meta_file.write_text(json.dumps(meta))
+        (path / "_MANIFEST.json").unlink()  # edit invalidates the manifest
+        back = IsolationForestModel.load(str(path))
+        assert back.scoring_representation == "f32"
+        np.testing.assert_array_equal(
+            back.score(small_data[:64]), model.score(small_data[:64])
+        )
+
+
 class TestEstimatorPersistence:
     def test_round_trip(self, tmp_path):
         est = IsolationForest(num_estimators=9, bootstrap=True, contamination=0.1)

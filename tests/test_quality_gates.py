@@ -194,6 +194,54 @@ class TestRemainingFamilyGates:
         assert std - eif > 0.02, f"ordering lost: gap {std - eif:.4f}"
 
 
+class TestSubsampledFit:
+    """FastForest-style fit-time subbagging (arxiv 2004.02423):
+    ``fit(subsample_trees=)`` grows a reduced ensemble whose quality the
+    band pins — the paper's claim is that a subsampled forest keeps its
+    detection quality, so the gate is AUROC-banded, not just shape-checked."""
+
+    def _load(self):
+        from conftest import _load_labeled_csv, resource_csv
+
+        return _load_labeled_csv(resource_csv("mammography.csv"))
+
+    def test_quarter_ensemble_auroc_stays_in_band(self):
+        X, y = self._load()
+        full = IsolationForest(num_estimators=100, random_seed=1).fit(X)
+        sub = IsolationForest(num_estimators=100, random_seed=1).fit(
+            X, subsample_trees=0.25
+        )
+        assert sub.forest.num_trees == 25
+        a_full = _auroc(np.asarray(full.score(X)), y)
+        a_sub = _auroc(np.asarray(sub.score(X)), y)
+        # measured: full 0.856, quarter 0.845 — the subsampled ensemble
+        # must hold the band AND stay close to its full-size twin
+        _band("mammography_subsample_std", a_sub)
+        assert a_full - a_sub < 0.03, f"subsampling cost {a_full - a_sub:.4f} AUROC"
+
+    def test_int_count_equals_fraction_bitwise(self):
+        X, _ = self._load()
+        mi = IsolationForest(num_estimators=100, random_seed=1).fit(
+            X, subsample_trees=25
+        )
+        mf = IsolationForest(num_estimators=100, random_seed=1).fit(
+            X, subsample_trees=0.25
+        )
+        assert mi.forest.num_trees == mf.forest.num_trees == 25
+        np.testing.assert_array_equal(
+            np.asarray(mi.score(X[:512])), np.asarray(mf.score(X[:512]))
+        )
+
+    def test_invalid_values_rejected(self):
+        import pytest
+
+        X = np.zeros((64, 3), np.float32)
+        m = IsolationForest(num_estimators=10, random_seed=1)
+        for bad in (0, -1, 11, 0.0, 1.5, True, "half"):
+            with pytest.raises(ValueError, match="subsample_trees"):
+                m.fit(X, subsample_trees=bad)
+
+
 def _auprc(y, s):
     """Average precision (the reference's AUPRC column, README.md:406-470):
     mean precision at each positive, scores descending, ties broken by

@@ -368,6 +368,66 @@ class TestNativeHidden:
                 )
 
 
+class TestQuantizedUnsupported:
+    """The ``q16_unsupported`` rung: a forest outside the u16 capacity
+    fences (here: a feature id past the 0xFFFF-sentinel payload) requested
+    with ``strategy='q16'`` lands on gather, bit-identical to an explicit
+    gather run (the rung only ever changes speed — an *eligible* q16 run is
+    itself bitwise-equal to its f32 family, tests/test_strategies.py)."""
+
+    @pytest.fixture(scope="class")
+    def wide_forest(self):
+        import jax.numpy as jnp
+
+        # one depth-1 tree splitting on feature 65535 — one past the u16
+        # plane's maximum representable id (65534)
+        feature = np.full((1, 3), -1, np.int32)
+        feature[0, 0] = 65535
+        threshold = np.zeros((1, 3), np.float32)
+        num_instances = np.full((1, 3), -1, np.int32)
+        num_instances[0, 1] = num_instances[0, 2] = 4
+        forest = StandardForest(
+            feature=jnp.asarray(feature),
+            threshold=jnp.asarray(threshold),
+            num_instances=jnp.asarray(num_instances),
+        )
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(8, 65536)).astype(np.float32)
+        return forest, X
+
+    def test_reason_names_the_fence(self, wide_forest):
+        from isoforest_tpu.ops.scoring_layout import (
+            quantized_eligible,
+            quantized_unsupported_reason,
+        )
+
+        forest, _ = wide_forest
+        reason = quantized_unsupported_reason(forest)
+        assert reason is not None and "feature id" in reason
+        assert not quantized_eligible(forest)
+
+    def test_q16_degrades_to_gather_with_parity(self, wide_forest):
+        forest, X = wide_forest
+        reset_degradations("q16_unsupported")
+        base = score_matrix(forest, X, 8, strategy="gather")
+        got = score_matrix(forest, X, 8, strategy="q16")
+        score_matrix(forest, X, 8, strategy="q16")
+        np.testing.assert_array_equal(got, base)
+        assert degradation_report().count("q16_unsupported") == 2
+        [event] = [e for e in degradations() if e.reason == "q16_unsupported"]
+        assert (event.from_, event.to) == ("q16", "gather")
+
+    def test_strict_mode_raises_instead(self, wide_forest):
+        forest, X = wide_forest
+        with pytest.raises(DegradationError, match="q16_unsupported"):
+            score_matrix(forest, X, 8, strategy="q16", strict=True)
+
+    def test_eligible_forest_never_takes_the_rung(self, std_model, data):
+        reset_degradations("q16_unsupported")
+        score_matrix(std_model.forest, data, std_model.num_samples, strategy="q16")
+        assert degradation_report().count("q16_unsupported") == 0
+
+
 class TestForcedStrategyRaise:
     def test_forced_raise_propagates_loudly(self, std_model, data):
         """A kernel failure must surface, not silently hop to another rung."""

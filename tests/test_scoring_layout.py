@@ -198,6 +198,177 @@ class TestPrePackingParity:
         assert loaded._scoring_layout is not None
 
 
+class TestQuantizedPlane:
+    """Rank-space quantized layout (docs/scoring_layout.md §quantized):
+    record decode round-trip, exact decision identity, shared-LUT dedup,
+    the i8/i16 feature-width boundary combined with quantized packing, and
+    the >= 1.8x plane-shrink acceptance gate."""
+
+    def test_record_decode_roundtrip(self):
+        from isoforest_tpu.ops.scoring_layout import (
+            _Q16_FEATURE_SENTINEL,
+            pack_standard_q,
+        )
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(512, 5)).astype(np.float32)
+        m = IsolationForest(num_estimators=4, max_samples=64.0, random_seed=1).fit(X)
+        q = pack_standard_q(m.forest)
+        packed = np.asarray(q.packed)
+        edges = np.asarray(q.edges)
+        lut = np.asarray(q.lut)
+        feat = np.asarray(m.forest.feature)
+        internal = feat >= 0
+        # feature payload: exact ids at internal slots, sentinel elsewhere
+        np.testing.assert_array_equal(
+            (packed & 0xFFFF)[internal], feat[internal].astype(np.uint32)
+        )
+        assert ((packed & 0xFFFF)[~internal] == _Q16_FEATURE_SENTINEL).all()
+        # internal codes are edge ranks: edges[code] decodes the EXACT f32
+        # threshold back (dedup-sorted, so the mapping is invertible)
+        codes = (packed >> 16)[internal]
+        np.testing.assert_array_equal(
+            edges[codes], np.asarray(m.forest.threshold, np.float32)[internal]
+        )
+        # leaf codes are LUT indices holding the f32 plane's exact leaf bits
+        f32 = pack_forest(m.forest, num_features=5)
+        leaf_codes = (packed >> 16)[~internal]
+        np.testing.assert_array_equal(
+            lut[leaf_codes], np.asarray(f32.value)[~internal]
+        )
+        assert lut[0] == 0.0 and (np.diff(lut) > 0).all()
+        assert (np.diff(edges) > 0).all()
+
+    def test_rank_comparison_is_decision_identical(self):
+        # rx > code  <=>  x >= threshold, INCLUDING rows exactly on an edge
+        from isoforest_tpu.ops.scoring_layout import pack_standard_q
+        from isoforest_tpu.ops.traversal import binarize_ranks
+
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(400, 3)).astype(np.float32)
+        m = IsolationForest(num_estimators=6, max_samples=64.0, random_seed=7).fit(X)
+        q = pack_standard_q(m.forest)
+        edges = np.asarray(q.edges)
+        # probe every edge itself, its f32 neighbours, and random points
+        probes = np.unique(
+            np.concatenate(
+                [edges, np.nextafter(edges, -np.inf), np.nextafter(edges, np.inf)]
+            )
+        ).astype(np.float32)
+        rx = np.asarray(binarize_ranks(q.edges, probes[:, None]))[:, 0]
+        for code, threshold in enumerate(edges):
+            np.testing.assert_array_equal(
+                rx > code, probes >= threshold, err_msg=f"edge {code}"
+            )
+
+    def test_lut_dedup_across_tree_heights(self):
+        # two sub-forests grown at DIFFERENT heights share one LUT: the
+        # (depth, n) pairs common to both dedup to single entries
+        from isoforest_tpu.ops.scoring_layout import pack_standard_q, leaf_lut
+
+        rng = np.random.default_rng(8)
+        X = rng.normal(size=(2048, 4)).astype(np.float32)
+        deep = IsolationForest(num_estimators=6, max_samples=256.0, random_seed=1).fit(X)
+        shallow = IsolationForest(num_estimators=6, max_samples=32.0, random_seed=1).fit(X)
+        for m in (deep, shallow):
+            q = pack_standard_q(m.forest)
+            lut = np.asarray(q.lut)
+            ni = np.asarray(m.forest.num_instances)
+            feat = np.asarray(m.forest.feature)
+            leaves = feat < 0
+            vals = np.asarray(
+                leaf_lut(ni, m.forest.max_nodes)
+            ).astype(np.float32)[leaves]
+            # every leaf value is IN the lut, and the lut holds nothing else
+            assert set(np.unique(vals)) <= set(lut.tolist())
+            assert lut.size == np.unique(np.concatenate([[0.0], vals])).size
+            # dedup is real: far fewer LUT entries than leaf slots
+            assert lut.size < leaves.sum()
+        # different heights, same scores contract: bitwise vs gather
+        for m in (deep, shallow):
+            base = score_matrix(m.forest, X[:512], m.num_samples, strategy="gather")
+            got = score_matrix(m.forest, X[:512], m.num_samples, strategy="q16")
+            import isoforest_tpu.native as native
+
+            if native.available():
+                base = score_matrix(
+                    m.forest, X[:512], m.num_samples, strategy="native"
+                )
+            np.testing.assert_array_equal(got, base)
+
+    @pytest.mark.parametrize("F", [127, 128, 129])
+    def test_feature_width_boundary_with_quantized_packing(self, F):
+        # the i8 -> i16 narrowing boundary of the f32 plane combined with
+        # the quantized u16 payload: both planes must gather the same
+        # (highest-id) column and agree with the unpacked reference
+        from isoforest_tpu.ops.scoring_layout import feature_dtype, get_layout_q
+
+        rng = np.random.default_rng(2)
+        forest = _boundary_forest([F - 1, 0], [0.0, 0.5])
+        X = np.zeros((257, F), np.float32)
+        X[:, F - 1] = rng.normal(size=257)
+        X[:, 0] = rng.normal(size=257)
+        want = _reference_scores_standard(forest, X, 64)
+        layout = get_layout(forest, num_features=F)
+        assert layout.feature.dtype == (np.int8 if F <= 128 else np.int16)
+        assert feature_dtype(F) == layout.feature.dtype
+        q = get_layout_q(forest)
+        internal = np.asarray(forest.feature) >= 0
+        assert (np.asarray(q.packed) & 0xFFFF)[internal].max() == F - 1
+        got = score_matrix(forest, X, 64, strategy="q16")
+        np.testing.assert_allclose(got, want, atol=1e-6)
+        base = score_matrix(forest, X, 64, strategy="gather", layout=layout)
+        import isoforest_tpu.native as native
+
+        if native.available():
+            base = score_matrix(forest, X, 64, strategy="native", layout=layout)
+        np.testing.assert_array_equal(got, base)
+
+    def test_plane_shrink_acceptance_gate(self):
+        # ISSUE 13 acceptance: packed-plane bytes shrink >= 1.8x vs f32 for
+        # a 100-tree forest (measured: exactly 2.0x — 4 vs 8 B/node)
+        from isoforest_tpu.ops.scoring_layout import (
+            get_layout,
+            get_layout_q,
+            quantized_plane_nbytes,
+        )
+
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(2048, 4)).astype(np.float32)
+        m = IsolationForest(num_estimators=100, max_samples=128.0, random_seed=1).fit(X)
+        f32_plane = quantized_plane_nbytes(get_layout(m.forest, num_features=4))
+        q_plane = quantized_plane_nbytes(get_layout_q(m.forest))
+        assert f32_plane / q_plane >= 1.8, (f32_plane, q_plane)
+
+    def test_extended_quantized_layout(self):
+        from isoforest_tpu.ops.scoring_layout import pack_extended, pack_extended_q
+
+        rng = np.random.default_rng(9)
+        X = rng.normal(size=(600, 5)).astype(np.float32)
+        ext = ExtendedIsolationForest(
+            num_estimators=5, max_samples=64.0, extension_level=2, random_seed=2
+        ).fit(X)
+        q = pack_extended_q(ext.forest)
+        assert np.asarray(q.indices).dtype == np.int16
+        np.testing.assert_array_equal(
+            np.asarray(q.indices), np.asarray(ext.forest.indices)
+        )
+        # the merged value plane is the f32 plane's exact bits
+        f32 = pack_extended(ext.forest)
+        np.testing.assert_array_equal(np.asarray(q.value), np.asarray(f32.value))
+
+    def test_q_layout_cache_hits_and_invalidates(self):
+        from isoforest_tpu.ops.scoring_layout import get_layout_q
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(256, 3)).astype(np.float32)
+        m = IsolationForest(num_estimators=3, max_samples=32.0, random_seed=1).fit(X)
+        a = get_layout_q(m.forest)
+        assert get_layout_q(m.forest) is a
+        f2 = m.forest._replace(threshold=np.asarray(m.forest.threshold).copy())
+        assert get_layout_q(f2) is not a
+
+
 class TestEarlyExit:
     def test_shallow_forest_scores_match(self):
         # all-leaf-at-root forests exercise the while_loop's first-trip

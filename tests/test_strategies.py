@@ -23,7 +23,7 @@ def models():
     return X, std, ext
 
 
-@pytest.mark.parametrize("strategy", ["dense", "pallas", "walk", "native"])
+@pytest.mark.parametrize("strategy", ["dense", "pallas", "walk", "native", "q16"])
 class TestStrategyEquivalence:
     def test_standard(self, models, strategy):
         X, std, _ = models
@@ -231,9 +231,98 @@ class TestAutoStrategy:
         X = np.full((1100, 3), 2.0, np.float32)
         ext = ExtendedIsolationForest(num_estimators=4, max_samples=32.0).fit(X)
         base = score_matrix(ext.forest, X, ext.num_samples, strategy="gather")
-        for strategy in ["dense", "pallas", "walk", "native"]:
+        for strategy in ["dense", "pallas", "walk", "native", "q16"]:
             got = score_matrix(ext.forest, X, ext.num_samples, strategy=strategy)
             np.testing.assert_allclose(got, base, atol=3e-6)
+
+
+class TestQuantizedBitwiseParity:
+    """The q16 rank plane is *decision-identical* to f32 by construction
+    (docs/scoring_layout.md §quantized): within a traversal family the
+    scores are BITWISE equal — `assert_array_equal`, not a tolerance. The
+    families: native-q16 vs native-f32 (same f64 tile fold), jax-q16 vs
+    gather (same tree-block scan + mean), dense-q16 vs dense-f32 (same
+    level walk)."""
+
+    def test_native_q16_matches_native_f32_bitwise(self, models):
+        import isoforest_tpu.native as native
+
+        if not native.available():
+            pytest.skip("native scorer unavailable")
+        X, std, _ = models
+        base = score_matrix(std.forest, X, std.num_samples, strategy="native")
+        got = score_matrix(std.forest, X, std.num_samples, strategy="q16")
+        np.testing.assert_array_equal(got, base)
+
+    def test_jax_q16_matches_gather_bitwise(self, models, monkeypatch):
+        # force the portable jax rank walk (the no-toolchain executor)
+        import isoforest_tpu.ops.traversal as tv
+
+        X, std, _ = models
+        monkeypatch.setattr(tv, "_score_native_q16", lambda *a, **k: None)
+        base = score_matrix(std.forest, X, std.num_samples, strategy="gather")
+        got = score_matrix(std.forest, X, std.num_samples, strategy="q16")
+        np.testing.assert_array_equal(got, base)
+
+    def test_extended_q16_matches_gather_bitwise(self, models):
+        # extended q16 keeps the f32 hyperplane math (ranks don't commute
+        # with dots), so parity with gather is bitwise, not toleranced
+        X, _, ext = models
+        base = score_matrix(ext.forest, X, ext.num_samples, strategy="gather")
+        got = score_matrix(ext.forest, X, ext.num_samples, strategy="q16")
+        np.testing.assert_array_equal(got, base)
+
+    def test_dense_q16_matches_dense_f32_bitwise(self, models):
+        from isoforest_tpu.ops.dense_traversal import (
+            standard_path_lengths_dense,
+            standard_path_lengths_dense_q,
+        )
+
+        X, std, _ = models
+        base = standard_path_lengths_dense(std.forest, X[:2048])
+        got = standard_path_lengths_dense_q(std.forest, X[:2048])
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(base))
+
+    def test_native_q16_tiled_path_bitwise(self):
+        # >768 KB of u32 records exercises the q16 walker's multi-tile f64
+        # accumulator path, which must fold in the same grouping as f32
+        import isoforest_tpu.native as native
+
+        if not native.available():
+            pytest.skip("native scorer unavailable")
+        rng = np.random.default_rng(11)
+        X = rng.normal(size=(2000, 5)).astype(np.float32)
+        model = IsolationForest(num_estimators=200, max_samples=128.0).fit(X)
+        got = score_matrix(model.forest, X, model.num_samples, strategy="q16")
+        base = score_matrix(model.forest, X, model.num_samples, strategy="native")
+        np.testing.assert_array_equal(got, base)
+
+    def test_exact_tie_rows_route_identically(self, monkeypatch):
+        # rows exactly ON a split threshold are the q16 safeguard's whole
+        # point: right-searchsorted gives the tie rank code+1, routing right
+        # exactly like the f32 `x >= threshold` branch. Score training rows
+        # (every threshold is a midpoint of training values, so grid data
+        # lands on thresholds constantly) and require bitwise agreement in
+        # BOTH executors.
+        import isoforest_tpu.ops.traversal as tv
+
+        rng = np.random.default_rng(9)
+        X = rng.integers(0, 3, size=(3000, 4)).astype(np.float32)
+        m = IsolationForest(num_estimators=16, max_samples=128.0, random_seed=2).fit(X)
+        thr = np.asarray(m.forest.threshold)[np.asarray(m.forest.feature) >= 0]
+        Xt = np.tile(thr[:64], (4, 1)).T.astype(np.float32)[:, : X.shape[1]]
+        for data in (X[:512], Xt):
+            base = score_matrix(m.forest, data, m.num_samples, strategy="gather")
+            with monkeypatch.context() as mp:
+                mp.setattr(tv, "_score_native_q16", lambda *a, **k: None)
+                got_jax = score_matrix(m.forest, data, m.num_samples, strategy="q16")
+            np.testing.assert_array_equal(got_jax, base)
+            import isoforest_tpu.native as native
+
+            if native.available():
+                base_n = score_matrix(m.forest, data, m.num_samples, strategy="native")
+                got_n = score_matrix(m.forest, data, m.num_samples, strategy="q16")
+                np.testing.assert_array_equal(got_n, base_n)
 
 
 class TestQuantizedTieRouting:

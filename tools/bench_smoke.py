@@ -33,6 +33,13 @@ sharded scoring path must stay >= 0.95x the single-shot upload on the
 ``tools/pipeline_smoke.py`` in a subprocess so its 8-device XLA flag never
 perturbs this process's single-device timing gates.
 
+Sixth gate (docs/scoring_layout.md §quantized): at the CPU 1M-row regime
+the quantized ``q16`` strategy must produce scores BITWISE-identical to
+the native f32 walker (``np.array_equal``, not a tolerance — the rank
+plane is decision-identical by construction, so any deviation is a bug)
+AND reach >= :data:`QUANTIZED_MIN_RATIO` (0.95x) of its rows/s. Skipped
+with nulls where there is no native walker to compare against.
+
 Timing asserts in shared CI runners are noisy, so both gates are best-of-N
 against a margin, not an exact comparison; the JSON line it prints records
 every timing for trend tracking.
@@ -75,6 +82,13 @@ MONITOR_MARGIN = 1.03
 AUTOTUNE_REPS = 5
 AUTOTUNE_MIN_RATIO = 0.95
 AUTOTUNE_REGIME_ROWS = 1 << 20
+
+# quantized gate: at the 1M-row regime the q16 plane must be bitwise-equal
+# to the native f32 walker and not cost more than 5% throughput (it should
+# WIN on memory-bound shapes — 4 B/node records halve the cache footprint —
+# but shared-runner noise makes ">= 1.0x" an unshippable assert)
+QUANTIZED_REPS = 3
+QUANTIZED_MIN_RATIO = 0.95
 
 
 def _unpacked_baseline():
@@ -235,8 +249,13 @@ def main() -> int:
             regime_pick = tuning.resolve_decision(
                 forest, X_1m, model.num_samples
             ).strategy
-            regime_expected = "native" if native.available() else "gather"
-            ok_regime = regime_pick == regime_expected
+            # native and its q16 twin are the same measured-r05 walker
+            # family; the probe picks between them on live timings, and the
+            # quantized gate below pins their relative speed explicitly
+            regime_expected = (
+                ("native", "q16") if native.available() else ("gather", "q16")
+            )
+            ok_regime = regime_pick in regime_expected
     finally:
         os.environ.pop("ISOFOREST_TPU_AUTOTUNE", None)
         os.environ.pop("ISOFOREST_TPU_AUTOTUNE_PATH", None)
@@ -275,6 +294,32 @@ def main() -> int:
     except Exception as exc:  # noqa: BLE001 — a dead gate must fail loudly
         print(f"pipeline smoke failed to run: {exc}", file=sys.stderr)
 
+    # quantized gate (docs/scoring_layout.md §quantized): the q16 strategy
+    # vs the native f32 walker at the 1M-row regime — bitwise-equal scores
+    # (decision identity is exact by construction, so equality is the
+    # assert, not a tolerance) and >= QUANTIZED_MIN_RATIO of its rows/s
+    q16_bitwise = None
+    q16_s = None
+    native_1m_s = None
+    q16_ratio = None
+    ok_quantized = True
+    if jax.devices()[0].platform == "cpu" and native.available():
+        X_1m = np.resize(X, (AUTOTUNE_REGIME_ROWS, FEATURES))
+
+        def run_native_1m():
+            return score_matrix(forest, X_1m, model.num_samples, strategy="native")
+
+        def run_q16_1m():
+            return score_matrix(forest, X_1m, model.num_samples, strategy="q16")
+
+        native_scores_1m = np.asarray(run_native_1m())  # warm + reference
+        q16_scores_1m = np.asarray(run_q16_1m())  # warm + candidate
+        q16_bitwise = bool(np.array_equal(native_scores_1m, q16_scores_1m))
+        native_1m_s = best_of(run_native_1m, QUANTIZED_REPS)
+        q16_s = best_of(run_q16_1m, QUANTIZED_REPS)
+        q16_ratio = native_1m_s / q16_s  # >= QUANTIZED_MIN_RATIO to pass
+        ok_quantized = q16_bitwise and q16_s * QUANTIZED_MIN_RATIO <= native_1m_s
+
     # correctness guard alongside the timing gate: packed scores must match
     # the unpacked baseline's scores to float32 tolerance
     from isoforest_tpu.utils.math import avg_path_length
@@ -291,6 +336,7 @@ def main() -> int:
         and ok_autotune_speed
         and ok_regime
         and ok_pipeline
+        and ok_quantized
     )
     print(
         json.dumps(
@@ -319,7 +365,16 @@ def main() -> int:
                 "autotune_source": auto_decision.source,
                 "autotune_static_pick": static_pick,
                 "autotune_regime_pick": regime_pick,
-                "autotune_regime_expected": regime_expected,
+                "autotune_regime_expected": list(regime_expected)
+                if regime_expected
+                else None,
+                "q16_bitwise_equal": q16_bitwise,
+                "q16_s": round(q16_s, 4) if q16_s is not None else None,
+                "native_1m_s": round(native_1m_s, 4)
+                if native_1m_s is not None
+                else None,
+                "q16_ratio": round(q16_ratio, 3) if q16_ratio is not None else None,
+                "q16_min_ratio": QUANTIZED_MIN_RATIO,
                 "pipeline_smoke": pipeline_json,
                 "backend": jax.devices()[0].platform,
                 "pass": ok,
@@ -336,6 +391,9 @@ def main() -> int:
             f"autotuned auto {t_auto:.4f}s vs static {t_static:.4f}s "
             f"(min ratio {AUTOTUNE_MIN_RATIO}), 1M-regime pick "
             f"{regime_pick!r} (expected {regime_expected!r}), "
+            f"quantized gate {'ok' if ok_quantized else 'FAILED'} "
+            f"(bitwise {q16_bitwise}, q16 {q16_s}s vs native {native_1m_s}s, "
+            f"min ratio {QUANTIZED_MIN_RATIO}), "
             f"pipeline gate {'ok' if ok_pipeline else 'FAILED'} "
             f"({pipeline_json})",
             file=sys.stderr,

@@ -261,7 +261,7 @@ def main() -> None:
         # burn chip time re-ranking what section 1 already measured
         prev_env = os.environ.get("ISOFOREST_TPU_STRATEGY")
         try:
-            total_s, bfit_s, score_s, scores, strategy, _ = bench.bench_ours(
+            total_s, bfit_s, score_s, scores, strategy, _, _ = bench.bench_ours(
                 Xh, strategy=winner_strat
             )
         finally:
