@@ -505,6 +505,11 @@ def _roofline(strategy: str, n: int, f: int, elapsed_s: float, platform: str) ->
 def main() -> None:
     backend = _ensure_live_backend()
     platform = backend if backend != "cpu_fallback" else "cpu"
+    # keep every trace for the run: the headline scoring trace is written
+    # next to the JSON line as trace_<dataset>.json (docs/observability.md §9)
+    from isoforest_tpu import telemetry as _telemetry
+
+    _telemetry.set_trace_policy(slow_threshold_s=0.0, sample_every=1)
     X, y = make_data()
     (
         ours_s,
@@ -557,6 +562,33 @@ def main() -> None:
 
     pipe = pipeline_stats("score_matrix")
 
+    # end-to-end request trace for the timed scoring pass, Perfetto-loadable
+    # (docs/observability.md §9); drop trace_kddcup_http_hard.json onto
+    # ui.perfetto.dev to see the per-chunk pipeline breakdown
+    dataset = "kddcup_http_hard"
+    trace_path = f"trace_{dataset}.json"
+    trace_stats = telemetry.trace_stats()
+    trace_spans = 0
+    score_trace = next(
+        (
+            t
+            for t in telemetry.recent_traces(limit=50)
+            if t["root"] == "model.score"
+        ),
+        None,
+    )
+    if score_trace is not None:
+        doc = telemetry.get_trace(score_trace["trace_id"])
+        trace_spans = len(doc["spans"]) if doc else 0
+        with open(trace_path, "w") as fh:
+            fh.write(telemetry.to_chrome_trace_json(doc, indent=1))
+            fh.write("\n")
+        print(
+            f"[bench] trace: {trace_spans} span(s) -> {trace_path} "
+            f"(trace_id {score_trace['trace_id']})",
+            file=sys.stderr,
+        )
+
     print(
         json.dumps(
             {
@@ -592,6 +624,12 @@ def main() -> None:
                 "degradations": [e.as_dict() for e in degradations()],
                 "telemetry_spans": telemetry_spans,
                 "telemetry_events": len(telemetry.get_events()),
+                "trace_spans": trace_spans,
+                "trace_dropped": (
+                    trace_stats["ring_dropped"]
+                    + trace_stats["open_dropped"]
+                    + trace_stats["span_dropped"]
+                ),
                 # the consulted cost-model table + per-source decision
                 # counts (docs/autotune.md), so a benchmark's strategy is
                 # never ambiguous about WHICH mechanism picked it (this

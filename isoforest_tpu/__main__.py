@@ -11,6 +11,8 @@ operational surface here is a small CLI over CSV files:
     python -m isoforest_tpu inspect --model /tmp/model [--tree 0]
     python -m isoforest_tpu telemetry [--format json|prometheus] \\
         [--input data.csv [--model /tmp/model]]
+    python -m isoforest_tpu trace out.json \\
+        [--input data.csv [--model /tmp/model]]
     python -m isoforest_tpu diagnose /tmp/model [--format json|prometheus]
     python -m isoforest_tpu monitor /tmp/model --input live.csv \\
         [--threshold 0.25] [--port 9101] [--format json|prometheus]
@@ -213,6 +215,64 @@ def cmd_telemetry(args) -> int:
         print(telemetry.to_prometheus(), end="")
     else:
         print(telemetry.snapshot_json(indent=1))
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Run an instrumented workload and write its scoring trace as
+    Chrome trace-event JSON — drop the output file onto
+    https://ui.perfetto.dev to see the causal path (root span, strategy
+    attribution, per-chunk pipeline timings; docs/observability.md §9).
+
+    Workload selection matches ``telemetry``: ``--input`` CSV (scored
+    with ``--model`` when given, else fit+scored), or a small synthetic
+    mixture. Capture policy is forced to keep-everything for the run so
+    the trace is always present regardless of latency.
+    """
+    from . import telemetry
+
+    telemetry.set_trace_policy(slow_threshold_s=0.0, sample_every=1)
+    if args.input:
+        X, _ = _load(args.input, args.labeled)
+        if args.model:
+            model = _load_model(args.model)
+        else:
+            from .models import IsolationForest
+
+            model = IsolationForest(
+                num_estimators=args.trees, random_seed=1
+            ).fit(X)
+    else:
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(args.rows, 4)).astype(np.float32)
+        X[: max(1, args.rows // 100)] += 4.0
+        from .models import IsolationForest
+
+        model = IsolationForest(num_estimators=args.trees, random_seed=1).fit(X)
+    model.score(X)
+    recent = telemetry.recent_traces(limit=50)
+    if not recent:
+        print(json.dumps({"error": "no traces captured"}))
+        return 1
+    # prefer the scoring trace; fall back to the newest one
+    chosen = next(
+        (t for t in recent if t["root"] == "model.score"), recent[0]
+    )
+    trace = telemetry.get_trace(chosen["trace_id"])
+    with open(args.output, "w") as fh:
+        fh.write(telemetry.to_chrome_trace_json(trace, indent=1))
+        fh.write("\n")
+    print(
+        json.dumps(
+            {
+                "trace_id": chosen["trace_id"],
+                "root": chosen["root"],
+                "spans": chosen["spans"],
+                "wall_s": chosen["wall_s"],
+                "output": args.output,
+            }
+        )
+    )
     return 0
 
 
@@ -549,6 +609,18 @@ def build_parser() -> argparse.ArgumentParser:
     tele.add_argument("--rows", type=int, default=4096, help="synthetic workload rows")
     tele.add_argument("--trees", type=int, default=50)
     tele.set_defaults(func=cmd_telemetry)
+
+    trc = sub.add_parser(
+        "trace",
+        help="run an instrumented workload and write a Perfetto-loadable trace",
+    )
+    trc.add_argument("output", help="Chrome trace-event JSON output path")
+    trc.add_argument("--input", default=None, help="CSV workload (default: synthetic)")
+    trc.add_argument("--model", default=None, help="score with a saved model")
+    trc.add_argument("--labeled", action="store_true")
+    trc.add_argument("--rows", type=int, default=4096, help="synthetic workload rows")
+    trc.add_argument("--trees", type=int, default=50)
+    trc.set_defaults(func=cmd_trace)
 
     diag = sub.add_parser(
         "diagnose", help="forest-structure diagnostics for a saved model"

@@ -587,6 +587,8 @@ class ModelRegistry:
                 "generation": manager.generation if manager is not None else None,
                 "flush_rows": pending.flush_rows,
                 "flush_requests": pending.flush_requests,
+                "queue_wait_s": pending.queue_wait_s,
+                "flush_ctx": pending.flush_ctx,
             }
             return scores, info
         raise ModelLoadError(

@@ -33,17 +33,20 @@ import time
 from typing import Optional, Tuple
 
 from ..serving.http import (
+    TRACE_HEADER,
     _BadRequest,
     _error_body,
     _finish as _serving_finish,
     _parse_csv,
     _parse_json,
+    inbound_trace_id,
 )
 from ..serving.coalescer import ServingError
 from ..serving.service import ServingConfig
 from ..telemetry.events import record_event
 from ..telemetry.metrics import counter as _counter
 from ..telemetry.metrics import exponential_buckets, histogram as _histogram
+from ..telemetry.spans import TraceContext, span, with_context
 from ..utils.logging import logger
 from .registry import ModelRegistry, UnknownModelError
 
@@ -93,13 +96,31 @@ class FleetService:
         _FLEET_RESPONSES.inc(model_id=model_id, code=status)
         return out
 
-    def handle_score(
-        self, model_id: str, body: bytes, headers, query: str = ""
-    ) -> Tuple[int, str, str]:
+    def handle_score(self, model_id: str, body: bytes, headers, query: str = ""):
         """One ``/score/<model_id>`` request -> ``(status, content_type,
-        body)``. Pure function of the payload + registry, so the status
-        mapping is unit-testable without a socket (the single-model
-        ``handle_score`` contract, per tenant)."""
+        body, headers)``. Pure function of the payload + registry, so the
+        status mapping is unit-testable without a socket (the single-model
+        ``handle_score`` contract, per tenant). The root span carries the
+        tenant's ``model_id`` and the response echoes the effective
+        ``X-Isoforest-Trace`` id (docs/observability.md §9)."""
+        inbound = inbound_trace_id(headers)
+        ctx = TraceContext(inbound) if inbound else None
+        with with_context(ctx):
+            with span(
+                "serving.request", path=SCORE_PREFIX + model_id,
+                model_id=model_id,
+            ) as sp:
+                status, content_type, payload = self._respond(
+                    model_id, body, headers, query, sp
+                )
+                sp.set_attrs(status=status)
+                trace_id = sp.trace_id or inbound
+        resp_headers = {TRACE_HEADER: trace_id} if trace_id else {}
+        return status, content_type, payload, resp_headers
+
+    def _respond(
+        self, model_id: str, body: bytes, headers, query: str, sp
+    ) -> Tuple[int, str, str]:
         t0 = time.perf_counter()
         try:
             try:
@@ -136,6 +157,13 @@ class FleetService:
                 )
             except Exception as exc:  # scoring failure: typed 500, never a hang
                 return self._finish(model_id, t0, 500, _error_body(500, repr(exc)))
+            flush_ctx = info.get("flush_ctx")
+            sp.set_attrs(
+                rows=int(rows.shape[0]),
+                queue_wait_s=round(float(info.get("queue_wait_s") or 0.0), 6),
+                flush_trace_id=flush_ctx.trace_id if flush_ctx else None,
+                flush_span_id=flush_ctx.span_id if flush_ctx else None,
+            )
             if csv:
                 out = "outlierScore\n" + "".join(
                     f"{float(s)!r}\n" for s in scores

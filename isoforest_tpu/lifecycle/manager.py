@@ -65,6 +65,7 @@ from ..resilience import faults
 from ..resilience.retry import RetryError, RetryPolicy, retry_call
 from ..telemetry.events import record_event
 from ..telemetry.metrics import counter as _counter, gauge as _gauge
+from ..telemetry.spans import span as _span
 from ..utils.logging import logger
 from .validation import ValidationGates, ValidationResult, validate_candidate
 from .window import DataReservoir
@@ -338,6 +339,7 @@ class ModelManager:
         strict: bool = False,
         chunk_size: Optional[int] = None,
         pipeline: Optional[bool] = None,
+        return_generation: bool = False,
     ) -> np.ndarray:
         """Score a served batch through the active model (folding the drift
         monitor), remember the rows in the retrain reservoir (labels too,
@@ -347,17 +349,35 @@ class ModelManager:
         coalesced-flush tail latency via the scoring watchdog + degradation
         ladder (docs/resilience.md §6) and ``chunk_size``/``pipeline`` to
         stream oversized flushes through the micro-batch executor
-        (docs/pipeline.md)."""
-        model = self.model
-        scores = model.score(
-            X,
-            timeout_s=timeout_s,
-            strict=strict,
-            chunk_size=chunk_size,
-            pipeline=pipeline,
-        )
+        (docs/pipeline.md). ``return_generation=True`` returns
+        ``(scores, generation)`` where ``generation`` is the one pinned in
+        the same lock hold as the model reference that scored — the only
+        read that cannot race a concurrent hot-swap (a separate
+        ``manager.generation`` read can observe the pre-swap number for a
+        post-swap score, or vice versa)."""
+        with self._lock:
+            # one lock hold pins model AND its generation together, so the
+            # lifecycle.score span's generation attr names exactly the
+            # model reference this call scores on — even mid-swap
+            model = self._model
+            generation = self.generation
+        with _span(
+            "lifecycle.score",
+            rows=int(np.asarray(X).shape[0]),
+            generation=generation,
+            **self._tenant_fields(),
+        ):
+            scores = model.score(
+                X,
+                timeout_s=timeout_s,
+                strict=strict,
+                chunk_size=chunk_size,
+                pipeline=pipeline,
+            )
         self.reservoir.fold(X, y)
         self._maybe_trigger()
+        if return_generation:
+            return scores, generation
         return scores
 
     def _maybe_trigger(self) -> None:

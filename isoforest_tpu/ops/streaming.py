@@ -71,6 +71,7 @@ from ..telemetry.events import record_event
 from ..telemetry.metrics import counter as _telemetry_counter
 from ..telemetry.metrics import gauge as _telemetry_gauge
 from ..telemetry.metrics import histogram as _telemetry_histogram
+from ..telemetry.spans import span as _span
 
 # Measured on a live v5e (2026-07-29, 524k rows x 100 trees, dense): bigger
 # chunks win monotonically — 0.81 s at 2^17, 0.64 s at 2^18, 0.53 s at 2^19
@@ -318,23 +319,42 @@ class StreamingExecutor:
         for start in range(0, n, chunk):
             stop = min(start + chunk, n)
             valid = stop - start
-            t0 = self._clock()
-            if stager is not None:
-                buf = stager.pack(np.asarray(X[start:stop], np.float32))
-                dev = (
-                    jax.device_put(buf, self._sharding)
-                    if self._sharding is not None
-                    else jax.device_put(buf)
+            # per-chunk trace span (docs/observability.md §9): the phase
+            # timings are THIS chunk's blocking H2D stage + compute
+            # dispatch, and the lag-one D2H fetch of the PREVIOUS chunk's
+            # scores (the overlap the pipeline exists to create)
+            with _span(
+                "pipeline.chunk",
+                site=self._site,
+                index=n_chunks,
+                rows=valid,
+            ) as csp:
+                t0 = self._clock()
+                if stager is not None:
+                    buf = stager.pack(np.asarray(X[start:stop], np.float32))
+                    dev = (
+                        jax.device_put(buf, self._sharding)
+                        if self._sharding is not None
+                        else jax.device_put(buf)
+                    )
+                else:
+                    dev = jnp.asarray(X[start:stop], jnp.float32)
+                    if valid < chunk:
+                        dev = jnp.pad(dev, ((0, chunk - valid), (0, 0)))
+                chunk_h2d = self._clock() - t0
+                h2d_s += chunk_h2d
+                t1 = self._clock()
+                scores = self._run_chunk(dev, True)
+                dispatch_s = self._clock() - t1
+                t2 = self._clock()
+                if pending is not None:
+                    parts.append(np.asarray(pending))
+                csp.set_attrs(
+                    h2d_s=round(chunk_h2d, 6),
+                    compute_dispatch_s=round(dispatch_s, 6),
+                    d2h_s=round(self._clock() - t2, 6),
                 )
-            else:
-                dev = jnp.asarray(X[start:stop], jnp.float32)
-                if valid < chunk:
-                    dev = jnp.pad(dev, ((0, chunk - valid), (0, 0)))
-            h2d_s += self._clock() - t0
-            scores = self._run_chunk(dev, True)
-            if pending is not None:
-                parts.append(np.asarray(pending))
-            pending = scores[:valid] if valid < chunk else scores
+                pending = scores[:valid] if valid < chunk else scores
             n_chunks += 1
         parts.append(np.asarray(pending))
         total_s = max(self._clock() - t_start, 1e-9)

@@ -40,6 +40,8 @@ from ..resilience.degradation import degrade
 from ..telemetry import _state as _telemetry_state
 from ..telemetry.metrics import counter as _telemetry_counter
 from ..telemetry.metrics import histogram as _telemetry_histogram
+from ..telemetry.spans import set_span_attrs as _set_span_attrs
+from ..telemetry.spans import span as _span
 from ..utils.math import avg_path_length, height_of as _height_of, score_from_path_length
 from ..utils.validation import validate_feature_vector_size
 from .ext_growth import ExtendedForest
@@ -651,7 +653,7 @@ def _default_chunk_size() -> int:
     return PLATFORM_DEFAULT_CHUNK.get(_live_platform(), 1 << 18)
 
 
-def score_matrix(
+def _score_matrix_impl(
     forest,
     X,
     num_samples: int,
@@ -750,14 +752,23 @@ def score_matrix(
     if strategy == "auto":
         from ..tuning import resolve_decision
 
-        strategy = resolve_decision(
+        decision = resolve_decision(
             forest,
             X,
             num_samples,
             platform=_live_platform(),
             strict=strict,
             layout=layout,
-        ).strategy
+        )
+        strategy = decision.strategy
+        # the enclosing score_matrix span answers "which kernel ran and
+        # WHY": the resolved winner plus where the decision came from
+        # (table/probe/pin/fallback — docs/autotune.md)
+        _set_span_attrs(
+            strategy=strategy, strategy_source=decision.source, rows=n
+        )
+    else:
+        _set_span_attrs(strategy=strategy, strategy_source="explicit", rows=n)
     if strategy not in STRATEGIES:
         raise ValueError(
             f"unknown scoring strategy {strategy!r}; expected one of "
@@ -942,6 +953,9 @@ def score_matrix(
                 ),
                 strict=strict,
             )
+    # degradation rungs above may have moved the strategy; the span attr
+    # must name the kernel that actually executes
+    _set_span_attrs(strategy=strategy)
     faults.check_strategy(strategy)
     if strategy == "pallas":
         from .pallas_traversal import path_lengths_pallas
@@ -1059,3 +1073,40 @@ def score_matrix(
             pad_to_bucket=pad_to_bucket,
             pipeline=pipeline,
         )
+
+
+def score_matrix(
+    forest,
+    X,
+    num_samples: int,
+    chunk_size: int | None = None,
+    strategy: str = "auto",
+    layout=None,
+    strict: bool = False,
+    expected_features: int | None = None,
+    timeout_s: float | None = None,
+    pad_to_bucket: bool | None = None,
+    pipeline: bool | None = None,
+) -> np.ndarray:
+    # Tracing shell around _score_matrix_impl (which carries the full
+    # docstring, mirrored below): the span records the resolved strategy +
+    # autotune decision source as attributes, and the watchdog-timeout
+    # retry re-enters through here so the gather rerun traces as its own
+    # nested span (docs/observability.md §9).
+    with _span("score_matrix", requested_strategy=strategy):
+        return _score_matrix_impl(
+            forest,
+            X,
+            num_samples,
+            chunk_size=chunk_size,
+            strategy=strategy,
+            layout=layout,
+            strict=strict,
+            expected_features=expected_features,
+            timeout_s=timeout_s,
+            pad_to_bucket=pad_to_bucket,
+            pipeline=pipeline,
+        )
+
+
+score_matrix.__doc__ = _score_matrix_impl.__doc__

@@ -45,6 +45,14 @@ policy is provable with zero real sleeps). Metrics:
 ``isoforest_serving_coalesced_requests_total`` (counter, requests scored
 per flush) and ``isoforest_serving_flushes_total{cause=size|linger|close}``.
 Schema table in ``docs/serving.md``.
+
+Tracing (docs/observability.md §9): :meth:`submit` captures the caller's
+:func:`~isoforest_tpu.telemetry.current_context` — the request's root span
+— and the flush wraps scoring in a ``serving.flush`` span that *links*
+every captured context (one flush, many requests; links, not parentage,
+because the flush belongs to the flusher thread's own trace). Each served
+request gets its measured queue wait and the flush span's identity back on
+the pending handle, so the HTTP layer can report where the latency went.
 """
 
 from __future__ import annotations
@@ -57,6 +65,8 @@ import numpy as np
 
 from ..telemetry.metrics import counter as _counter, gauge as _gauge
 from ..telemetry.metrics import histogram as _histogram
+from ..telemetry.spans import current_context as _current_context
+from ..telemetry.spans import span as _span
 
 _QUEUE_DEPTH = _gauge(
     "isoforest_serving_queue_depth",
@@ -129,9 +139,12 @@ class _Pending:
         "error",
         "flush_rows",
         "flush_requests",
+        "ctx",
+        "queue_wait_s",
+        "flush_ctx",
     )
 
-    def __init__(self, rows: np.ndarray, enqueued_at: float) -> None:
+    def __init__(self, rows: np.ndarray, enqueued_at: float, ctx=None) -> None:
         self.rows = rows
         self.enqueued_at = enqueued_at
         self.event = threading.Event()
@@ -139,6 +152,12 @@ class _Pending:
         self.error: Optional[BaseException] = None
         self.flush_rows = 0
         self.flush_requests = 0
+        # trace handoff: the submitter's span context (linked by the flush
+        # span), the measured enqueue->drain wait, and the flush span's own
+        # context (reported back so the request trace names its flush)
+        self.ctx = ctx
+        self.queue_wait_s = 0.0
+        self.flush_ctx = None
 
 
 class MicroBatchCoalescer:
@@ -224,7 +243,7 @@ class MicroBatchCoalescer:
                     f"({self._pending_rows}/{self.max_queue_rows} rows "
                     "pending); back off and retry"
                 )
-            pending = _Pending(rows, now)
+            pending = _Pending(rows, now, ctx=_current_context())
             self._queue.append(pending)
             self._pending_rows += n
             _QUEUE_DEPTH.set(self._pending_rows)
@@ -303,27 +322,43 @@ class MicroBatchCoalescer:
         X = batch[0].rows if len(batch) == 1 else np.concatenate(
             [p.rows for p in batch], axis=0
         )
-        try:
-            scores = np.asarray(self._score_fn(X))
-            if scores.shape[0] != total:
-                raise ValueError(
-                    f"score_fn returned {scores.shape[0]} scores for "
-                    f"{total} rows"
-                )
-        except BaseException as exc:  # every waiter learns the same fate
+        drained_at = self._clock()
+        for p in batch:
+            p.queue_wait_s = max(drained_at - p.enqueued_at, 0.0)
+        # one flush serves many requests on this (flusher) thread: the span
+        # LINKS each request's captured context instead of parenting it
+        with _span(
+            "serving.flush",
+            links=[p.ctx for p in batch],
+            cause=cause,
+            rows=total,
+            requests=len(batch),
+        ) as fsp:
+            flush_ctx = fsp.context
             for p in batch:
-                p.error = exc
-                p.event.set()
+                p.flush_ctx = flush_ctx
+            try:
+                scores = np.asarray(self._score_fn(X))
+                if scores.shape[0] != total:
+                    raise ValueError(
+                        f"score_fn returned {scores.shape[0]} scores for "
+                        f"{total} rows"
+                    )
+            except BaseException as exc:  # every waiter learns the same fate
+                fsp.set_attrs(error=type(exc).__name__)
+                for p in batch:
+                    p.error = exc
+                    p.event.set()
+                _FLUSHES.inc(cause=cause)
+                return
+            _BATCH_ROWS.observe(float(total))
+            _COALESCED.inc(len(batch))
             _FLUSHES.inc(cause=cause)
-            return
-        _BATCH_ROWS.observe(float(total))
-        _COALESCED.inc(len(batch))
-        _FLUSHES.inc(cause=cause)
-        for i, p in enumerate(batch):
-            p.scores = scores[offsets[i] : offsets[i + 1]]
-            p.flush_rows = total
-            p.flush_requests = len(batch)
-            p.event.set()
+            for i, p in enumerate(batch):
+                p.scores = scores[offsets[i] : offsets[i + 1]]
+                p.flush_rows = total
+                p.flush_requests = len(batch)
+                p.event.set()
 
     def pump(self) -> int:
         """Run at most one due flush on the CALLER's thread; returns the

@@ -21,22 +21,40 @@ latency (parse → queue → coalesced score → encode) lands in the
 ``isoforest_serving_request_seconds`` histogram — the p50/p95/p99 the load
 generator reports come from the server's own series, not client clocks —
 and every response ticks ``isoforest_serving_responses_total{code=}``.
+
+Tracing (docs/observability.md §9): every request runs inside a
+``serving.request`` root span. An inbound ``X-Isoforest-Trace`` header
+(sanitised: ``[A-Za-z0-9._-]``, ≤64 chars) is adopted as the request's
+trace id — a client can stamp its own id and fetch the server-side trace
+with ``GET /trace?trace_id=`` later — and the response always echoes the
+effective trace id in the same header. The span records where the latency
+went (``queue_wait_s``) and which coalesced flush served it
+(``flush_trace_id``/``flush_span_id`` attrs, resolvable to the flush's own
+trace with the strategy + per-chunk pipeline spans under it).
 """
 
 from __future__ import annotations
 
 import io
 import json
+import re
 import time
-from typing import Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from ..telemetry.metrics import counter as _counter
 from ..telemetry.metrics import exponential_buckets, histogram as _histogram
+from ..telemetry.spans import TraceContext, span, with_context
 from .coalescer import ServingError
 
 SCORE_PATH = "/score"
+
+TRACE_HEADER = "X-Isoforest-Trace"
+# accepted inbound trace ids: our own hex ids plus dotted/dashed client
+# ids; anything else (header injection, oversized junk) is ignored and the
+# server mints its own id instead
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 
 # ~1.3x-geometric bounds, 50 us .. ~0.65 s: a warm coalesced 1-row request
 # through a cold full-bucket flush all resolve (same shape the old
@@ -101,10 +119,35 @@ def _parse_csv(body: bytes) -> np.ndarray:
     return rows
 
 
-def handle_score(service, body: bytes, headers, query: str = "") -> Tuple[int, str, str]:
-    """One ``/score`` request → ``(status, content_type, body)``. Pure
-    function of the payload + service so the status mapping is unit-testable
-    without a socket."""
+def inbound_trace_id(headers) -> Optional[str]:
+    """The sanitised client-supplied trace id, or None (absent/invalid)."""
+    raw = headers.get(TRACE_HEADER) if headers is not None else None
+    if raw and _TRACE_ID_RE.match(raw):
+        return raw
+    return None
+
+
+def handle_score(
+    service, body: bytes, headers, query: str = ""
+) -> Tuple[int, str, str, Dict[str, str]]:
+    """One ``/score`` request → ``(status, content_type, body, headers)``.
+    Pure function of the payload + service so the status mapping is
+    unit-testable without a socket. The returned headers always carry the
+    request's effective trace id (module doc)."""
+    inbound = inbound_trace_id(headers)
+    ctx = TraceContext(inbound) if inbound else None
+    with with_context(ctx):
+        with span("serving.request", path=SCORE_PATH) as sp:
+            status, content_type, payload = _respond(
+                service, body, headers, query, sp
+            )
+            sp.set_attrs(status=status)
+            trace_id = sp.trace_id or inbound
+    resp_headers = {TRACE_HEADER: trace_id} if trace_id else {}
+    return status, content_type, payload, resp_headers
+
+
+def _respond(service, body: bytes, headers, query: str, sp) -> Tuple[int, str, str]:
     t0 = time.perf_counter()
     content_type = (headers.get("Content-Type") or "").lower()
     csv = "csv" in content_type or "format=csv" in (query or "")
@@ -116,6 +159,7 @@ def handle_score(service, body: bytes, headers, query: str = "") -> Tuple[int, s
                 rows, single = _parse_json(body)
         except _BadRequest as exc:
             return _finish(t0, 400, _error_body(400, str(exc)))
+        sp.set_attrs(rows=int(rows.shape[0]))
         try:
             pending = service.coalescer.submit(rows)
             scores = service.coalescer.result(
@@ -125,6 +169,18 @@ def handle_score(service, body: bytes, headers, query: str = "") -> Tuple[int, s
             return _finish(t0, exc.status, _error_body(exc.status, str(exc)))
         except Exception as exc:  # scoring failure: typed 500, never a hang
             return _finish(t0, 500, _error_body(500, repr(exc)))
+        # where the latency went + which flush served us: the request trace
+        # names its flush (a DIFFERENT trace, reachable via the flush
+        # span's link back to this request — docs/observability.md §9)
+        sp.set_attrs(
+            queue_wait_s=round(pending.queue_wait_s, 6),
+            flush_trace_id=(
+                pending.flush_ctx.trace_id if pending.flush_ctx else None
+            ),
+            flush_span_id=(
+                pending.flush_ctx.span_id if pending.flush_ctx else None
+            ),
+        )
         if csv:
             out = "outlierScore\n" + "".join(
                 f"{float(s)!r}\n" for s in scores

@@ -32,6 +32,7 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from ..telemetry.events import record_event
+from ..telemetry.spans import set_span_attrs
 from ..utils.logging import logger
 from .coalescer import MicroBatchCoalescer
 
@@ -117,8 +118,18 @@ class ScoringService:
         kwargs = {}
         if int(X.shape[0]) > self._max_warm_bucket:
             kwargs = {"chunk_size": self._max_warm_bucket, "pipeline": True}
+        # annotate the enclosing serving.flush span with WHICH model served
+        # this flush — the cross-thread link test pins scores to generations.
+        # The generation must be the one the score call pinned under the
+        # manager lock: reading manager.generation here separately races a
+        # concurrent hot-swap (new scores tagged with the old number).
         if self.manager is not None:
-            return self.manager.score(X, timeout_s=timeout_s, **kwargs)
+            scores, generation = self.manager.score(
+                X, timeout_s=timeout_s, return_generation=True, **kwargs
+            )
+            set_span_attrs(model_id=self.model_id, generation=generation)
+            return scores
+        set_span_attrs(model_id=self.model_id, generation=0)
         return self._bare_model.score(X, timeout_s=timeout_s, **kwargs)
 
     def score(self, rows: np.ndarray) -> np.ndarray:

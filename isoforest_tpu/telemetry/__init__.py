@@ -10,8 +10,10 @@ checkpoint seals/resumes, distributed bring-up attempts).
 
 Seven coordinated pieces, stdlib-only:
 
-* :mod:`.spans` — nestable, thread-safe span tracer with wall/process time
-  and optional ``jax.profiler.TraceAnnotation`` pass-through;
+* :mod:`.spans` — nestable, thread-safe span tracer with wall/process time,
+  optional ``jax.profiler.TraceAnnotation`` pass-through, and request-scoped
+  trace context (deterministic ``trace_id``/``span_id``, cross-thread span
+  links, a bounded trace ring with a slow-request capture policy);
 * :mod:`.metrics` — process-wide registry of counters, gauges and
   fixed-bucket histograms with p50/p95/p99 summaries;
 * :mod:`.events` — one ordered, timestamped, bounded event timeline;
@@ -24,7 +26,8 @@ Seven coordinated pieces, stdlib-only:
   split-feature usage, realised vs expected path length) computed from the
   packed scoring layout;
 * :mod:`.http` — a stdlib HTTP daemon serving ``/metrics`` (Prometheus),
-  ``/healthz`` (heartbeat liveness) and ``/snapshot`` (JSON), started via
+  ``/healthz`` (heartbeat liveness), ``/snapshot`` (JSON), ``/trace`` +
+  ``/traces/recent`` (Perfetto-loadable request traces), started via
   :func:`serve` or ``ISOFOREST_TPU_METRICS_PORT``.
 
 Telemetry is ON by default and near-zero cost when disabled
@@ -42,6 +45,8 @@ from .export import (
     reset,
     snapshot,
     snapshot_json,
+    to_chrome_trace,
+    to_chrome_trace_json,
     to_prometheus,
 )
 from .http import MetricsServer, active_server, maybe_serve_from_env, serve
@@ -65,7 +70,21 @@ from .monitor import (
     ks,
     psi,
 )
-from .spans import SpanRecord, current_span_name, span
+from .spans import (
+    SpanRecord,
+    TraceContext,
+    current_context,
+    current_span_name,
+    get_trace,
+    recent_traces,
+    reset_traces,
+    seed_trace_ids,
+    set_span_attrs,
+    set_trace_policy,
+    span,
+    trace_stats,
+    with_context,
+)
 from .spans import records as span_records
 from .spans import summary as span_summary
 
@@ -82,9 +101,11 @@ __all__ = [
     "ScoreMonitor",
     "SpanRecord",
     "StreamBaseline",
+    "TraceContext",
     "active_server",
     "capture_baseline",
     "counter",
+    "current_context",
     "current_span_name",
     "disable",
     "enable",
@@ -93,23 +114,33 @@ __all__ = [
     "forest_diagnostics",
     "gauge",
     "get_events",
+    "get_trace",
     "histogram",
     "ks",
     "maybe_serve_from_env",
     "parse_prometheus",
     "psi",
     "publish_gauges",
+    "recent_traces",
     "record_event",
     "registry",
     "reset",
+    "reset_traces",
+    "seed_trace_ids",
     "serve",
+    "set_span_attrs",
+    "set_trace_policy",
     "snapshot",
     "snapshot_json",
     "span",
     "span_records",
     "span_summary",
     "timeline",
+    "to_chrome_trace",
+    "to_chrome_trace_json",
     "to_prometheus",
+    "trace_stats",
+    "with_context",
 ]
 
 # live /metrics endpoint opt-in: exporting ISOFOREST_TPU_METRICS_PORT makes

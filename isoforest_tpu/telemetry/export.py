@@ -12,9 +12,17 @@ label sets, cumulative ``le`` histogram buckets with ``_sum``/``_count``.
 the exposition through it, and operators can use it to spot-check a
 scraped payload without a Prometheus server.
 
+``to_chrome_trace()`` renders one committed trace (plus its link-adjacent
+traces) in the Chrome trace-event JSON format: ``ph:"X"`` complete events
+with microsecond ``ts``/``dur``, per-thread ``tid`` lanes named by
+``ph:"M"`` metadata, and ``ph:"s"``/``ph:"f"`` flow arrows for every
+request→flush span link — the file Perfetto (ui.perfetto.dev) and
+``chrome://tracing`` load directly (docs/observability.md §9).
+
 ``bench.py`` embeds a compact snapshot in its JSON line and
 ``python -m isoforest_tpu telemetry`` prints either format after a
-(synthetic or user-supplied) fit+score workload.
+(synthetic or user-supplied) fit+score workload;
+``python -m isoforest_tpu trace out.json`` writes the Chrome artifact.
 """
 
 from __future__ import annotations
@@ -44,6 +52,7 @@ def snapshot() -> dict:
         "metrics": metrics.registry().snapshot(),
         "events": [e.as_dict() for e in events.get_events()],
         "events_dropped": timeline.dropped,
+        "traces": spans.trace_stats(),
     }
 
 
@@ -178,9 +187,139 @@ def _split_labels(body: str):
     return items
 
 
+# --------------------------------------------------------------------------- #
+# Chrome trace-event JSON (Perfetto / chrome://tracing)
+# --------------------------------------------------------------------------- #
+
+
+def _flatten_trace_spans(trace: dict) -> list:
+    """One trace doc (get_trace output) -> every span dict it carries,
+    including link-adjacent traces merged in under ``linked``."""
+    out = list(trace.get("spans", ()))
+    for adj in trace.get("linked", ()):
+        out.extend(adj.get("spans", ()))
+    return out
+
+
+def to_chrome_trace(trace: dict, pid: Optional[int] = None) -> dict:
+    """Render one trace doc (:func:`spans.get_trace` /
+    ``{"spans": [...]}``) as Chrome trace-event JSON.
+
+    Every span becomes a ``ph:"X"`` complete event (microsecond
+    ``ts``/``dur``); each recorded thread gets a stable ``tid`` lane with
+    ``ph:"M"`` ``thread_name`` metadata; every span *link* becomes a flow
+    arrow — ``ph:"s"`` anchored inside the linked (request) slice,
+    ``ph:"f"`` with ``bp:"e"`` anchored inside the linking (flush) slice,
+    sharing the linked span's id — so Perfetto draws request→flush
+    causality across thread lanes. ``pid`` defaults to the live process id
+    (tests pin it for golden comparison)."""
+    import os as _os
+
+    pid = _os.getpid() if pid is None else int(pid)
+    span_docs = _flatten_trace_spans(trace)
+    tids: Dict[str, int] = {}
+    events_out = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": "isoforest-tpu"},
+        }
+    ]
+    by_span_id: Dict[str, dict] = {}
+    for doc in span_docs:
+        thread = str(doc.get("thread") or "main")
+        if thread not in tids:
+            tids[thread] = len(tids) + 1
+            events_out.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tids[thread],
+                    "args": {"name": thread},
+                }
+            )
+        ts_us = float(doc["start_unix_s"]) * 1e6
+        dur_us = max(float(doc["wall_s"]) * 1e6, 1.0)
+        args = {
+            "trace_id": doc.get("trace_id"),
+            "span_id": doc.get("span_id"),
+            "parent_id": doc.get("parent_id"),
+        }
+        args.update(doc.get("attrs") or {})
+        event = {
+            "name": doc["name"],
+            "cat": "span",
+            "ph": "X",
+            "ts": ts_us,
+            "dur": dur_us,
+            "pid": pid,
+            "tid": tids[thread],
+            "args": args,
+        }
+        events_out.append(event)
+        if doc.get("span_id"):
+            by_span_id[doc["span_id"]] = event
+    # flow arrows: for each span that declares links, draw linked-span ->
+    # linking-span (the request slice flows into the flush that served it)
+    for doc in span_docs:
+        sink = by_span_id.get(doc.get("span_id") or "")
+        if sink is None:
+            continue
+        for target_trace, target_span in doc.get("links") or ():
+            source = by_span_id.get(target_span or "")
+            if source is None:
+                continue  # linked span not captured (sampled out/evicted)
+            flow_id = str(target_span)
+            events_out.append(
+                {
+                    "name": "coalesce",
+                    "cat": "link",
+                    "ph": "s",
+                    "id": flow_id,
+                    "ts": source["ts"],
+                    "pid": pid,
+                    "tid": source["tid"],
+                    "args": {"trace_id": target_trace},
+                }
+            )
+            events_out.append(
+                {
+                    "name": "coalesce",
+                    "cat": "link",
+                    "ph": "f",
+                    "bp": "e",
+                    "id": flow_id,
+                    "ts": sink["ts"],
+                    "pid": pid,
+                    "tid": sink["tid"],
+                    "args": {"trace_id": doc.get("trace_id")},
+                }
+            )
+    return {
+        "traceEvents": events_out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "trace_id": trace.get("trace_id"),
+            "root": trace.get("root"),
+            "producer": "isoforest_tpu.telemetry",
+        },
+    }
+
+
+def to_chrome_trace_json(
+    trace: dict, pid: Optional[int] = None, indent: Optional[int] = None
+) -> str:
+    return json.dumps(to_chrome_trace(trace, pid=pid), indent=indent)
+
+
 def reset() -> None:
-    """Clear spans, metric series, and the event timeline (registered
-    metric objects stay valid). For tests and sample-and-clear operators."""
+    """Clear spans, traces, metric series, and the event timeline
+    (registered metric objects stay valid). For tests and
+    sample-and-clear operators."""
     spans.reset_spans()
+    spans.reset_traces()
     metrics.reset_metrics()
     events.reset_events()

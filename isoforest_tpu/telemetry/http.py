@@ -22,6 +22,10 @@ only *reads* registries that are already thread-safe):
 * ``GET /snapshot`` — the full JSON snapshot (:func:`..export.snapshot`):
   spans, metrics, the event timeline, plus the same ``lifecycle`` section
   when a manager is live.
+* ``GET /trace?trace_id=<id>`` — one captured trace, as Perfetto-loadable
+  Chrome trace-event JSON (``&format=spans`` for the raw span docs), and
+  ``GET /traces/recent?limit=N`` — newest-first trace summaries plus the
+  ring's drop accounting (docs/observability.md §9).
 
 Start with ``telemetry.serve(port=...)`` (``port=0`` picks an ephemeral
 port, reported on the returned handle) or by exporting
@@ -37,10 +41,11 @@ import json
 import math
 import os
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
-from . import export
+from . import export, spans
 from .events import record_event
 
 METRICS_PORT_ENV = "ISOFOREST_TPU_METRICS_PORT"
@@ -50,9 +55,11 @@ DEFAULT_STALE_AFTER_S = 15.0
 
 _INDEX = (
     "isoforest_tpu telemetry endpoint\n"
-    "  /metrics   Prometheus text exposition\n"
-    "  /healthz   liveness (heartbeat ages + lifecycle state when configured)\n"
-    "  /snapshot  full JSON telemetry snapshot\n"
+    "  /metrics        Prometheus text exposition\n"
+    "  /healthz        liveness (heartbeat ages + lifecycle state when configured)\n"
+    "  /snapshot       full JSON telemetry snapshot\n"
+    "  /trace          one trace as Chrome trace-event JSON (?trace_id=<id>)\n"
+    "  /traces/recent  newest-first trace summaries (?limit=N)\n"
 )
 
 # Refuse request bodies past this size before reading them into memory: the
@@ -95,6 +102,53 @@ class _Handler(BaseHTTPRequestHandler):
                 "application/json",
                 json.dumps(doc, sort_keys=True) + "\n",
             )
+        elif path == "/trace":
+            params = urllib.parse.parse_qs(query)
+            trace_id = (params.get("trace_id") or [""])[0]
+            if not trace_id:
+                self._reply(
+                    400,
+                    "application/json",
+                    json.dumps(
+                        {"error": "trace_id query parameter required",
+                         "status": 400}
+                    ) + "\n",
+                )
+                return
+            trace = spans.get_trace(trace_id)
+            if trace is None:
+                self._reply(
+                    404,
+                    "application/json",
+                    json.dumps(
+                        {"error": f"no captured trace {trace_id} "
+                                  "(never captured, sampled out, or evicted)",
+                         "status": 404}
+                    ) + "\n",
+                )
+                return
+            fmt = (params.get("format") or ["chrome"])[0]
+            doc = trace if fmt == "spans" else export.to_chrome_trace(trace)
+            self._reply(
+                200,
+                "application/json",
+                json.dumps(doc, sort_keys=True) + "\n",
+            )
+        elif path == "/traces/recent":
+            params = urllib.parse.parse_qs(query)
+            try:
+                limit = int((params.get("limit") or ["20"])[0])
+            except ValueError:
+                limit = 20
+            doc = {
+                "traces": spans.recent_traces(limit=limit),
+                "stats": spans.trace_stats(),
+            }
+            self._reply(
+                200,
+                "application/json",
+                json.dumps(doc, sort_keys=True) + "\n",
+            )
         elif path in ("/healthz", "/health"):
             payload, healthy = owner.health()
             self._reply(
@@ -122,8 +176,11 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler contract
         """Dispatch to the owner's registered POST routes (the serving
         layer mounts ``/score`` here, docs/serving.md). Routes return
-        ``(status, content_type, body)``; any handler exception is a typed
-        500 — the telemetry daemon must never die to a bad request."""
+        ``(status, content_type, body)`` or ``(status, content_type, body,
+        headers)`` — the 4th element is a dict of extra response headers
+        (the scoring routes echo ``X-Isoforest-Trace`` this way); any
+        handler exception is a typed 500 — the telemetry daemon must never
+        die to a bad request."""
         owner: "MetricsServer" = self.server.owner  # type: ignore[attr-defined]
         path, _, query = self.path.partition("?")
         handler = owner.post_routes.get(path)
@@ -175,21 +232,34 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         body = self.rfile.read(length) if length else b""
+        extra_headers = None
         try:
-            status, content_type, payload = handler(body, self.headers, query)
+            result = handler(body, self.headers, query)
+            if len(result) == 4:
+                status, content_type, payload, extra_headers = result
+            else:
+                status, content_type, payload = result
         except Exception as exc:
             status, content_type, payload = (
                 500,
                 "application/json",
                 json.dumps({"error": repr(exc), "status": 500}) + "\n",
             )
-        self._reply(status, content_type, payload)
+        self._reply(status, content_type, payload, extra_headers)
 
-    def _reply(self, status: int, content_type: str, body: str) -> None:
+    def _reply(
+        self,
+        status: int,
+        content_type: str,
+        body: str,
+        headers: Optional[dict] = None,
+    ) -> None:
         data = body.encode("utf-8")
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        for name, value in (headers or {}).items():
+            self.send_header(str(name), str(value))
         self.end_headers()
         self.wfile.write(data)
 
@@ -252,8 +322,8 @@ class MetricsServer:
 
     def register_post(self, path: str, handler) -> None:
         """Mount a POST route (``handler(body, headers, query) -> (status,
-        content_type, body_str)``); replaces any existing route at
-        ``path``."""
+        content_type, body_str[, extra_headers])``); replaces any existing
+        route at ``path``."""
         self.post_routes[str(path)] = handler
 
     def unregister_post(self, path: str) -> None:

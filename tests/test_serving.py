@@ -478,8 +478,9 @@ class TestWireFormats:
 
     def test_json_batch_response_fields(self, service, model, data):
         body = json.dumps({"rows": [[float(v) for v in r] for r in data[:6]]})
-        status, ctype, out = handle_score(service, body.encode(), {})
+        status, ctype, out, resp_headers = handle_score(service, body.encode(), {})
         assert status == 200 and ctype == "application/json"
+        assert resp_headers.get("X-Isoforest-Trace")  # server-minted trace id
         doc = json.loads(out)
         assert doc["scores"] == [float(s) for s in model.score(data[:6])]
         assert doc["rows"] == 6 and doc["single"] is False
@@ -487,7 +488,7 @@ class TestWireFormats:
 
     def test_csv_request_and_response(self, service, model, data):
         body = "\n".join(",".join(repr(float(v)) for v in r) for r in data[:3])
-        status, ctype, out = handle_score(
+        status, ctype, out, _ = handle_score(
             service, body.encode(), {"Content-Type": "text/csv"}
         )
         assert status == 200 and ctype.startswith("text/csv")
@@ -496,14 +497,14 @@ class TestWireFormats:
 
     def test_csv_via_query_parameter(self, service, data):
         body = ",".join(repr(float(v)) for v in data[0])
-        status, ctype, out = handle_score(
+        status, ctype, out, _ = handle_score(
             service, body.encode(), {}, query="format=csv"
         )
         assert status == 200 and ctype.startswith("text/csv")
 
     def test_csv_malformed_400(self, service):
         for payload in (b"1,2,banana\n", b"", b"\xff\xfe"):
-            status, _, out = handle_score(
+            status, _, out, _ = handle_score(
                 service, payload, {"Content-Type": "text/csv"}
             )
             assert status == 400, payload
@@ -511,7 +512,7 @@ class TestWireFormats:
 
     def test_json_malformed_400(self, service):
         for payload in (b"\xff\xfe", b"[1,2]", b'{"rows": [[[1]]]}'):
-            status, _, out = handle_score(service, payload, {})
+            status, _, out, _ = handle_score(service, payload, {})
             assert status == 400, payload
 
 
@@ -551,7 +552,7 @@ class TestStatusMapping:
     )
     def test_error_to_status(self, exc, status):
         svc = self._StubService(exc)
-        code, _, body = handle_score(
+        code, _, body, _ = handle_score(
             svc, json.dumps({"rows": [[1.0, 2.0]]}).encode(), {}
         )
         assert code == status

@@ -1,0 +1,535 @@
+"""End-to-end request tracing (docs/observability.md §9).
+
+Acceptance matrix:
+  * trace identity is deterministic: seeded 16-hex ids, child spans
+    inherit the parent's trace, ``with_context`` adopts a foreign context
+    (the HTTP header handoff) — no ``random`` anywhere (JIT001);
+  * the coalescer's cross-thread handoff is correct UNDER A STALLED
+    HOT-SWAP: every request span is **linked** (not parented) by exactly
+    one shared ``serving.flush`` span, and the flush's recorded
+    ``generation`` matches the model that actually scored the request
+    (old or new — never a mislabel);
+  * the trace ring is bounded with exact drop accounting
+    (``kept``/``sampled_out``/``ring_dropped``/``open_dropped``/
+    ``span_dropped``);
+  * ``X-Isoforest-Trace`` round-trips through ``handle_score`` (honoured
+    when sane, ignored when malformed, minted when absent — always
+    echoed);
+  * the Chrome export matches the trace-event schema byte-for-byte
+    against a golden (``ph:"X"`` complete events, thread lanes, paired
+    ``s``/``f`` flow arrows);
+  * the capture policy keeps slow roots and linked roots unconditionally
+    and samples the rest 1-in-N;
+  * disabled telemetry makes the whole layer a no-op.
+
+Zero real sleeps: the swap stall is event-gated, the coalescer flushes
+on size, everything else is synchronous.
+"""
+
+import json
+import pathlib
+import threading
+
+import numpy as np
+import pytest
+
+from isoforest_tpu import IsolationForest, telemetry
+from isoforest_tpu.lifecycle import ModelManager
+from isoforest_tpu.serving import ScoringService, ServingConfig, handle_score
+from isoforest_tpu.telemetry import TraceContext, spans as spans_mod
+from isoforest_tpu.telemetry.export import to_chrome_trace
+
+N_TREES = 12
+GOLDEN = pathlib.Path(__file__).parent / "resources" / "chrome_trace_golden.json"
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    telemetry.reset()
+    telemetry.set_trace_policy(slow_threshold_s=0.25, sample_every=1)
+    yield
+    telemetry.enable()
+    telemetry.reset()
+    telemetry.set_trace_policy(slow_threshold_s=0.25, sample_every=1)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(4096, 5)).astype(np.float32)
+    X[:80] += 4.0
+    return X
+
+
+@pytest.fixture(scope="module")
+def model(data):
+    return IsolationForest(
+        num_estimators=N_TREES, max_samples=64.0, random_seed=1
+    ).fit(data)
+
+
+# --------------------------------------------------------------------------- #
+# trace identity & context handoff
+# --------------------------------------------------------------------------- #
+
+
+class TestTraceIdentity:
+    def test_ids_are_seeded_and_deterministic(self):
+        telemetry.seed_trace_ids(0xBEEF)
+        with telemetry.span("a") as sp:
+            pass
+        assert sp.trace_id == "beef000000000001"
+        assert sp.span_id == "beef000000000002"
+        assert sp.parent_id is None
+        telemetry.seed_trace_ids(0xBEEF)
+        with telemetry.span("a") as again:
+            pass
+        assert (again.trace_id, again.span_id) == (sp.trace_id, sp.span_id)
+
+    def test_child_inherits_trace_and_parent(self):
+        with telemetry.span("outer") as outer:
+            with telemetry.span("inner") as inner:
+                pass
+        assert inner.trace_id == outer.trace_id
+        assert inner.parent_id == outer.span_id
+        assert inner.span_id != outer.span_id
+
+    def test_with_context_adopts_foreign_trace(self):
+        ctx = TraceContext("client-trace-1")
+        with telemetry.with_context(ctx):
+            with telemetry.span("adopted") as sp:
+                pass
+        assert sp.trace_id == "client-trace-1"
+        assert sp.parent_id is None  # header context carries no span id
+
+    def test_current_context_crosses_threads(self):
+        captured = []
+
+        def worker(ctx):
+            with telemetry.with_context(ctx):
+                with telemetry.span("remote") as sp:
+                    pass
+            captured.append(sp)
+
+        with telemetry.span("local") as local:
+            ctx = telemetry.current_context()
+            assert ctx == TraceContext(local.trace_id, local.span_id)
+            t = threading.Thread(target=worker, args=(ctx,))
+            t.start()
+            t.join(timeout=60)
+        (remote,) = captured
+        assert remote.trace_id == local.trace_id
+        assert remote.parent_id == local.span_id
+        assert telemetry.current_context() is None  # fully unwound
+
+
+# --------------------------------------------------------------------------- #
+# cross-thread flush links under a stalled hot-swap
+# --------------------------------------------------------------------------- #
+
+
+class TestFlushLinksThroughSwap:
+    def test_flush_generation_matches_scored_model(self, tmp_path):
+        """The swap-under-load harness, re-run for TRACES: worker threads
+        score through the coalescer while a hot-swap is stalled mid-flight.
+        Every request span must be linked by exactly one shared
+        ``serving.flush`` span on the coalescer thread, and that flush's
+        recorded ``generation`` must name the model whose scores the
+        request actually received — the attribution a post-incident trace
+        query depends on."""
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(8192, 5)).astype(np.float32)
+        shifted = X + 3.0 * np.std(X, axis=0, keepdims=True)
+        model = IsolationForest(
+            num_estimators=N_TREES, max_samples=64.0, random_seed=1
+        ).fit(X)
+        swap_entered, swap_release = threading.Event(), threading.Event()
+
+        def slow_swap():
+            swap_entered.set()
+            assert swap_release.wait(timeout=300)
+
+        from isoforest_tpu.resilience import faults
+
+        fc = faults.FakeClock()
+        mgr = ModelManager(
+            model,
+            work_dir=str(tmp_path / "wd"),
+            auto_retrain=False,
+            background=True,
+            window_rows=6144,
+            min_window_rows=1024,
+            checkpoint_every=4,
+            clock=fc.now,
+            sleep=fc.sleep,
+            hooks={"mid_swap": slow_swap},
+        )
+        service = ScoringService(
+            manager=mgr,
+            config=ServingConfig(
+                batch_rows=512, linger_ms=0.0, request_timeout_s=300.0
+            ),
+        )
+        try:
+            probe = np.ascontiguousarray(shifted[:257])
+            old_scores = model.score(probe)
+            for i in range(6):
+                service.score(shifted[i * 1024 : (i + 1) * 1024])
+            assert mgr.retrain(reason="trace_link_test", wait=False)
+            assert swap_entered.wait(timeout=300)
+            telemetry.reset()  # only the traced requests below matter
+
+            results, errors = [], []
+            lock = threading.Lock()
+            go = threading.Barrier(9)
+
+            def scorer():
+                try:
+                    go.wait(timeout=300)
+                    for _ in range(4):
+                        with telemetry.span("test.request") as sp:
+                            scores = service.score(probe)
+                        with lock:
+                            results.append((sp.trace_id, sp.span_id, scores))
+                except Exception as exc:  # surfaced below
+                    errors.append(exc)
+
+            threads = [threading.Thread(target=scorer) for _ in range(8)]
+            for t in threads:
+                t.start()
+            go.wait(timeout=300)
+            swap_release.set()
+            for t in threads:
+                t.join(timeout=300)
+            assert mgr.wait_retrain(timeout_s=300)
+            assert not errors, errors
+            assert len(results) == 32
+            new_scores = mgr.model.score(probe)
+
+            for trace_id, span_id, scores in results:
+                doc = telemetry.get_trace(trace_id)
+                assert doc is not None and doc["complete"]
+                flushes = [
+                    s
+                    for adj in doc["linked"]
+                    for s in adj["spans"]
+                    if s["name"] == "serving.flush"
+                    and [trace_id, span_id] in s["links"]
+                ]
+                assert len(flushes) == 1, (
+                    f"request {trace_id} linked by {len(flushes)} flushes"
+                )
+                flush = flushes[0]
+                assert flush["thread"] != threading.current_thread().name
+                generation = flush["attrs"]["generation"]
+                if np.array_equal(scores, old_scores):
+                    assert generation == 1
+                elif np.array_equal(scores, new_scores):
+                    assert generation == 2
+                else:
+                    pytest.fail(f"torn scores in request {trace_id}")
+        finally:
+            swap_release.set()
+            service.close()
+            mgr.close()
+
+
+# --------------------------------------------------------------------------- #
+# ring bounds & drop accounting
+# --------------------------------------------------------------------------- #
+
+
+class TestRingBounds:
+    def test_committed_ring_drops_oldest_with_accounting(self):
+        telemetry.set_trace_policy(slow_threshold_s=0.0, sample_every=1)
+        n = spans_mod.MAX_TRACES + 20
+        for _ in range(n):
+            with telemetry.span("tick"):
+                pass
+        stats = telemetry.trace_stats()
+        assert stats["kept"] == n
+        assert stats["ring_dropped"] == 20
+        assert stats["ring_size"] == spans_mod.MAX_TRACES
+        assert len(telemetry.recent_traces(limit=0)) == spans_mod.MAX_TRACES
+
+    def test_per_trace_span_cap(self):
+        telemetry.set_trace_policy(slow_threshold_s=0.0, sample_every=1)
+        extra = 44
+        with telemetry.span("root") as root:
+            for _ in range(spans_mod.MAX_TRACE_SPANS + extra):
+                with telemetry.span("leaf"):
+                    pass
+        doc = telemetry.get_trace(root.trace_id)
+        assert len(doc["spans"]) == spans_mod.MAX_TRACE_SPANS
+        # the overflowing leaves + the root record itself are accounted
+        assert telemetry.trace_stats()["span_dropped"] == extra + 1
+
+    def test_open_trace_cap(self):
+        """Traces that never complete (a child reported under an adopted
+        context whose root lives elsewhere) are bounded too."""
+        overflow = 10
+        for i in range(spans_mod.MAX_OPEN_TRACES + overflow):
+            with telemetry.with_context(TraceContext(f"open-{i}", "ffff")):
+                with telemetry.span("orphan"):
+                    pass
+        stats = telemetry.trace_stats()
+        assert stats["open_traces"] == spans_mod.MAX_OPEN_TRACES
+        assert stats["open_dropped"] == overflow
+        # an open trace is queryable, marked incomplete
+        doc = telemetry.get_trace(f"open-{spans_mod.MAX_OPEN_TRACES}")
+        assert doc is not None and doc["complete"] is False
+
+
+# --------------------------------------------------------------------------- #
+# X-Isoforest-Trace round-trip through handle_score
+# --------------------------------------------------------------------------- #
+
+
+class TestHeaderRoundTrip:
+    @pytest.fixture()
+    def service(self, model):
+        svc = ScoringService(
+            model=model,
+            config=ServingConfig(
+                batch_rows=64, linger_ms=0.0, request_timeout_s=60.0
+            ),
+        )
+        yield svc
+        svc.close()
+
+    def _body(self, data, n=3):
+        return json.dumps(
+            {"rows": [[float(v) for v in r] for r in data[:n]]}
+        ).encode()
+
+    def test_inbound_id_is_honoured_and_echoed(self, service, data):
+        telemetry.set_trace_policy(slow_threshold_s=0.0, sample_every=1)
+        headers = {"X-Isoforest-Trace": "client_req.42-a"}
+        status, _, _, resp = handle_score(service, self._body(data), headers)
+        assert status == 200
+        assert resp["X-Isoforest-Trace"] == "client_req.42-a"
+        doc = telemetry.get_trace("client_req.42-a")
+        assert doc is not None
+        root = next(s for s in doc["spans"] if s["name"] == "serving.request")
+        assert root["attrs"]["rows"] == 3
+        assert root["attrs"]["status"] == 200
+        assert root["attrs"]["queue_wait_s"] >= 0.0
+        # the request names its flush; the flush trace links back
+        flush_trace = root["attrs"]["flush_trace_id"]
+        assert flush_trace
+        linked = {adj["trace_id"] for adj in doc["linked"]}
+        assert flush_trace in linked
+
+    def test_malformed_inbound_id_is_ignored(self, service, data):
+        for bad in ("spaces are bad", "x" * 65, "sneaky\nheader", ""):
+            headers = {"X-Isoforest-Trace": bad}
+            status, _, _, resp = handle_score(
+                service, self._body(data), headers
+            )
+            assert status == 200
+            echoed = resp["X-Isoforest-Trace"]
+            assert echoed and echoed != bad  # server-minted replacement
+
+    def test_absent_header_mints_and_echoes(self, service, data):
+        telemetry.set_trace_policy(slow_threshold_s=0.0, sample_every=1)
+        status, _, _, resp = handle_score(service, self._body(data), {})
+        assert status == 200
+        minted = resp["X-Isoforest-Trace"]
+        assert minted and telemetry.get_trace(minted) is not None
+
+    def test_error_responses_still_echo(self, service):
+        headers = {"X-Isoforest-Trace": "bad-payload-1"}
+        status, _, _, resp = handle_score(service, b"{nope", headers)
+        assert status == 400
+        assert resp["X-Isoforest-Trace"] == "bad-payload-1"
+
+
+# --------------------------------------------------------------------------- #
+# Chrome trace-event export
+# --------------------------------------------------------------------------- #
+
+
+def _fixture_trace():
+    """A handcrafted get_trace document: one flush trace (root + chunk
+    child) linking one request span from another trace — fixed timings so
+    the export is byte-deterministic."""
+    request_span = {
+        "name": "serving.request",
+        "parent": None,
+        "depth": 0,
+        "thread": "http-1",
+        "start_unix_s": 1000.0,
+        "wall_s": 0.004,
+        "process_s": 0.001,
+        "attrs": {"path": "/score", "rows": 3, "status": 200},
+        "trace_id": "aaaa000000000001",
+        "span_id": "aaaa000000000002",
+        "parent_id": None,
+        "links": [],
+    }
+    chunk_span = {
+        "name": "pipeline.chunk",
+        "parent": "serving.flush",
+        "depth": 1,
+        "thread": "isoforest-coalescer",
+        "start_unix_s": 1000.0021,
+        "wall_s": 0.001,
+        "process_s": 0.001,
+        "attrs": {"site": "score_matrix", "index": 0, "rows": 3},
+        "trace_id": "bbbb000000000001",
+        "span_id": "bbbb000000000003",
+        "parent_id": "bbbb000000000002",
+        "links": [],
+    }
+    flush_span = {
+        "name": "serving.flush",
+        "parent": None,
+        "depth": 0,
+        "thread": "isoforest-coalescer",
+        "start_unix_s": 1000.002,
+        "wall_s": 0.0015,
+        "process_s": 0.001,
+        "attrs": {"cause": "size", "rows": 3, "requests": 1},
+        "trace_id": "bbbb000000000001",
+        "span_id": "bbbb000000000002",
+        "parent_id": None,
+        "links": [["aaaa000000000001", "aaaa000000000002"]],
+    }
+    return {
+        "trace_id": "bbbb000000000001",
+        "root": "serving.flush",
+        "root_span_id": "bbbb000000000002",
+        "start_unix_s": 1000.002,
+        "wall_s": 0.0015,
+        "slow": False,
+        "spans": [chunk_span, flush_span],
+        "complete": True,
+        "linked": [
+            {
+                "trace_id": "aaaa000000000001",
+                "root": "serving.request",
+                "spans": [request_span],
+            }
+        ],
+    }
+
+
+class TestChromeExport:
+    def test_golden(self):
+        got = to_chrome_trace(_fixture_trace(), pid=1)
+        want = json.loads(GOLDEN.read_text())
+        assert got == want
+
+    def test_real_trace_matches_event_schema(self, model, data):
+        """The live-path analogue of the CI trace smoke: score through the
+        coalescer with a traced request, export, and hold the trace-event
+        schema — complete events carry ts/dur/pid/tid, flow-arrow ids pair
+        a ``ph:"s"`` with a ``ph:"f"`` anchored on different lanes."""
+        telemetry.set_trace_policy(slow_threshold_s=0.0, sample_every=1)
+        svc = ScoringService(
+            model=model,
+            config=ServingConfig(
+                batch_rows=64, linger_ms=0.0, request_timeout_s=60.0
+            ),
+        )
+        try:
+            body = json.dumps(
+                {"rows": [[float(v) for v in r] for r in data[:4]]}
+            ).encode()
+            status, _, _, resp = handle_score(svc, body, {})
+            assert status == 200
+        finally:
+            svc.close()
+        doc = telemetry.get_trace(resp["X-Isoforest-Trace"])
+        chrome = telemetry.to_chrome_trace(doc, pid=7)
+        events = chrome["traceEvents"]
+        xs = [e for e in events if e["ph"] == "X"]
+        names = {e["name"] for e in xs}
+        assert {"serving.request", "serving.flush"} <= names
+        for e in xs:
+            assert e["pid"] == 7
+            assert e["dur"] > 0 and e["ts"] > 0
+            assert isinstance(e["tid"], int) and e["tid"] >= 1
+            assert e["args"]["trace_id"] and e["args"]["span_id"]
+        starts = [e for e in events if e["ph"] == "s"]
+        finishes = [e for e in events if e["ph"] == "f"]
+        assert {e["id"] for e in starts} == {e["id"] for e in finishes}
+        assert len(starts) >= 1
+        for f in finishes:
+            assert f["bp"] == "e"
+        # the arrow crosses lanes: request thread -> coalescer thread
+        assert {e["tid"] for e in starts} != {e["tid"] for e in finishes}
+        # round-trips as JSON (what /trace serves and Perfetto loads)
+        assert json.loads(telemetry.to_chrome_trace_json(doc, pid=7)) == chrome
+
+
+# --------------------------------------------------------------------------- #
+# capture policy
+# --------------------------------------------------------------------------- #
+
+
+class TestCapturePolicy:
+    def test_sampler_keeps_one_in_n(self):
+        telemetry.set_trace_policy(slow_threshold_s=1e9, sample_every=5)
+        for _ in range(10):
+            with telemetry.span("fast"):
+                pass
+        stats = telemetry.trace_stats()
+        assert stats["kept"] == 2
+        assert stats["sampled_out"] == 8
+
+    def test_slow_roots_bypass_the_sampler(self):
+        # slow = wall >= threshold; a zero threshold makes every trace
+        # "slow" without sleeping (SLP001) — none may be sampled out
+        telemetry.set_trace_policy(slow_threshold_s=0.0, sample_every=10**6)
+        for _ in range(5):
+            with telemetry.span("slow-by-policy"):
+                pass
+        stats = telemetry.trace_stats()
+        assert stats["kept"] == 5 and stats["sampled_out"] == 0
+        assert all(t["slow"] for t in telemetry.recent_traces())
+
+    def test_linked_roots_bypass_the_sampler(self):
+        # dropping a flush trace would orphan every request that points at
+        # it, so roots declaring links are always kept
+        telemetry.set_trace_policy(slow_threshold_s=1e9, sample_every=10**6)
+        with telemetry.span("plain"):
+            pass
+        with telemetry.span(
+            "flushlike", links=[TraceContext("t1", "s1")]
+        ) as linked:
+            pass
+        stats = telemetry.trace_stats()
+        assert stats["kept"] == 1 and stats["sampled_out"] == 1
+        assert telemetry.get_trace(linked.trace_id) is not None
+
+    def test_policy_is_reported(self):
+        policy = telemetry.set_trace_policy(
+            slow_threshold_s=0.5, sample_every=3
+        )
+        assert policy == {"slow_threshold_s": 0.5, "sample_every": 3}
+        assert telemetry.trace_stats()["policy"] == policy
+
+
+# --------------------------------------------------------------------------- #
+# disabled-mode no-op
+# --------------------------------------------------------------------------- #
+
+
+class TestDisabledNoOp:
+    def test_disabled_spans_carry_no_context_and_record_nothing(self):
+        telemetry.disable()
+        try:
+            with telemetry.span("invisible", rows=3) as sp:
+                sp.set_attrs(more=1)
+                assert telemetry.current_context() is None
+            assert sp.trace_id is None and sp.span_id is None
+            with telemetry.with_context(TraceContext("t", "s")):
+                with telemetry.span("still-invisible"):
+                    pass
+        finally:
+            telemetry.enable()
+        stats = telemetry.trace_stats()
+        assert stats["kept"] == 0 and stats["sampled_out"] == 0
+        assert telemetry.recent_traces() == []
+        assert telemetry.get_trace("t") is None

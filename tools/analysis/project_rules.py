@@ -13,6 +13,10 @@ now enforced statically.
   every ``record_event`` kind appears in ``docs/observability.md`` (the
   public schema, §6: renaming is a dashboard-breaking change), and vice
   versa — a documented-but-unregistered name is doc rot.
+* OBS005 — every literal span name opened via ``telemetry.span(...)`` /
+  ``utils.logging.phase(...)`` has a row in the ``docs/observability.md``
+  §2 span table, and vice versa (trace dashboards and saved Perfetto
+  queries key on span names exactly like metric names).
 * SLP001 — tests must not call ``time.sleep``: the FakeClock policy
   (``resilience.faults.FakeClock``) that kept tier-1 at zero real sleeps,
   previously enforced only by review.
@@ -523,6 +527,81 @@ def check_events_exist(project: Project) -> List[Finding]:
                     lineno,
                     f"documented event kind {kind!r} is never recorded "
                     "anywhere in isoforest_tpu/ — doc rot",
+                )
+            )
+    return findings
+
+
+SPAN_CALLS = {"span", "phase", "_span", "_telemetry_span"}
+
+
+def literal_span_names(project: Project) -> List[Tuple[str, str, int]]:
+    """(name, file, line) for every literal span name opened via
+    ``telemetry.span(...)`` / ``utils.logging.phase(...)`` — by the
+    conventional call names (attribute calls included) or any import alias
+    of ``span``/``phase``. Dynamic names (e.g. ``phase()``'s pass-through
+    inside utils/logging.py) are naturally skipped."""
+    out: List[Tuple[str, str, int]] = []
+    for f in project.package_files():
+        if f.tree is None or f.rel.endswith("telemetry/spans.py"):
+            continue
+        names = SPAN_CALLS | _aliases_of(f.tree, {"span", "phase"})
+        for node in ast.walk(f.tree):
+            if (
+                isinstance(node, ast.Call)
+                and call_name(node) in names
+                and node.args
+            ):
+                name = str_const(node.args[0])
+                if name:
+                    out.append((name, f.rel, node.lineno))
+    return out
+
+
+def documented_spans(project: Project) -> List[Tuple[str, int]]:
+    """Span names from the docs/observability.md §2 table."""
+    if project.observability_doc is None:
+        return []
+    rows = _doc_section(project.observability_doc, "## 2.")
+    out: List[Tuple[str, int]] = []
+    for token, lineno in _table_first_cell_tokens(rows):
+        if re.fullmatch(r"[a-z_]+(\.[a-z_]+)*", token):
+            out.append((token, lineno))
+    return out
+
+
+@rule("OBS005", "span names: code literals ⇄ the docs §2 span table")
+def check_spans_documented(project: Project) -> List[Finding]:
+    """Both directions of the span-name contract (the OBS001/OBS002 shape
+    for spans): every literal span name opened in the package must have a
+    row in the docs §2 table, and every documented span name must still be
+    opened somewhere — a renamed span silently breaks every saved Perfetto
+    query and the `isoforest_span_seconds{span=}` dashboards."""
+    findings: List[Finding] = []
+    opened = literal_span_names(project)
+    documented = documented_spans(project)
+    documented_names = {name for name, _ in documented}
+    for name, rel, lineno in opened:
+        if name not in documented_names:
+            findings.append(
+                Finding(
+                    "OBS005",
+                    rel,
+                    lineno,
+                    f"span {name!r} is opened here but has no row in the "
+                    f"{OBS_DOC} §2 span table (the public schema, its §6)",
+                )
+            )
+    opened_names = {name for name, _, _ in opened}
+    for name, lineno in documented:
+        if name not in opened_names:
+            findings.append(
+                Finding(
+                    "OBS005",
+                    OBS_DOC,
+                    lineno,
+                    f"documented span {name!r} is never opened anywhere in "
+                    "isoforest_tpu/ — doc rot",
                 )
             )
     return findings

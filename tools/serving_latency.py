@@ -18,7 +18,12 @@ Drives a running ``python -m isoforest_tpu serve`` deployment over HTTP
   tail;
 * **parity**: with ``--model``, every response is cross-checked against a
   direct in-process ``model.score`` on the same rows — coalescing must be
-  BITWISE invisible to the caller (scores serialise via repr round-trip).
+  BITWISE invisible to the caller (scores serialise via repr round-trip);
+* **trace**: a subset of requests carries a client-minted
+  ``X-Isoforest-Trace`` id — the response must echo it, ``GET /trace``
+  must reconstruct the request with the shared flush span *linking* the
+  request span, and the slowest traced request is broken down into queue
+  wait vs coalesced scoring vs demux/encode (docs/observability.md §9).
 
 Typical CI smoke (the serving step in ci.yml):
 
@@ -56,19 +61,22 @@ import numpy as np  # noqa: E402
 SCORE_ROUTE = "/score"
 
 
-def _post(url: str, rows, timeout: float = 30.0):
-    """POST one JSON batch; returns (status, parsed-body-or-None)."""
+def _post(url: str, rows, timeout: float = 30.0, trace_id: str = None):
+    """POST one JSON batch; returns (status, parsed-body-or-None,
+    response-headers). ``trace_id`` rides the ``X-Isoforest-Trace``
+    request header (docs/observability.md §9)."""
     body = json.dumps({"rows": [[float(v) for v in r] for r in rows]}).encode()
-    req = urllib.request.Request(
-        url + SCORE_ROUTE, data=body, headers={"Content-Type": "application/json"}
-    )
+    headers = {"Content-Type": "application/json"}
+    if trace_id:
+        headers["X-Isoforest-Trace"] = trace_id
+    req = urllib.request.Request(url + SCORE_ROUTE, data=body, headers=headers)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.status, json.loads(resp.read())
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
     except urllib.error.HTTPError as exc:
-        return exc.code, None
+        return exc.code, None, dict(exc.headers or {})
     except Exception:
-        return -1, None
+        return -1, None, {}
 
 
 def _closed_loop(url, rows_pool, concurrency, duration, rows_per_request):
@@ -89,7 +97,7 @@ def _closed_loop(url, rows_pool, concurrency, duration, rows_per_request):
         while time.perf_counter() < stop:
             start = rng.integers(0, max(1, len(rows_pool) - rows_per_request))
             batch = rows_pool[start : start + rows_per_request]
-            status, doc = _post(url, batch)
+            status, doc, _ = _post(url, batch)
             with lock:
                 if status == 200:
                     stats["requests"] += 1
@@ -152,7 +160,7 @@ def _open_loop(url, rows_pool, rps, duration, rows_per_request, max_inflight=64)
 
         def fire(batch=batch):
             try:
-                status, _ = _post(url, batch)
+                status, _, _ = _post(url, batch)
                 with lock:
                     stats["status"][status] = stats["status"].get(status, 0) + 1
             finally:
@@ -232,7 +240,7 @@ def _check_parity(url, model_dir, rows_pool, n_rows):
     direct = [float(s) for s in model.score(rows)]
     mismatches = []
     # one batch request
-    status, doc = _post(url, rows)
+    status, doc, _ = _post(url, rows)
     if status != 200:
         return {"pass": False, "error": f"batch parity request -> HTTP {status}"}
     for i, (got, want) in enumerate(zip(doc["scores"], direct)):
@@ -241,7 +249,7 @@ def _check_parity(url, model_dir, rows_pool, n_rows):
     # single-row requests (these coalesce server-side under load; alone
     # they still traverse the same padded bucket)
     for i in range(min(8, n_rows)):
-        status, doc = _post(url, rows[i : i + 1])
+        status, doc, _ = _post(url, rows[i : i + 1])
         if status != 200 or doc["scores"][0] != direct[i]:
             mismatches.append(
                 {
@@ -252,6 +260,83 @@ def _check_parity(url, model_dir, rows_pool, n_rows):
                 }
             )
     return {"pass": not mismatches, "rows": n_rows, "mismatches": mismatches[:5]}
+
+
+def _trace_phase(url, rows_pool, rows_per_request, n_requests=6):
+    """Trace round-trip check (docs/observability.md §9): send a subset of
+    requests with a client-minted ``X-Isoforest-Trace`` id, assert the
+    response echoes it, then reconstruct each trace via ``GET /trace`` and
+    assert the shared flush span **links** at least one request span. The
+    worst (slowest) traced request gets a per-phase breakdown: queue wait
+    vs coalesced scoring vs demux/encode."""
+    import os
+
+    sent = []
+    for i in range(n_requests):
+        trace_id = f"lat-{os.getpid()}-{i}"
+        start = (i * rows_per_request) % max(1, len(rows_pool) - rows_per_request)
+        batch = rows_pool[start : start + rows_per_request]
+        status, _, headers = _post(url, batch, trace_id=trace_id)
+        sent.append(
+            {
+                "trace_id": trace_id,
+                "status": status,
+                "echoed": headers.get("X-Isoforest-Trace"),
+            }
+        )
+    echo_ok = all(r["status"] == 200 and r["echoed"] == r["trace_id"] for r in sent)
+
+    linked_requests = 0
+    worst = None
+    for r in sent:
+        if r["status"] != 200:
+            continue
+        try:
+            with urllib.request.urlopen(
+                url + f"/trace?trace_id={r['trace_id']}&format=spans", timeout=10
+            ) as resp:
+                tdoc = json.loads(resp.read())
+        except Exception:
+            continue
+        root = next(
+            (s for s in tdoc.get("spans", []) if s["name"] == "serving.request"),
+            None,
+        )
+        if root is None:
+            continue
+        attrs = root.get("attrs", {})
+        # the shared flush span must LINK this request's span (not parent
+        # it — the flush serves N requests on its own thread)
+        flush_wall = 0.0
+        for linked in tdoc.get("linked", []):
+            for s in linked.get("spans", []):
+                if s["name"] != "serving.flush":
+                    continue
+                if any(link[0] == tdoc["trace_id"] for link in s.get("links", [])):
+                    linked_requests += 1
+                    flush_wall = s["wall_s"]
+                    break
+            else:
+                continue
+            break
+        wall = root["wall_s"]
+        queue_wait = float(attrs.get("queue_wait_s") or 0.0)
+        breakdown = {
+            "trace_id": r["trace_id"],
+            "wall_ms": round(wall * 1e3, 3),
+            "queue_wait_ms": round(queue_wait * 1e3, 3),
+            "score_ms": round(flush_wall * 1e3, 3),
+            "demux_ms": round(max(wall - queue_wait - flush_wall, 0.0) * 1e3, 3),
+        }
+        if worst is None or wall > worst["wall_ms"] / 1e3:
+            worst = breakdown
+    return {
+        "requests": len(sent),
+        "echo_ok": echo_ok,
+        "linked_requests": linked_requests,
+        "worst_request": worst,
+        "pass": echo_ok and linked_requests >= 1,
+    }
 
 
 SERVING_SERIES = (
@@ -358,6 +443,11 @@ def main() -> None:
             url, rows_pool, args.rps, args.duration, args.rows_per_request
         )
         print(json.dumps({"phase": "open_loop", **open_loop}), flush=True)
+
+    trace = _trace_phase(url, rows_pool, args.rows_per_request)
+    print(json.dumps({"phase": "trace", **trace}), flush=True)
+    if not trace["pass"]:
+        failed.append("trace")
 
     latency = _server_histogram_summary(url)
     print(json.dumps({"phase": "server_latency", "histogram": latency}), flush=True)
