@@ -502,6 +502,42 @@ def _roofline(strategy: str, n: int, f: int, elapsed_s: float, platform: str) ->
     return out
 
 
+def _write_failure_bundle(reason: str) -> str | None:
+    """Flight recorder (docs/observability.md §10): a timeout-killed or
+    crashed bench run dumps everything the process knows — traces, event
+    timeline, metrics, degradation rungs, autotune table, compile log,
+    memory watermarks — into one attachable artifact, so the postmortem
+    starts from evidence instead of a dead log line. Returns the path
+    written, or None (the recorder must never mask the original failure)."""
+    try:
+        from isoforest_tpu.telemetry import write_bundle
+
+        path = f"debug_bundle_{reason}.json"
+        write_bundle(path)
+        print(f"[bench] wrote failure debug bundle -> {path}", file=sys.stderr)
+        return path
+    except Exception as exc:
+        print(f"[bench] debug bundle write failed: {exc!r}", file=sys.stderr)
+        return None
+
+
+def _install_flight_recorder() -> None:
+    """Arm SIGTERM (what ``timeout`` sends when the driver kills a wedged
+    run) to write the debug bundle before dying; the re-raise with default
+    semantics keeps the exit status reporting the kill."""
+    import signal
+
+    def _on_term(signum, frame):
+        _write_failure_bundle("timeout")
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        signal.raise_signal(signal.SIGTERM)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass  # not the main thread (imported under a test harness)
+
+
 def main() -> None:
     backend = _ensure_live_backend()
     platform = backend if backend != "cpu_fallback" else "cpu"
@@ -637,6 +673,18 @@ def main() -> None:
                 # typically source="pin")
                 "autotune_table": tuning.table_snapshot()["entries"],
                 "autotune_decisions": tuning.decision_counts(),
+                # resource plane (docs/observability.md §10): where the
+                # run's XLA compile time went, the streaming executor's
+                # peak double-buffer staging, and the packed scoring-plane
+                # bytes resident at the end, split host/device
+                "compile_seconds": round(telemetry.compile_seconds_total(), 3),
+                "compile_count": telemetry.compile_counts()["total"],
+                "peak_host_staging_bytes": telemetry.peak_host_staging_bytes(),
+                "resident_plane_bytes": {
+                    k: v
+                    for k, v in telemetry.resident_plane_bytes().items()
+                    if k in ("host", "device")
+                },
             }
         )
     )
@@ -741,8 +789,13 @@ def full_sweep() -> None:
 
 
 if __name__ == "__main__":
-    if "--full" in sys.argv:
-        _ensure_live_backend()
-        full_sweep()
-    else:
-        main()
+    _install_flight_recorder()
+    try:
+        if "--full" in sys.argv:
+            _ensure_live_backend()
+            full_sweep()
+        else:
+            main()
+    except Exception:
+        _write_failure_bundle("failure")
+        raise

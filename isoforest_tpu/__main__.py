@@ -13,6 +13,8 @@ operational surface here is a small CLI over CSV files:
         [--input data.csv [--model /tmp/model]]
     python -m isoforest_tpu trace out.json \\
         [--input data.csv [--model /tmp/model]]
+    python -m isoforest_tpu debug-bundle out.json \\
+        [--input data.csv [--model /tmp/model]]
     python -m isoforest_tpu diagnose /tmp/model [--format json|prometheus]
     python -m isoforest_tpu monitor /tmp/model --input live.csv \\
         [--threshold 0.25] [--port 9101] [--format json|prometheus]
@@ -270,6 +272,52 @@ def cmd_trace(args) -> int:
                 "spans": chosen["spans"],
                 "wall_s": chosen["wall_s"],
                 "output": args.output,
+            }
+        )
+    )
+    return 0
+
+
+def cmd_debug_bundle(args) -> int:
+    """Run an instrumented workload and write the flight-recorder debug
+    bundle (docs/observability.md §10): recent traces, the event timeline
+    tail, a metrics snapshot, degradation rungs, the autotune winner
+    table, the compile log and memory watermarks — one attachable JSON
+    artifact. Workload selection matches ``telemetry``/``trace``:
+    ``--input`` CSV (scored with ``--model`` when given, else fit+scored),
+    or a small synthetic mixture.
+    """
+    from . import telemetry
+
+    telemetry.set_trace_policy(slow_threshold_s=0.0, sample_every=1)
+    if args.input:
+        X, _ = _load(args.input, args.labeled)
+        if args.model:
+            model = _load_model(args.model)
+        else:
+            from .models import IsolationForest
+
+            model = IsolationForest(
+                num_estimators=args.trees, random_seed=1
+            ).fit(X)
+    else:
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(args.rows, 4)).astype(np.float32)
+        X[: max(1, args.rows // 100)] += 4.0
+        from .models import IsolationForest
+
+        model = IsolationForest(num_estimators=args.trees, random_seed=1).fit(X)
+    model.score(X)
+    bundle = telemetry.write_bundle(args.output)
+    print(
+        json.dumps(
+            {
+                "output": args.output,
+                "schema": bundle["schema"],
+                "sections": sorted(k for k in bundle if k != "schema"),
+                "compiles": bundle["compiles"]["total"],
+                "traces": len(bundle["traces"]),
+                "events": len(bundle["events"]),
             }
         )
     )
@@ -621,6 +669,19 @@ def build_parser() -> argparse.ArgumentParser:
     trc.add_argument("--rows", type=int, default=4096, help="synthetic workload rows")
     trc.add_argument("--trees", type=int, default=50)
     trc.set_defaults(func=cmd_trace)
+
+    dbg = sub.add_parser(
+        "debug-bundle",
+        help="run an instrumented workload and write the flight-recorder "
+        "debug bundle (one JSON artifact)",
+    )
+    dbg.add_argument("output", help="debug-bundle JSON output path")
+    dbg.add_argument("--input", default=None, help="CSV workload (default: synthetic)")
+    dbg.add_argument("--model", default=None, help="score with a saved model")
+    dbg.add_argument("--labeled", action="store_true")
+    dbg.add_argument("--rows", type=int, default=4096, help="synthetic workload rows")
+    dbg.add_argument("--trees", type=int, default=50)
+    dbg.set_defaults(func=cmd_debug_bundle)
 
     diag = sub.add_parser(
         "diagnose", help="forest-structure diagnostics for a saved model"

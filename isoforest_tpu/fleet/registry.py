@@ -64,6 +64,7 @@ from ..resilience import faults
 from ..resilience.degradation import degrade
 from ..serving.coalescer import CoalescerClosedError, ServingError
 from ..serving.service import ScoringService, ServingConfig
+from ..telemetry import resources as _resources
 from ..telemetry.events import record_event
 from ..telemetry.metrics import counter as _counter, gauge as _gauge
 from ..utils.logging import logger
@@ -165,6 +166,9 @@ class ManagedEntry:
         self.manager = None
         self.service: Optional[ScoringService] = None
         self.resident_bytes = 0
+        # host/device split of resident_bytes (telemetry.resources
+        # .model_plane_bytes): placement='device' on accelerator backends
+        self.plane_bytes: Optional[dict] = None
         self.loads = 0
         self.last_used = 0  # registry LRU sequence number
         self.last_load_error: Optional[str] = None
@@ -195,6 +199,7 @@ class ManagedEntry:
             "model_dir": self.model_dir,
             "resident": service is not None,
             "resident_bytes": self.resident_bytes,
+            "plane_bytes": dict(self.plane_bytes) if self.plane_bytes else None,
             "loads": self.loads,
             "last_used_seq": self.last_used,
             "pinned": self.pinned,
@@ -359,32 +364,48 @@ class ModelRegistry:
 
         t0 = time.perf_counter()
         try:
-            faults.check_fleet_load(entry.model_id)
-            model = load_model(entry.model_dir)
-            manager = None
-            if entry.lifecycle and model.baseline is not None:
-                manager = ModelManager(
-                    model,
-                    work_dir=entry.work_dir,
+            # a tenant's lazy first load (or post-eviction re-load) is an
+            # EXPECTED one-time cost: any compile it triggers attributes
+            # to fleet.load and ticks phase=warmup even after serving has
+            # marked steady (docs/observability.md §10)
+            with _resources.warmup_scope(), _resources.compile_scope(
+                "fleet.load", key=entry.model_id
+            ):
+                faults.check_fleet_load(entry.model_id)
+                model = load_model(entry.model_dir)
+                manager = None
+                if entry.lifecycle and model.baseline is not None:
+                    manager = ModelManager(
+                        model,
+                        work_dir=entry.work_dir,
+                        model_id=entry.model_id,
+                        **entry.manager_kwargs,
+                    )
+                elif entry.lifecycle:
+                    logger.warning(
+                        "fleet: %s (%s) has no _BASELINE.json sidecar — "
+                        "serving WITHOUT the lifecycle manager (no "
+                        "drift-triggered retraining); refit and re-save to "
+                        "enable it",
+                        entry.model_id,
+                        entry.model_dir,
+                    )
+                active = manager.model if manager is not None else model
+                # ROADMAP item 2 follow-on: the budget bounds the SCARCE
+                # placement — actual device bytes when committed puts land
+                # the packed planes on an accelerator, host bytes on CPU
+                planes = _resources.model_plane_bytes(active)
+                nbytes = (
+                    planes["device"]
+                    if planes["placement"] == "device"
+                    else planes["host"]
+                )
+                service = ScoringService(
+                    model=None if manager is not None else model,
+                    manager=manager,
+                    config=entry.config,
                     model_id=entry.model_id,
-                    **entry.manager_kwargs,
                 )
-            elif entry.lifecycle:
-                logger.warning(
-                    "fleet: %s (%s) has no _BASELINE.json sidecar — serving "
-                    "WITHOUT the lifecycle manager (no drift-triggered "
-                    "retraining); refit and re-save to enable it",
-                    entry.model_id,
-                    entry.model_dir,
-                )
-            active = manager.model if manager is not None else model
-            nbytes = layout_nbytes(active)
-            service = ScoringService(
-                model=None if manager is not None else model,
-                manager=manager,
-                config=entry.config,
-                model_id=entry.model_id,
-            )
         except Exception as exc:
             entry.last_load_error = repr(exc)
             degrade(
@@ -405,6 +426,7 @@ class ModelRegistry:
         entry.manager = manager
         entry.service = service
         entry.resident_bytes = nbytes
+        entry.plane_bytes = planes
         entry.loads += 1
         entry.last_load_error = None
         with self._lock:
@@ -414,10 +436,17 @@ class ModelRegistry:
         _RESIDENT_MODELS.set(resident)
         _RESIDENT_BYTES.set(resident_bytes)
         _LOADS_TOTAL.inc(model_id=entry.model_id)
+        _resources.account_resident_plane(
+            entry.model_id,
+            planes["host"],
+            planes["device"],
+            plane=planes["plane"],
+        )
         record_event(
             "fleet.load",
             model_id=entry.model_id,
             bytes=nbytes,
+            placement=planes["placement"],
             generation=entry.generation,
             load_seconds=round(time.perf_counter() - t0, 6),
             resident_models=resident,
@@ -518,6 +547,7 @@ class ModelRegistry:
             entry.manager = None
             entry.service = None
             entry.resident_bytes = 0
+            entry.plane_bytes = None
         with self._lock:
             self._resident_bytes -= freed
             resident = sum(1 for e in self._entries.values() if e.resident)
@@ -525,6 +555,7 @@ class ModelRegistry:
         _RESIDENT_MODELS.set(resident)
         _RESIDENT_BYTES.set(resident_bytes)
         _EVICTIONS_TOTAL.inc(cause=cause)
+        _resources.release_resident_plane(entry.model_id)
         record_event(
             "fleet.evict",
             model_id=entry.model_id,

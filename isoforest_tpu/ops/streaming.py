@@ -67,6 +67,7 @@ import numpy as np
 from ..resilience import faults
 from ..resilience.degradation import degrade
 from ..telemetry import _state as _telemetry_state
+from ..telemetry import resources as _resources
 from ..telemetry.events import record_event
 from ..telemetry.metrics import counter as _telemetry_counter
 from ..telemetry.metrics import gauge as _telemetry_gauge
@@ -277,18 +278,35 @@ class StreamingExecutor:
         return self._run_streamed(X, n)
 
     def _run_single(self, X, n: int) -> np.ndarray:
-        # one chunk: nothing to overlap — keep the historical upload-pad-run
-        # shape (and its exact ``owned`` donation semantics) verbatim
-        Xc = jnp.asarray(X, jnp.float32)
-        owned = Xc is not X
+        # one chunk: nothing to overlap. Host inputs pad host-side and the
+        # result slices host-side: ``jnp.pad`` / a lazy ``[:n]`` each
+        # compile a tiny program per exact row count, which would tick the
+        # steady-phase compile counter on every novel batch size even when
+        # the bucket-shaped scoring program is warm — only the bucket shape
+        # may touch XLA (docs/observability.md §10)
         padded = self._single_pad(n) if self._single_pad is not None else n
         pad = padded - n
-        if pad:
-            Xc = jnp.pad(Xc, ((0, pad), (0, 0)))
+        if not isinstance(X, jax.Array):
+            Xnp = np.asarray(X, np.float32)
+            if pad:
+                Xnp = np.pad(Xnp, ((0, pad), (0, 0)))
+            Xc = jnp.asarray(Xnp, jnp.float32)
             owned = True
+        else:
+            Xc = jnp.asarray(X, jnp.float32)
+            owned = Xc is not X
+            if pad:
+                Xc = jnp.pad(Xc, ((0, pad), (0, 0)))
+                owned = True
         if _telemetry_state.enabled():
             _PIPELINE_CHUNKS.inc(1, site=self._site)
-        return np.asarray(self._run_chunk(Xc, owned)[:n])
+        # the executor is the one shared dispatch seam for every chunked
+        # scoring path, so an XLA compile fired by this call attributes
+        # here by default; semantic callers (serving.prewarm, autotune
+        # probes) wrap their own outer compile_scope and win attribution
+        with _resources.compile_scope(self._site, key=f"rows={padded}"):
+            scores = self._run_chunk(Xc, owned)
+        return np.asarray(scores)[:n]
 
     def _run_streamed(self, X, n: int) -> np.ndarray:
         chunk = self.chunk_rows
@@ -311,6 +329,12 @@ class StreamingExecutor:
         stager = (
             _HostStager(chunk, int(X.shape[1])) if (host and committed) else None
         )
+        if stager is not None:
+            # both reusable staging buffers, live for the whole streamed
+            # run — the host-memory watermark the resource plane reports
+            _resources.note_host_staging(
+                self._site, 2 * chunk * int(X.shape[1]) * 4
+            )
         t_start = self._clock()
         h2d_s = 0.0
         parts = []
@@ -337,6 +361,13 @@ class StreamingExecutor:
                         if self._sharding is not None
                         else jax.device_put(buf)
                     )
+                elif host:
+                    # same per-exact-n compile hazard as _run_single: pad
+                    # the tail host-side so only the chunk shape hits XLA
+                    buf = np.asarray(X[start:stop], np.float32)
+                    if valid < chunk:
+                        buf = np.pad(buf, ((0, chunk - valid), (0, 0)))
+                    dev = jnp.asarray(buf, jnp.float32)
                 else:
                     dev = jnp.asarray(X[start:stop], jnp.float32)
                     if valid < chunk:
@@ -344,7 +375,10 @@ class StreamingExecutor:
                 chunk_h2d = self._clock() - t0
                 h2d_s += chunk_h2d
                 t1 = self._clock()
-                scores = self._run_chunk(dev, True)
+                with _resources.compile_scope(
+                    self._site, key=f"rows={chunk}"
+                ):
+                    scores = self._run_chunk(dev, True)
                 dispatch_s = self._clock() - t1
                 t2 = self._clock()
                 if pending is not None:
@@ -354,9 +388,11 @@ class StreamingExecutor:
                     compute_dispatch_s=round(dispatch_s, 6),
                     d2h_s=round(self._clock() - t2, 6),
                 )
-                pending = scores[:valid] if valid < chunk else scores
+                # the tail slice happens host-side after the fetch — a lazy
+                # device [:valid] would compile per exact tail size
+                pending = scores
             n_chunks += 1
-        parts.append(np.asarray(pending))
+        parts.append(np.asarray(pending)[:valid])
         total_s = max(self._clock() - t_start, 1e-9)
         if _telemetry_state.enabled():
             eff = max(0.0, min(1.0, 1.0 - h2d_s / total_s))

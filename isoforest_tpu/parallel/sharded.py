@@ -21,6 +21,7 @@ from ..ops.streaming import StreamingExecutor, pipeline_enabled, resolve_chunk_r
 from ..ops.traversal import donation_supported, path_lengths
 from ..ops.tree_growth import StandardForest, grow_forest
 from ..resilience.degradation import degrade
+from ..telemetry import resources as _resources
 from ..utils.math import score_from_path_length
 from .mesh import DATA_AXIS, TREES_AXIS, shard_map_compat
 
@@ -161,7 +162,12 @@ def _grow_sharded(mesh, tree_keys, X, bag_idx, feat_idx, height, extension_level
     bag_idx, _ = _pad_axis(bag_idx, 0, n_shards)
     feat_idx, _ = _pad_axis(feat_idx, 0, n_shards)
     f = _grow_program(mesh, height, extension_level)
-    forest = f(tree_keys, X, bag_idx, feat_idx)
+    # the lru_cached builder only wraps jit — the XLA compile fires on the
+    # first CALL for a shape, so the scope wraps the call, not the builder
+    with _resources.compile_scope(
+        "sharded_grow", key=f"trees={tree_keys.shape[0]}"
+    ):
+        forest = f(tree_keys, X, bag_idx, feat_idx)
     if pad:
         forest = jax.tree_util.tree_map(lambda a: a[: a.shape[0] - pad], forest)
     return forest
@@ -353,7 +359,8 @@ def sharded_score_2d(
         strategy,
         donate,
     )
-    return np.asarray(f(forest_p, Xp)[:n])
+    with _resources.compile_scope("sharded_2d", key=f"rows={Xp.shape[0]}"):
+        return np.asarray(f(forest_p, Xp)[:n])
 
 
 @functools.lru_cache(maxsize=64)
@@ -459,4 +466,5 @@ def sharded_score(
         strategy,
         donate,
     )
-    return np.asarray(f(forest, Xp)[:n])
+    with _resources.compile_scope("sharded", key=f"rows={Xp.shape[0]}"):
+        return np.asarray(f(forest, Xp)[:n])

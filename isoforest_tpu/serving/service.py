@@ -154,6 +154,7 @@ class ScoringService:
         one ``serving.warmup`` event naming the warmed buckets and the
         resolved strategies; returns the per-bucket decisions."""
         from ..ops.traversal import batch_bucket
+        from ..telemetry import resources
         from .. import tuning
 
         model = self.model
@@ -162,20 +163,26 @@ class ScoringService:
         buckets = sorted({batch_bucket(b) for b in sizes if b >= 1})
         width = max(int(model.total_num_features), 1)
         decisions = []
-        for bucket in buckets:
-            dummy = np.zeros((bucket, width), np.float32)
-            d = tuning.resolve_decision(
-                model.forest, dummy, model.num_samples, site="serving.prewarm"
-            )
-            decisions.append(
-                {
-                    "bucket": bucket,
-                    "strategy": d.strategy,
-                    "source": d.source,
-                    "key": d.key,
-                }
-            )
-        model.warmup(batch_sizes=buckets)
+        # prewarm IS the warmup phase: every compile here attributes to
+        # serving.prewarm and ticks phase=warmup even when a later
+        # re-warm runs after mark_steady() (docs/observability.md §10)
+        with resources.warmup_scope(), resources.compile_scope(
+            "serving.prewarm", key=",".join(str(b) for b in buckets)
+        ):
+            for bucket in buckets:
+                dummy = np.zeros((bucket, width), np.float32)
+                d = tuning.resolve_decision(
+                    model.forest, dummy, model.num_samples, site="serving.prewarm"
+                )
+                decisions.append(
+                    {
+                        "bucket": bucket,
+                        "strategy": d.strategy,
+                        "source": d.source,
+                        "key": d.key,
+                    }
+                )
+            model.warmup(batch_sizes=buckets)
         if buckets:
             self._max_warm_bucket = max(buckets)
         record_event(
@@ -298,6 +305,12 @@ def serve_model(
     server = _telemetry_serve(port=port, host=host)
     mount(server, service)
     service.prewarm(warm_batch_sizes)
+    # warmed shapes are now compiled: any compile a live request triggers
+    # from here on ticks isoforest_compiles_total{phase="steady"} — the
+    # recompile-storm anomaly signal CI gates at zero
+    from ..telemetry.resources import mark_steady
+
+    mark_steady()
     _event(
         "serving.start",
         port=server.port,

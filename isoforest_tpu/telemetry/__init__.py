@@ -8,7 +8,7 @@ work flowed through which kernel (metrics), and every operational incident
 in causal order (events: degradation rungs, retries, watchdog timeouts,
 checkpoint seals/resumes, distributed bring-up attempts).
 
-Seven coordinated pieces, stdlib-only:
+Eight coordinated pieces, stdlib-only (plus one jax.monitoring hook):
 
 * :mod:`.spans` — nestable, thread-safe span tracer with wall/process time,
   optional ``jax.profiler.TraceAnnotation`` pass-through, and request-scoped
@@ -27,8 +27,15 @@ Seven coordinated pieces, stdlib-only:
   packed scoring layout;
 * :mod:`.http` — a stdlib HTTP daemon serving ``/metrics`` (Prometheus),
   ``/healthz`` (heartbeat liveness), ``/snapshot`` (JSON), ``/trace`` +
-  ``/traces/recent`` (Perfetto-loadable request traces), started via
-  :func:`serve` or ``ISOFOREST_TPU_METRICS_PORT``.
+  ``/traces/recent`` (Perfetto-loadable request traces), ``/debug/bundle``
+  (the flight-recorder artifact), started via :func:`serve` or
+  ``ISOFOREST_TPU_METRICS_PORT``;
+* :mod:`.resources` — the resource observability plane (docs/observability
+  .md §10): XLA compile accounting via a ``jax.monitoring`` listener with
+  ``compile_scope`` attribution and a warmup/steady phase, host-staging and
+  resident-plane memory watermarks, and the ``build_bundle`` flight
+  recorder behind ``GET /debug/bundle`` /
+  ``python -m isoforest_tpu debug-bundle``.
 
 Telemetry is ON by default and near-zero cost when disabled
 (``ISOFOREST_TPU_TELEMETRY=0`` or :func:`disable`; the enabled-vs-disabled
@@ -70,6 +77,28 @@ from .monitor import (
     ks,
     psi,
 )
+from .resources import (
+    BUNDLE_SCHEMA,
+    BUNDLE_SECTIONS,
+    build_bundle,
+    compile_counts,
+    compile_log,
+    compile_scope,
+    compile_seconds_total,
+    disable_resources,
+    enable_resources,
+    mark_steady,
+    mark_warmup,
+    memory_watermarks,
+    model_plane_bytes,
+    note_host_staging,
+    peak_host_staging_bytes,
+    reset_resources,
+    resident_plane_bytes,
+    resources_enabled,
+    warmup_scope,
+    write_bundle,
+)
 from .spans import (
     SpanRecord,
     TraceContext,
@@ -89,6 +118,8 @@ from .spans import records as span_records
 from .spans import summary as span_summary
 
 __all__ = [
+    "BUNDLE_SCHEMA",
+    "BUNDLE_SECTIONS",
     "DEFAULT_LATENCY_BUCKETS",
     "Baseline",
     "Counter",
@@ -103,12 +134,19 @@ __all__ = [
     "StreamBaseline",
     "TraceContext",
     "active_server",
+    "build_bundle",
     "capture_baseline",
+    "compile_counts",
+    "compile_log",
+    "compile_scope",
+    "compile_seconds_total",
     "counter",
     "current_context",
     "current_span_name",
     "disable",
+    "disable_resources",
     "enable",
+    "enable_resources",
     "enabled",
     "exponential_buckets",
     "forest_diagnostics",
@@ -117,15 +155,24 @@ __all__ = [
     "get_trace",
     "histogram",
     "ks",
+    "mark_steady",
+    "mark_warmup",
     "maybe_serve_from_env",
+    "memory_watermarks",
+    "model_plane_bytes",
+    "note_host_staging",
     "parse_prometheus",
+    "peak_host_staging_bytes",
     "psi",
     "publish_gauges",
     "recent_traces",
     "record_event",
     "registry",
     "reset",
+    "reset_resources",
     "reset_traces",
+    "resident_plane_bytes",
+    "resources_enabled",
     "seed_trace_ids",
     "serve",
     "set_span_attrs",
@@ -140,7 +187,9 @@ __all__ = [
     "to_chrome_trace_json",
     "to_prometheus",
     "trace_stats",
+    "warmup_scope",
     "with_context",
+    "write_bundle",
 ]
 
 # live /metrics endpoint opt-in: exporting ISOFOREST_TPU_METRICS_PORT makes

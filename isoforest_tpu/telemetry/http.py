@@ -26,6 +26,11 @@ only *reads* registries that are already thread-safe):
   Chrome trace-event JSON (``&format=spans`` for the raw span docs), and
   ``GET /traces/recent?limit=N`` — newest-first trace summaries plus the
   ring's drop accounting (docs/observability.md §9).
+* ``GET /debug/bundle`` — the flight-recorder bundle
+  (:func:`..resources.build_bundle`): traces, event timeline tail,
+  metrics, degradation rungs, autotune winner table, compile log and
+  memory watermarks in one downloadable artifact
+  (docs/observability.md §10).
 
 Start with ``telemetry.serve(port=...)`` (``port=0`` picks an ephemeral
 port, reported on the returned handle) or by exporting
@@ -60,6 +65,7 @@ _INDEX = (
     "  /snapshot       full JSON telemetry snapshot\n"
     "  /trace          one trace as Chrome trace-event JSON (?trace_id=<id>)\n"
     "  /traces/recent  newest-first trace summaries (?limit=N)\n"
+    "  /debug/bundle   flight-recorder debug bundle (one JSON artifact)\n"
 )
 
 # Refuse request bodies past this size before reading them into memory: the
@@ -146,6 +152,22 @@ class _Handler(BaseHTTPRequestHandler):
             }
             self._reply(
                 200,
+                "application/json",
+                json.dumps(doc, sort_keys=True) + "\n",
+            )
+        elif path == "/debug/bundle":
+            # the flight recorder: everything an operator needs to debug a
+            # bad deployment in ONE artifact — curl it before restarting
+            from . import resources
+
+            try:
+                doc = resources.build_bundle()
+                status = 200
+            except Exception as exc:  # the daemon must never die to this
+                doc = {"error": repr(exc), "status": 500}
+                status = 500
+            self._reply(
+                status,
                 "application/json",
                 json.dumps(doc, sort_keys=True) + "\n",
             )

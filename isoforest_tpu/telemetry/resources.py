@@ -1,0 +1,561 @@
+"""Resource observability plane: compile & memory accounting + flight recorder.
+
+The stack could already trace a request end-to-end (docs/observability.md
+§9) but was blind to the two resources that actually bound it:
+
+* **XLA compilation.** Every jitted program in the package compiles lazily
+  on first call of a new shape — a shape-churn recompile storm in serving
+  (bucket padding misconfigured, an unexpected batch size past the warmed
+  set) would surface only as a silent p99 cliff. jax fires
+  ``/jax/core/compile/backend_compile_duration`` through
+  :mod:`jax.monitoring` exactly once per *real* backend compile (cached
+  dispatches never fire it), so one registered listener turns every
+  compile into a metric tick, attributed to the program-build seam that
+  triggered it via a thread-local :func:`compile_scope` frame stack —
+  compiles are synchronous in the calling thread, so the innermost open
+  scope on the firing thread IS the attribution. Each compile also lands
+  in a bounded compile log (site, key, wall time, phase, and the
+  triggering ``trace_id`` when inside a request span). The process-wide
+  *phase* starts at ``warmup`` and flips to ``steady`` via
+  :func:`mark_steady` (serving calls it after prewarm); expected one-time
+  compiles after that point — autotuner probes, a fleet tenant's lazy
+  first load — run under :func:`warmup_scope` so
+  ``isoforest_compiles_total{phase="steady"}`` stays an anomaly detector:
+  nonzero means a live request paid an XLA compile.
+
+* **Memory.** The streaming executor reports its double host staging
+  buffers (``isoforest_host_staging_bytes{site}`` + a peak watermark),
+  and resident model representations report their packed plane bytes
+  split host/device (``isoforest_resident_plane_bytes{placement}``):
+  committed ``device_put``\\ s target an accelerator when one is live, so
+  on TPU/GPU the planes a resident model pins are *device* bytes — the
+  number the fleet residency budget must see (ROADMAP item 2 follow-on)
+  — while the CPU fallback keeps them host bytes.
+
+* **Flight recorder.** :func:`build_bundle` assembles one postmortem
+  artifact — recent traces, event-timeline tail, full metrics snapshot,
+  degradation ladder + rungs taken, autotune winner table, compile log,
+  memory watermarks, config/env fingerprint — served live at
+  ``GET /debug/bundle`` (telemetry/http.py), written by
+  ``python -m isoforest_tpu debug-bundle out.json``, and auto-written by
+  ``bench.py`` on a timeout-killed or failed run so wedged TPU rounds
+  finally leave evidence.
+
+Everything is gated on the shared telemetry switch (:mod:`._state`) AND
+``ISOFOREST_TPU_RESOURCES`` (default ON) so ``tools/bench_smoke.py`` can
+measure the plane's own overhead — CI bounds it at 3% like the other
+telemetry gates.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import _state
+from .events import record_event
+from .metrics import counter as _counter
+from .metrics import gauge as _gauge
+from .metrics import histogram as _histogram
+
+# the jax.monitoring event one real XLA backend compile fires exactly once
+# (cached jit dispatches never fire it) — the whole observatory hangs off it
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+COMPILE_LOG_MAX = 256
+
+PHASES = ("warmup", "steady")
+
+PLACEMENTS = ("host", "device")
+
+BUNDLE_SCHEMA = "isoforest-debug-bundle/1"
+
+_COMPILE_SECONDS = _histogram(
+    "isoforest_compile_seconds",
+    "XLA backend-compile wall-clock seconds, by triggering program-build "
+    "site (compile_scope attribution; 'unattributed' = no open scope)",
+    labelnames=("site",),
+)
+_COMPILES_TOTAL = _counter(
+    "isoforest_compiles_total",
+    "XLA backend compiles by site and phase; phase='steady' after "
+    "mark_steady() means a live request paid a compile (anomaly)",
+    labelnames=("site", "phase"),
+)
+_HOST_STAGING = _gauge(
+    "isoforest_host_staging_bytes",
+    "Live bytes in the streaming executor's double host staging buffers, "
+    "by call site (peak watermark in memory_watermarks())",
+    labelnames=("site",),
+)
+_RESIDENT_PLANE = _gauge(
+    "isoforest_resident_plane_bytes",
+    "Resident packed scoring-plane bytes by placement: 'device' when "
+    "committed puts target an accelerator, 'host' on the CPU fallback",
+    labelnames=("placement",),
+)
+
+_OFF_VALUES = frozenset({"0", "false", "off", "no", "disabled"})
+
+ENV_VAR = "ISOFOREST_TPU_RESOURCES"
+
+_LOCAL = threading.local()
+_LOCK = threading.Lock()
+_COMPILE_LOG: collections.deque = collections.deque(maxlen=COMPILE_LOG_MAX)
+_STAGING_PEAK: Dict[str, int] = {}
+_PLANES: Dict[str, Dict[str, int]] = {}
+_PHASE = "warmup"
+_LISTENER_INSTALLED = False
+_ENABLED = os.environ.get(ENV_VAR, "1").strip().lower() not in _OFF_VALUES
+
+
+def resources_enabled() -> bool:
+    """True when the resource plane records (both the shared telemetry
+    switch and ``ISOFOREST_TPU_RESOURCES`` are on)."""
+    return _ENABLED and _state.enabled()
+
+
+def enable_resources() -> None:
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable_resources() -> None:
+    """Stop recording (bench_smoke's overhead A/B lever); already-recorded
+    data stays readable."""
+    global _ENABLED
+    _ENABLED = False
+
+
+# --------------------------------------------------------------------------- #
+# compilation observatory
+# --------------------------------------------------------------------------- #
+
+
+def _frames() -> list:
+    frames = getattr(_LOCAL, "frames", None)
+    if frames is None:
+        frames = _LOCAL.frames = []
+    return frames
+
+
+@contextlib.contextmanager
+def compile_scope(site: str, key: Optional[str] = None):
+    """Attribute any XLA compile triggered inside the block to ``site``.
+
+    Scopes nest; attribution goes to the OUTERMOST frame — the semantic
+    seam (``serving.prewarm``, ``autotune.probe``) rather than the shared
+    executor underneath it — while every frame's ``key`` (shape detail,
+    bucket, decision key) is joined into the compile-log entry. Compiles
+    are synchronous in the calling thread, so a thread-local stack is
+    exact attribution with no cross-thread bookkeeping."""
+    if not resources_enabled():
+        yield
+        return
+    frames = _frames()
+    frames.append((str(site), None if key is None else str(key)))
+    try:
+        yield
+    finally:
+        frames.pop()
+
+
+def current_phase() -> str:
+    """This thread's effective compile phase: a :func:`warmup_scope`
+    override, else the process-wide phase."""
+    override = getattr(_LOCAL, "phase", None)
+    return override if override is not None else _PHASE
+
+
+def mark_steady() -> None:
+    """Flip the process-wide phase to ``steady`` — every compile after this
+    point (outside a :func:`warmup_scope`) is an anomaly. Serving calls it
+    once prewarm has compiled the warmed buckets."""
+    global _PHASE
+    _PHASE = "steady"
+
+
+def mark_warmup() -> None:
+    """Reset the process-wide phase to ``warmup`` (tests, re-warming)."""
+    global _PHASE
+    _PHASE = "warmup"
+
+
+@contextlib.contextmanager
+def warmup_scope():
+    """Treat compiles inside the block as ``warmup`` regardless of the
+    process phase — for *expected* one-time compiles after steady state:
+    autotuner probes and a fleet tenant's lazy first load."""
+    prev = getattr(_LOCAL, "phase", None)
+    _LOCAL.phase = "warmup"
+    try:
+        yield
+    finally:
+        _LOCAL.phase = prev
+
+
+def _on_event_duration(event: str, duration: float, **kw) -> None:
+    """The registered jax.monitoring listener: one call per real backend
+    compile, in the compiling thread."""
+    if event != _COMPILE_EVENT or not resources_enabled():
+        return
+    frames = getattr(_LOCAL, "frames", None) or ()
+    site = frames[0][0] if frames else "unattributed"
+    keys = [k for _s, k in frames if k]
+    phase = current_phase()
+    seconds = float(duration)
+    _COMPILE_SECONDS.observe(seconds, site=site)
+    _COMPILES_TOTAL.inc(1, site=site, phase=phase)
+    from .spans import current_context
+
+    ctx = current_context()
+    entry = {
+        "site": site,
+        "key": "/".join(keys) if keys else None,
+        "phase": phase,
+        "seconds": round(seconds, 6),
+        "unix_s": round(time.time(), 3),
+        "trace_id": ctx.trace_id if ctx is not None else None,
+    }
+    with _LOCK:
+        _COMPILE_LOG.append(entry)
+    if phase == "steady":
+        # the detectable anomaly this plane exists for: a live request
+        # paid an XLA compile after warmup declared the shapes covered
+        record_event(
+            "compile.steady_recompile",
+            site=site,
+            key=entry["key"] or "",
+            seconds=entry["seconds"],
+        )
+
+
+def install_compile_listener() -> bool:
+    """Register the compile listener with :mod:`jax.monitoring` (idempotent;
+    jax offers no per-listener unregistration, so registration is
+    once-per-process and the callback gates on :func:`resources_enabled`).
+    Returns True when the listener is installed."""
+    global _LISTENER_INSTALLED
+    with _LOCK:
+        if _LISTENER_INSTALLED:
+            return True
+        try:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_event_duration
+            )
+        except Exception:  # pragma: no cover - jax-less import environments
+            return False
+        _LISTENER_INSTALLED = True
+        return True
+
+
+def compile_log() -> List[dict]:
+    """The bounded compile log, oldest first."""
+    with _LOCK:
+        return [dict(e) for e in _COMPILE_LOG]
+
+
+def compile_counts() -> dict:
+    """Roll-up of ``isoforest_compiles_total``: total, by site, by phase."""
+    snap = _COMPILES_TOTAL.snapshot()
+    by_site: Dict[str, float] = {}
+    by_phase: Dict[str, float] = {p: 0.0 for p in PHASES}
+    total = 0.0
+    for series in snap["series"]:
+        value = float(series["value"])
+        labels = series["labels"]
+        total += value
+        by_site[labels["site"]] = by_site.get(labels["site"], 0.0) + value
+        by_phase[labels["phase"]] = by_phase.get(labels["phase"], 0.0) + value
+    return {
+        "total": int(total),
+        "by_site": {s: int(v) for s, v in sorted(by_site.items())},
+        "by_phase": {p: int(v) for p, v in sorted(by_phase.items())},
+    }
+
+
+def compile_seconds_total() -> float:
+    """Cumulative XLA backend-compile wall-clock across every site."""
+    snap = _COMPILE_SECONDS.snapshot()
+    return float(sum(series["sum"] for series in snap["series"]))
+
+
+# --------------------------------------------------------------------------- #
+# memory accounting
+# --------------------------------------------------------------------------- #
+
+
+def note_host_staging(site: str, nbytes: int) -> None:
+    """Record a streaming-executor host-stager allocation (both reusable
+    buffers): live gauge + peak watermark per site."""
+    if not resources_enabled():
+        return
+    nbytes = int(nbytes)
+    _HOST_STAGING.set(nbytes, site=site)
+    with _LOCK:
+        if nbytes > _STAGING_PEAK.get(site, 0):
+            _STAGING_PEAK[site] = nbytes
+
+
+def peak_host_staging_bytes(site: Optional[str] = None) -> int:
+    """Peak host staging-buffer bytes — for one site, or the max across
+    sites (the number bench.py reports)."""
+    with _LOCK:
+        if site is not None:
+            return _STAGING_PEAK.get(site, 0)
+        return max(_STAGING_PEAK.values(), default=0)
+
+
+def plane_placement(platform: Optional[str] = None) -> str:
+    """Where a resident model's packed planes land when scored: committed
+    puts target the accelerator on TPU/GPU (``device``); the CPU fallback
+    keeps them ``host``."""
+    if platform is None:
+        try:
+            import jax
+
+            platform = jax.devices()[0].platform
+        except Exception:  # backend bring-up failed: CPU semantics
+            platform = "cpu"
+    return "device" if platform in ("tpu", "gpu") else "host"
+
+
+def model_plane_bytes(model, platform: Optional[str] = None) -> dict:
+    """Per-model resident representation bytes split host/device.
+
+    The packed plane (f32 layout, or the u32 q16 plane for tenants on the
+    quantized representation — ``fleet.layout_nbytes``) is always built
+    host-side; on an accelerator backend the committed put pins the same
+    bytes device-side, and THAT is the scarce resource the fleet budget
+    must account (host bytes on CPU). Returns ``{"host", "device",
+    "plane", "placement"}``."""
+    from ..fleet.registry import layout_nbytes
+
+    nbytes = int(layout_nbytes(model))
+    placement = plane_placement(platform)
+    return {
+        "host": nbytes,
+        "device": nbytes if placement == "device" else 0,
+        "plane": getattr(model, "scoring_representation", "f32"),
+        "placement": placement,
+    }
+
+
+def account_resident_plane(
+    model_id: str, host_bytes: int, device_bytes: int, plane: str = "f32"
+) -> None:
+    """Register one resident model's plane bytes; totals land on the
+    ``isoforest_resident_plane_bytes{placement}`` gauges."""
+    with _LOCK:
+        _PLANES[str(model_id)] = {
+            "host": int(host_bytes),
+            "device": int(device_bytes),
+            "plane": str(plane),
+        }
+        totals = _plane_totals_locked()
+    _RESIDENT_PLANE.set(totals["host"], placement="host")
+    _RESIDENT_PLANE.set(totals["device"], placement="device")
+
+
+def release_resident_plane(model_id: str) -> None:
+    """Drop one model's plane accounting (eviction/close)."""
+    with _LOCK:
+        _PLANES.pop(str(model_id), None)
+        totals = _plane_totals_locked()
+    _RESIDENT_PLANE.set(totals["host"], placement="host")
+    _RESIDENT_PLANE.set(totals["device"], placement="device")
+
+
+def _plane_totals_locked() -> Dict[str, int]:
+    return {
+        "host": sum(p["host"] for p in _PLANES.values()),
+        "device": sum(p["device"] for p in _PLANES.values()),
+    }
+
+
+def resident_plane_bytes() -> dict:
+    """Current plane-byte totals and the per-model breakdown."""
+    with _LOCK:
+        totals = _plane_totals_locked()
+        models = {mid: dict(p) for mid, p in sorted(_PLANES.items())}
+    return {"host": totals["host"], "device": totals["device"], "models": models}
+
+
+def memory_watermarks() -> dict:
+    """The memory section of the flight recorder: staging-buffer watermarks
+    per site plus resident-plane totals. Keys are always present (zeros
+    before any streamed run / resident model) so the bundle schema is
+    stable."""
+    with _LOCK:
+        staging = {
+            site: {
+                "current_bytes": int(_HOST_STAGING.value(site=site)),
+                "peak_bytes": peak,
+            }
+            for site, peak in sorted(_STAGING_PEAK.items())
+        }
+    return {
+        "host_staging": staging,
+        "host_staging_peak_bytes": peak_host_staging_bytes(),
+        "resident_plane_bytes": resident_plane_bytes(),
+    }
+
+
+# --------------------------------------------------------------------------- #
+# flight recorder
+# --------------------------------------------------------------------------- #
+
+# every key build_bundle() always emits — the schema CI validates and
+# tests/test_resources.py pins as the bundle golden
+BUNDLE_SECTIONS = (
+    "schema",
+    "generated_unix_s",
+    "config",
+    "traces",
+    "events",
+    "metrics",
+    "degradations",
+    "autotune",
+    "compile_log",
+    "compiles",
+    "memory",
+)
+
+
+def config_fingerprint() -> dict:
+    """What was this process? Versions, backend, every ISOFOREST_TPU_* env
+    knob, argv — the reproduction header of a postmortem."""
+    try:
+        import jax
+
+        jax_version = jax.__version__
+        try:
+            backend = jax.devices()[0].platform
+        except Exception:
+            backend = "unavailable"
+    except Exception:  # pragma: no cover - jax-less import environments
+        jax_version = None
+        backend = "unavailable"
+    from .. import __version__
+
+    return {
+        "package_version": __version__,
+        "python": sys.version.split()[0],
+        "jax": jax_version,
+        "backend": backend,
+        "pid": os.getpid(),
+        "argv": list(sys.argv),
+        "env": {
+            k: v
+            for k, v in sorted(os.environ.items())
+            if k.startswith("ISOFOREST_TPU_")
+        },
+    }
+
+
+def build_bundle(trace_limit: int = 10, event_tail: int = 200) -> dict:
+    """Assemble the one-file postmortem artifact (plain JSON types).
+
+    Sections (:data:`BUNDLE_SECTIONS`): the last ``trace_limit`` committed
+    traces, the trailing ``event_tail`` timeline events, the full metrics
+    snapshot, the degradation ladder plus every rung taken, the autotune
+    winner table + decision counts, the compile log and roll-up, the
+    memory watermarks, and the config/env fingerprint. Containers are
+    always present — an empty fleet still yields a well-formed bundle."""
+    from . import events as _events
+    from . import metrics as _metrics
+    from . import spans as _spans
+    from ..resilience import degradation as _degradation
+
+    try:
+        from ..tuning import decision_counts, table_snapshot
+
+        autotune = {
+            "table": table_snapshot(),
+            "decisions": decision_counts(),
+        }
+    except Exception as exc:  # pragma: no cover - tuning import failure
+        autotune = {"error": repr(exc)}
+    timeline = [e.as_dict() for e in _events.get_events()]
+    return {
+        "schema": BUNDLE_SCHEMA,
+        "generated_unix_s": round(time.time(), 3),
+        "config": config_fingerprint(),
+        "traces": _spans.recent_traces(limit=trace_limit),
+        "events": timeline[-event_tail:],
+        "metrics": _metrics.registry().snapshot(),
+        "degradations": {
+            "ladder": sorted(_degradation.LADDER),
+            "events": [d.as_dict() for d in _degradation.degradations()],
+        },
+        "autotune": autotune,
+        "compile_log": compile_log(),
+        "compiles": compile_counts(),
+        "memory": memory_watermarks(),
+    }
+
+
+def write_bundle(path: str, **kw) -> dict:
+    """Build the bundle and write it to ``path`` as JSON; returns the
+    bundle document."""
+    doc = build_bundle(**kw)
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return doc
+
+
+def reset_resources() -> None:
+    """Clear the compile log, memory watermarks and plane accounting, and
+    reset the phase to ``warmup`` (metric series are cleared separately by
+    ``reset_metrics``). For tests and sample-and-clear operators."""
+    global _PHASE
+    with _LOCK:
+        _COMPILE_LOG.clear()
+        _STAGING_PEAK.clear()
+        _PLANES.clear()
+    _PHASE = "warmup"
+
+
+# Registration is once-per-process and the callback itself is ~free when
+# the plane is disabled, so installing at import keeps every entry point
+# (serving, bench, CLI, tests) covered without per-caller ceremony.
+install_compile_listener()
+
+__all__ = [
+    "BUNDLE_SCHEMA",
+    "BUNDLE_SECTIONS",
+    "COMPILE_LOG_MAX",
+    "account_resident_plane",
+    "build_bundle",
+    "compile_counts",
+    "compile_log",
+    "compile_scope",
+    "compile_seconds_total",
+    "config_fingerprint",
+    "current_phase",
+    "disable_resources",
+    "enable_resources",
+    "install_compile_listener",
+    "mark_steady",
+    "mark_warmup",
+    "memory_watermarks",
+    "model_plane_bytes",
+    "note_host_staging",
+    "peak_host_staging_bytes",
+    "plane_placement",
+    "release_resident_plane",
+    "reset_resources",
+    "resident_plane_bytes",
+    "resources_enabled",
+    "warmup_scope",
+    "write_bundle",
+]

@@ -441,7 +441,15 @@ def resolve_decision(
             emit_decision(entry2["strategy"], "table", key, site)
             return Decision(entry2["strategy"], "table", key, entry2.get("timings_s"))
         Xp = _probe_slice(X, n)
-        timings = _probe(forest, Xp, num_samples, eligible, layout=layout)
+        # probe executions compile every eligible strategy once — expected
+        # one-time cost even after serving marks steady, so they run under
+        # warmup_scope and attribute to their own compile site
+        from ..telemetry import resources as _resources
+
+        with _resources.warmup_scope(), _resources.compile_scope(
+            "autotune.probe", key=key
+        ):
+            timings = _probe(forest, Xp, num_samples, eligible, layout=layout)
 
     finite = {
         s: t for s, t in timings.items() if t is not None and math.isfinite(t)

@@ -749,6 +749,6 @@ def test_rule_registry_complete():
     assert set(core.RULES) == {
         "SYN001", "IMP001", "WSP001", "WSP002",
         "LAD001", "LAD002", "FLT001", "FLT002",
-        "OBS001", "OBS002", "OBS003", "OBS004", "OBS005",
+        "OBS001", "OBS002", "OBS003", "OBS004", "OBS005", "OBS006",
         "SLP001", "JIT001", "LCK001", "LCK002",
     }

@@ -262,6 +262,80 @@ class TestResidencyLRU:
         finally:
             registry.close()
 
+
+# --------------------------------------------------------------------------- #
+# device-byte budget semantics (ISSUE 15: the budget bounds the SCARCE
+# placement — device bytes on an accelerator, host bytes on the CPU
+# fallback; docs/fleet.md §2, docs/observability.md §10)
+# --------------------------------------------------------------------------- #
+
+
+class TestDevicePlaneBudget:
+    def test_cpu_fallback_accounts_host_plane_bytes(
+        self, fleet_dirs, tmp_path, data
+    ):
+        from isoforest_tpu.telemetry import resources
+
+        resources.reset_resources()
+        registry = _registry(fleet_dirs, tmp_path)
+        try:
+            registry.score("tenant-a", data[:16])
+            entry = registry.entry("tenant-a")
+            assert entry.plane_bytes["placement"] == "host"
+            planes = telemetry.resident_plane_bytes()
+            assert planes["host"] == entry.resident_bytes
+            assert planes["device"] == 0
+            load = telemetry.get_events(kind="fleet.load")[-1]
+            assert load.fields["placement"] == "host"
+        finally:
+            registry.close()
+        # close released every tenant's plane accounting
+        assert telemetry.resident_plane_bytes()["models"] == {}
+
+    def test_device_budget_evicts_on_device_bytes_and_reloads_bitwise(
+        self, fleet_dirs, tmp_path, data, monkeypatch
+    ):
+        from isoforest_tpu.telemetry import resources
+
+        # pretend committed puts land on an accelerator: every resident
+        # plane becomes device bytes and THOSE are what the budget bounds
+        monkeypatch.setattr(
+            resources, "plane_placement", lambda platform=None: "device"
+        )
+        resources.reset_resources()
+        one = layout_nbytes(fleet_dirs["tenant-a"][1])
+        budget = int(one * 1.5)  # fits exactly one device-resident model
+        registry = _registry(fleet_dirs, tmp_path, budget_bytes=budget)
+        try:
+            before = registry.score("tenant-a", data[:256])
+            entry = registry.entry("tenant-a")
+            assert entry.plane_bytes["placement"] == "device"
+            assert entry.resident_bytes == entry.plane_bytes["device"] == one
+            load = telemetry.get_events(kind="fleet.load")[-1]
+            assert load.fields["placement"] == "device"
+            planes = telemetry.resident_plane_bytes()
+            assert planes["device"] == one
+            # a second tenant pushes DEVICE residency past the budget
+            registry.score("tenant-b", data[:16])
+            assert not registry.entry("tenant-a").resident
+            assert registry.state()["resident_bytes"] <= budget
+            planes = telemetry.resident_plane_bytes()
+            assert planes["device"] == one
+            assert list(planes["models"]) == ["tenant-b"]
+            evict = telemetry.get_events(kind="fleet.evict")[-1]
+            assert evict.fields["model_id"] == "tenant-a"
+            assert evict.fields["cause"] == "budget"
+            # the evicted tenant re-loads bitwise from its sealed dirs
+            after = registry.score("tenant-a", data[:256])
+            np.testing.assert_array_equal(before, after)
+        finally:
+            registry.close()
+        assert telemetry.resident_plane_bytes() == {
+            "host": 0,
+            "device": 0,
+            "models": {},
+        }
+
     def test_evict_mid_retrain_refused_until_swap_completes(
         self, fleet_dirs, tmp_path, data
     ):

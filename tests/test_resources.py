@@ -1,0 +1,318 @@
+"""Resource observability plane (ISSUE 15, docs/observability.md §10).
+
+Acceptance matrix:
+  * compile attribution: the OUTERMOST ``compile_scope`` frame wins, scope
+    keys join into the bounded compile log, no open scope attributes as
+    ``unattributed``, and a compile inside a request span records the
+    active ``trace_id``;
+  * the phase model: the process starts in ``warmup``, ``mark_steady``
+    flips it, a steady-phase compile records a ``compile.steady_recompile``
+    event, and ``warmup_scope`` shields expected one-time compiles;
+  * recompile detection on real XLA programs: padded (bucketed) traffic
+    after warmup pays ZERO steady compiles, while bypassing bucket padding
+    (shape churn) ticks ``isoforest_compiles_total{phase="steady"}``;
+  * memory accounting: host-staging watermarks, plane placement by
+    backend, and resident-plane account/release bookkeeping;
+  * the flight recorder: ``build_bundle`` emits exactly the documented
+    sections (the bundle golden) and ``write_bundle`` round-trips JSON.
+
+Metric/event/section names asserted here are the public schema documented
+in docs/observability.md §10 — renaming one is a breaking change.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from isoforest_tpu import IsolationForest, telemetry
+from isoforest_tpu.ops.traversal import score_matrix
+from isoforest_tpu.telemetry import resources
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Each test starts from an empty, enabled resource plane in the
+    warmup phase (the fixture also restores it for later test files)."""
+    telemetry.enable()
+    telemetry.enable_resources()
+    telemetry.reset()
+    telemetry.reset_resources()
+    yield
+    telemetry.enable()
+    telemetry.enable_resources()
+    telemetry.reset()
+    telemetry.reset_resources()
+
+
+def _fire(duration: float = 0.01) -> None:
+    """Deliver one synthetic backend-compile monitoring event — exactly
+    what jax.monitoring fires once per real XLA compile, on the compiling
+    thread."""
+    resources._on_event_duration(resources._COMPILE_EVENT, duration)
+
+
+# --------------------------------------------------------------------------- #
+# compilation observatory: attribution + phase model
+# --------------------------------------------------------------------------- #
+
+
+class TestCompileAttribution:
+    def test_outermost_scope_wins_and_keys_join(self):
+        with resources.compile_scope("serving.prewarm", key="bucket=1024"):
+            with resources.compile_scope("score_matrix", key="rows=1024"):
+                _fire(0.25)
+        (entry,) = telemetry.compile_log()
+        assert entry["site"] == "serving.prewarm"
+        assert entry["key"] == "bucket=1024/rows=1024"
+        assert entry["phase"] == "warmup"
+        assert entry["seconds"] == pytest.approx(0.25)
+        counts = telemetry.compile_counts()
+        assert counts["total"] == 1
+        assert counts["by_site"] == {"serving.prewarm": 1}
+        assert counts["by_phase"]["warmup"] == 1
+        assert telemetry.compile_seconds_total() == pytest.approx(0.25)
+
+    def test_no_open_scope_is_unattributed(self):
+        _fire()
+        (entry,) = telemetry.compile_log()
+        assert entry["site"] == "unattributed"
+        assert entry["key"] is None
+        assert telemetry.compile_counts()["by_site"] == {"unattributed": 1}
+
+    def test_disabled_plane_records_nothing(self):
+        telemetry.disable_resources()
+        with resources.compile_scope("score_matrix"):
+            _fire()
+        assert telemetry.compile_log() == []
+        assert telemetry.compile_counts()["total"] == 0
+
+    def test_compile_inside_request_span_records_trace_id(self):
+        with telemetry.span("serving.request") as span:
+            trace_id = span.trace_id
+            with resources.compile_scope("score_matrix"):
+                _fire()
+        (entry,) = telemetry.compile_log()
+        assert entry["trace_id"] == trace_id
+
+    def test_compile_log_is_bounded(self):
+        for _ in range(resources.COMPILE_LOG_MAX + 10):
+            _fire()
+        log = telemetry.compile_log()
+        assert len(log) == resources.COMPILE_LOG_MAX
+        assert telemetry.compile_counts()["total"] == (
+            resources.COMPILE_LOG_MAX + 10
+        )
+
+
+class TestPhaseModel:
+    def test_mark_steady_flips_and_records_anomaly_event(self):
+        assert resources.current_phase() == "warmup"
+        telemetry.mark_steady()
+        assert resources.current_phase() == "steady"
+        with resources.compile_scope("score_matrix", key="rows=333"):
+            _fire(0.5)
+        counts = telemetry.compile_counts()
+        assert counts["by_phase"]["steady"] == 1
+        (event,) = telemetry.get_events(kind="compile.steady_recompile")
+        assert event.fields["site"] == "score_matrix"
+        assert event.fields["key"] == "rows=333"
+        assert event.fields["seconds"] == pytest.approx(0.5)
+        telemetry.mark_warmup()
+        assert resources.current_phase() == "warmup"
+
+    def test_warmup_scope_shields_expected_compiles(self):
+        telemetry.mark_steady()
+        with telemetry.warmup_scope():
+            assert resources.current_phase() == "warmup"
+            with resources.compile_scope("autotune.probe"):
+                _fire()
+        assert resources.current_phase() == "steady"
+        counts = telemetry.compile_counts()
+        assert counts["by_phase"]["steady"] == 0
+        assert counts["by_phase"]["warmup"] == 1
+        assert not telemetry.get_events(kind="compile.steady_recompile")
+
+
+# --------------------------------------------------------------------------- #
+# recompile detection on real XLA programs (the anomaly this plane exists
+# to catch: docs/observability.md §10 phase model)
+# --------------------------------------------------------------------------- #
+
+
+class TestRecompileDetection:
+    @pytest.fixture(scope="class")
+    def forest(self):
+        # deliberately odd dimensions (6 features, 7 trees, 48 samples) so
+        # this class's XLA programs share no shape with the rest of the
+        # suite — the process-wide jit cache would otherwise hide compiles
+        rng = np.random.default_rng(151)
+        X = rng.normal(size=(900, 6)).astype(np.float32)
+        model = IsolationForest(
+            num_estimators=7, max_samples=48.0, random_seed=151
+        ).fit(X)
+        return model, X
+
+    def _score(self, forest, n, pad_to_bucket):
+        model, X = forest
+        rows = np.resize(X, (n, X.shape[1])).astype(np.float32)
+        return score_matrix(
+            model.forest,
+            rows,
+            model.num_samples,
+            strategy="gather",
+            pad_to_bucket=pad_to_bucket,
+        )
+
+    def test_bucketed_traffic_is_steady_shape_churn_is_not(self, forest):
+        # warmup: compile the 2048-row bucket once (1100 pads to 2048)
+        self._score(forest, 1100, pad_to_bucket=True)
+        assert telemetry.compile_counts()["by_site"].get("score_matrix", 0) > 0
+        telemetry.mark_steady()
+        steady0 = telemetry.compile_counts()["by_phase"]["steady"]
+        # padded traffic at a different n in the SAME bucket: zero compiles
+        self._score(forest, 1500, pad_to_bucket=True)
+        assert telemetry.compile_counts()["by_phase"]["steady"] == steady0
+        assert not telemetry.get_events(kind="compile.steady_recompile")
+        # bypassing bucket padding compiles per exact row count: every
+        # novel shape is a steady-phase recompile, loudly accounted
+        self._score(forest, 611, pad_to_bucket=False)
+        self._score(forest, 723, pad_to_bucket=False)
+        counts = telemetry.compile_counts()
+        assert counts["by_phase"]["steady"] >= 2
+        assert counts["by_site"]["score_matrix"] >= 2
+        events = telemetry.get_events(kind="compile.steady_recompile")
+        assert len(events) >= 2
+        assert all(e.fields["site"] == "score_matrix" for e in events)
+        # the compile log names the padded row counts that paid the price
+        steady_keys = {
+            e["key"] for e in telemetry.compile_log() if e["phase"] == "steady"
+        }
+        assert {"rows=611", "rows=723"} <= steady_keys
+
+
+# --------------------------------------------------------------------------- #
+# memory accounting
+# --------------------------------------------------------------------------- #
+
+
+class TestMemoryAccounting:
+    def test_host_staging_watermark_keeps_peak(self):
+        telemetry.note_host_staging("score_matrix", 4096)
+        telemetry.note_host_staging("score_matrix", 1024)  # live drops
+        telemetry.note_host_staging("sharded", 2048)
+        assert telemetry.peak_host_staging_bytes("score_matrix") == 4096
+        assert telemetry.peak_host_staging_bytes("sharded") == 2048
+        assert telemetry.peak_host_staging_bytes() == 4096
+        marks = telemetry.memory_watermarks()["host_staging"]
+        assert marks["score_matrix"] == {
+            "current_bytes": 1024,
+            "peak_bytes": 4096,
+        }
+
+    def test_disabled_plane_skips_staging(self):
+        telemetry.disable_resources()
+        telemetry.note_host_staging("score_matrix", 4096)
+        assert telemetry.peak_host_staging_bytes() == 0
+
+    def test_plane_placement_by_backend(self):
+        assert resources.plane_placement("tpu") == "device"
+        assert resources.plane_placement("gpu") == "device"
+        assert resources.plane_placement("cpu") == "host"
+        # this suite runs on the CPU backend: the live default is host
+        assert resources.plane_placement() == "host"
+
+    def test_model_plane_bytes_splits_by_placement(self):
+        from isoforest_tpu.fleet import layout_nbytes
+
+        rng = np.random.default_rng(5)
+        model = IsolationForest(num_estimators=5, random_seed=5).fit(
+            rng.normal(size=(512, 4)).astype(np.float32)
+        )
+        nbytes = layout_nbytes(model)
+        on_cpu = telemetry.model_plane_bytes(model, platform="cpu")
+        assert on_cpu == {
+            "host": nbytes,
+            "device": 0,
+            "plane": "f32",
+            "placement": "host",
+        }
+        on_tpu = telemetry.model_plane_bytes(model, platform="tpu")
+        assert on_tpu["device"] == nbytes and on_tpu["placement"] == "device"
+
+    def test_account_and_release_roll_up(self):
+        resources.account_resident_plane("a", 1000, 0, plane="f32")
+        resources.account_resident_plane("b", 500, 500, plane="q16")
+        totals = telemetry.resident_plane_bytes()
+        assert totals["host"] == 1500 and totals["device"] == 500
+        assert totals["models"]["b"]["plane"] == "q16"
+        snap = telemetry.snapshot()["metrics"]["isoforest_resident_plane_bytes"]
+        by_placement = {
+            s["labels"]["placement"]: s["value"] for s in snap["series"]
+        }
+        assert by_placement == {"host": 1500.0, "device": 500.0}
+        resources.release_resident_plane("a")
+        totals = telemetry.resident_plane_bytes()
+        assert totals["host"] == 500 and list(totals["models"]) == ["b"]
+
+
+# --------------------------------------------------------------------------- #
+# the flight recorder
+# --------------------------------------------------------------------------- #
+
+
+class TestFlightRecorder:
+    def _touch_everything(self):
+        with telemetry.span("score_matrix"):
+            pass
+        with resources.compile_scope("score_matrix", key="rows=1024"):
+            _fire()
+        telemetry.note_host_staging("score_matrix", 8192)
+        resources.account_resident_plane("tenant-a", 4096, 0)
+
+    def test_bundle_golden_sections(self):
+        self._touch_everything()
+        bundle = telemetry.build_bundle()
+        # the golden: exactly the documented sections, nothing else
+        assert sorted(bundle) == sorted(resources.BUNDLE_SECTIONS)
+        assert bundle["schema"] == telemetry.BUNDLE_SCHEMA
+        assert bundle["config"]["backend"] == "cpu"
+        assert all(
+            k.startswith("ISOFOREST_TPU_") for k in bundle["config"]["env"]
+        )
+        assert bundle["compiles"]["total"] == 1
+        assert bundle["compile_log"][0]["site"] == "score_matrix"
+        memory = bundle["memory"]
+        assert memory["host_staging_peak_bytes"] == 8192
+        assert memory["resident_plane_bytes"]["host"] == 4096
+        assert isinstance(bundle["traces"], list)
+        assert isinstance(bundle["events"], list)
+        assert "isoforest_compiles_total" in bundle["metrics"]
+
+    def test_empty_process_still_yields_wellformed_bundle(self):
+        bundle = telemetry.build_bundle()
+        assert sorted(bundle) == sorted(resources.BUNDLE_SECTIONS)
+        assert bundle["compiles"] == {
+            "total": 0,
+            "by_site": {},
+            "by_phase": {"steady": 0, "warmup": 0},
+        }
+        assert bundle["memory"]["resident_plane_bytes"]["models"] == {}
+
+    def test_write_bundle_round_trips_json(self, tmp_path):
+        self._touch_everything()
+        path = tmp_path / "bundle.json"
+        doc = telemetry.write_bundle(str(path))
+        loaded = json.loads(path.read_text())
+        assert loaded == json.loads(json.dumps(doc))
+        assert loaded["schema"] == telemetry.BUNDLE_SCHEMA
+
+    def test_bundle_tails_are_bounded(self):
+        for i in range(12):
+            with telemetry.span("score_matrix", i=i):
+                pass
+        bundle = telemetry.build_bundle(trace_limit=3, event_tail=5)
+        assert len(bundle["traces"]) <= 3
+        assert len(bundle["events"]) <= 5

@@ -608,6 +608,202 @@ def check_spans_documented(project: Project) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------- #
+# OBS006 — HTTP endpoint rows ⇄ registered routes
+# --------------------------------------------------------------------------- #
+
+HTTP_FILE = "isoforest_tpu/telemetry/http.py"
+# the three docs whose tables carry endpoint rows (docs/observability.md
+# §8/§9, docs/serving.md, docs/fleet.md §3)
+ENDPOINT_DOCS = (OBS_DOC, "docs/serving.md", "docs/fleet.md")
+# do_GET built-ins that legitimately have no docs-table row: the index
+# page and the /healthz spelling alias
+ENDPOINT_ALIASES = {"/", "/health"}
+
+_ENDPOINT_TOKEN_RE = re.compile(r"^(?:(GET|POST)\s+)?(/[^\s`]*)$")
+
+
+def _module_str_constants(tree: ast.AST) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` assignments — how route paths are
+    spelled at their registration sites (``SCORE_PREFIX = "/score/"``)."""
+    out: Dict[str, str] = {}
+    for node in getattr(tree, "body", []):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            value = str_const(node.value)
+            if value is not None:
+                out[node.targets[0].id] = value
+    return out
+
+
+def registered_routes(project: Project) -> Dict[str, List[Tuple[str, str, int]]]:
+    """``{"get"|"post"|"post_prefix": [(path, file, line)]}`` for every
+    route the telemetry HTTP daemon can actually serve: ``register_get`` /
+    ``register_post`` / ``register_post_prefix`` calls (first arg a string
+    literal or a module-level string constant), plus the built-in GET
+    dispatch — the literal paths ``do_GET`` compares ``path`` against in
+    telemetry/http.py."""
+    out: Dict[str, List[Tuple[str, str, int]]] = {
+        "get": [],
+        "post": [],
+        "post_prefix": [],
+    }
+    kinds = {
+        "register_get": "get",
+        "register_post": "post",
+        "register_post_prefix": "post_prefix",
+    }
+    for f in project.package_files():
+        if f.tree is None or f.rel == HTTP_FILE:
+            continue  # http.py only DEFINES the register_* methods
+        consts = _module_str_constants(f.tree)
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = kinds.get(call_name(node) or "")
+            if kind is None or not node.args:
+                continue
+            arg = node.args[0]
+            value = str_const(arg)
+            if value is None and isinstance(arg, ast.Name):
+                value = consts.get(arg.id)
+            if value is not None:
+                out[kind].append((value, f.rel, node.lineno))
+    src = project.file(HTTP_FILE)
+    if src is not None and src.tree is not None:
+        for node in ast.walk(src.tree):
+            if not (
+                isinstance(node, ast.Compare)
+                and isinstance(node.left, ast.Name)
+                and node.left.id == "path"
+            ):
+                continue
+            for comp in node.comparators:
+                elts = list(comp.elts) if isinstance(comp, ast.Tuple) else [comp]
+                for elt in elts:
+                    literal = str_const(elt)
+                    if literal is not None and literal.startswith("/"):
+                        out["get"].append((literal, HTTP_FILE, node.lineno))
+    return out
+
+
+def documented_endpoints(project: Project) -> List[Tuple[str, str, str, int]]:
+    """(method, path, doc_rel, line) for every endpoint row across the
+    :data:`ENDPOINT_DOCS` markdown tables: first-cell backticked tokens of
+    the shape ```/path```, ```GET /path``` or ```POST /path``` (no method
+    means GET, matching the docs' §8 convention). The query string is
+    presentation, not route identity (``/trace?trace_id=<id>`` is the
+    ``/trace`` route); a ``<param>`` left in the path marks a
+    prefix-dispatched route (``/score/<model_id>`` → prefix ``/score/``)."""
+    out: List[Tuple[str, str, str, int]] = []
+    for rel in ENDPOINT_DOCS:
+        if rel == OBS_DOC:
+            text = project.observability_doc
+        else:
+            try:
+                text = (project.root / rel).read_text()
+            except OSError:
+                text = None
+        if text is None:
+            continue
+        rows = [
+            (lineno, line)
+            for lineno, line in enumerate(text.splitlines(), 1)
+            if line.strip().startswith("|")
+        ]
+        for token, lineno in _table_first_cell_tokens(rows):
+            match = _ENDPOINT_TOKEN_RE.fullmatch(token)
+            if match is None:
+                continue
+            method = match.group(1) or "GET"
+            path = match.group(2).split("?")[0]
+            out.append((method, path, rel, lineno))
+    return out
+
+
+@rule("OBS006", "HTTP endpoint rows ⇄ registered GET/POST routes")
+def check_endpoints(project: Project) -> List[Finding]:
+    """Both directions of the endpoint contract: every endpoint row in the
+    docs tables must be backed by a route the daemon actually registers
+    (built-in ``do_GET`` path, ``register_get``, ``register_post`` or
+    ``register_post_prefix``), and every registered route must have a docs
+    row — an undocumented route is invisible to operators, a documented
+    phantom route is a 404 in every runbook that cites it."""
+    findings: List[Finding] = []
+    routes = registered_routes(project)
+    get_paths = {p for p, _, _ in routes["get"]}
+    post_paths = {p for p, _, _ in routes["post"]}
+    prefix_paths = {p for p, _, _ in routes["post_prefix"]}
+    documented = documented_endpoints(project)
+    doc_get: Set[str] = set()
+    doc_post: Set[str] = set()
+    doc_prefix: Set[str] = set()
+    for method, path, rel, lineno in documented:
+        if "<" in path:
+            prefix = path.split("<")[0]
+            doc_prefix.add(prefix)
+            if method != "POST" or prefix not in prefix_paths:
+                findings.append(
+                    Finding(
+                        "OBS006",
+                        rel,
+                        lineno,
+                        f"documented endpoint `{method} {path}` has no "
+                        f"matching register_post_prefix({prefix!r}) route",
+                    )
+                )
+        elif method == "POST":
+            doc_post.add(path)
+            if path not in post_paths:
+                findings.append(
+                    Finding(
+                        "OBS006",
+                        rel,
+                        lineno,
+                        f"documented endpoint `POST {path}` has no "
+                        "matching register_post route",
+                    )
+                )
+        else:
+            doc_get.add(path)
+            if path not in get_paths:
+                findings.append(
+                    Finding(
+                        "OBS006",
+                        rel,
+                        lineno,
+                        f"documented endpoint `GET {path}` is neither a "
+                        "built-in telemetry/http.py path nor a "
+                        "register_get route",
+                    )
+                )
+    seen: Set[Tuple[str, str]] = set()
+    for kind, registered, covered, label in (
+        ("get", routes["get"], doc_get, "GET"),
+        ("post", routes["post"], doc_post, "POST"),
+        ("post_prefix", routes["post_prefix"], doc_prefix, "POST prefix"),
+    ):
+        for path, rel, lineno in registered:
+            if path in ENDPOINT_ALIASES or (kind, path) in seen:
+                continue
+            seen.add((kind, path))
+            if path not in covered:
+                findings.append(
+                    Finding(
+                        "OBS006",
+                        rel,
+                        lineno,
+                        f"registered {label} route {path!r} has no endpoint "
+                        f"row in any of {', '.join(ENDPOINT_DOCS)} — "
+                        "operators cannot discover it",
+                    )
+                )
+    return findings
+
+
+# --------------------------------------------------------------------------- #
 # SLP001 — the FakeClock policy
 # --------------------------------------------------------------------------- #
 

@@ -13,7 +13,9 @@ Second gate (docs/observability.md): telemetry-ENABLED scoring must stay
 within :data:`TELEMETRY_MARGIN` (3%) of telemetry-DISABLED scoring on the
 same workload — the "near-zero cost" contract of the instrumentation on
 the scoring hot path. Both sides are best-of-N on the identical packed
-run; the measured overhead ships in the JSON line.
+run; the measured overhead ships in the JSON line. The resource
+observability plane (compile & memory accounting, docs/observability.md
+§10) gets the same A/B gate at :data:`RESOURCES_MARGIN` (3%).
 
 Third gate (docs/observability.md §8): MONITOR-enabled ``model.score``
 (the drift monitor folding every served batch into the baseline histogram
@@ -74,6 +76,13 @@ TELEMETRY_MARGIN = 1.03
 # monitor-off (ISSUE 5 acceptance); same best-of-5 protocol
 MONITOR_REPS = 5
 MONITOR_MARGIN = 1.03
+
+# resource-plane overhead gate (docs/observability.md §10): scoring with
+# the compile/memory accounting enabled within 3% of disabled — the plane
+# only touches thread-local frame pushes and (rarely) the compile listener,
+# so its steady-state cost on the hot path must be noise
+RESOURCES_REPS = 5
+RESOURCES_MARGIN = 1.03
 
 # autotune gate: warm-table strategy="auto" must reach >= 0.95x the speed
 # of the static-default pick (ISSUE 6 acceptance — the resolve path adds a
@@ -184,6 +193,19 @@ def main() -> int:
         telemetry.enable()
     telemetry_overhead = t_tel_on / t_tel_off - 1.0
     ok_telemetry = t_tel_on <= t_tel_off * TELEMETRY_MARGIN
+
+    # resource-plane overhead gate: same packed run, compile/memory
+    # accounting on vs off (telemetry stays enabled on both sides so only
+    # the resource plane itself is measured)
+    telemetry.enable_resources()
+    t_res_on = best_of(run_packed, RESOURCES_REPS)
+    telemetry.disable_resources()
+    try:
+        t_res_off = best_of(run_packed, RESOURCES_REPS)
+    finally:
+        telemetry.enable_resources()
+    resources_overhead = t_res_on / t_res_off - 1.0
+    ok_resources = t_res_on <= t_res_off * RESOURCES_MARGIN
 
     # drift-monitor overhead gate: model.score with the streaming PSI/KS
     # monitor folding every batch vs detached, on the SAME packed-gather
@@ -332,6 +354,7 @@ def main() -> int:
         t_packed <= t_unpacked * MARGIN
         and max_dev <= 1e-6
         and ok_telemetry
+        and ok_resources
         and ok_monitor
         and ok_autotune_speed
         and ok_regime
@@ -353,6 +376,10 @@ def main() -> int:
                 "telemetry_disabled_s": round(t_tel_off, 4),
                 "telemetry_overhead_pct": round(telemetry_overhead * 100, 2),
                 "telemetry_margin": TELEMETRY_MARGIN,
+                "resources_enabled_s": round(t_res_on, 4),
+                "resources_disabled_s": round(t_res_off, 4),
+                "resources_overhead_pct": round(resources_overhead * 100, 2),
+                "resources_margin": RESOURCES_MARGIN,
                 "monitor_enabled_s": round(t_mon_on, 4),
                 "monitor_disabled_s": round(t_mon_off, 4),
                 "monitor_overhead_pct": round(monitor_overhead * 100, 2),
@@ -386,7 +413,9 @@ def main() -> int:
             f"bench smoke FAILED: packed {t_packed:.4f}s vs unpacked "
             f"{t_unpacked:.4f}s (margin {MARGIN}x), max_dev {max_dev:g}, "
             f"telemetry on/off {t_tel_on:.4f}/{t_tel_off:.4f}s "
-            f"(margin {TELEMETRY_MARGIN}x), monitor on/off "
+            f"(margin {TELEMETRY_MARGIN}x), resources on/off "
+            f"{t_res_on:.4f}/{t_res_off:.4f}s (margin {RESOURCES_MARGIN}x), "
+            f"monitor on/off "
             f"{t_mon_on:.4f}/{t_mon_off:.4f}s (margin {MONITOR_MARGIN}x), "
             f"autotuned auto {t_auto:.4f}s vs static {t_static:.4f}s "
             f"(min ratio {AUTOTUNE_MIN_RATIO}), 1M-regime pick "

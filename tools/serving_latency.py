@@ -339,6 +339,26 @@ def _trace_phase(url, rows_pool, rows_per_request, n_requests=6):
     }
 
 
+def _steady_compile_count(url):
+    """The server's own ``isoforest_compiles_total{phase="steady"}`` roll-up
+    from ``/snapshot`` — the recompile-anomaly signal
+    (docs/observability.md §10). After prewarm every flush must land on an
+    already-compiled bucket shape, so this counter must NOT move across the
+    measured phases: a non-zero delta means live traffic paid an XLA
+    compile. Returns -1 when the snapshot is unreadable."""
+    try:
+        with urllib.request.urlopen(url + "/snapshot", timeout=10) as resp:
+            doc = json.loads(resp.read())
+    except Exception:
+        return -1
+    metric = doc.get("metrics", {}).get("isoforest_compiles_total")
+    total = 0
+    for s in (metric or {}).get("series") or []:
+        if s.get("labels", {}).get("phase") == "steady":
+            total += int(s.get("value", 0))
+    return total
+
+
 SERVING_SERIES = (
     "isoforest_serving_queue_depth",
     "isoforest_serving_batch_rows",
@@ -431,6 +451,11 @@ def main() -> None:
         if not parity["pass"]:
             failed.append("parity")
 
+    # steady-compile watermark BEFORE the measured phases: the serve
+    # prewarmed its buckets and marked steady, so the measured traffic
+    # below must not trigger a single further XLA compile
+    steady_before = _steady_compile_count(url)
+
     sequential = _closed_loop(url, rows_pool, 1, args.duration, args.rows_per_request)
     print(json.dumps({"phase": "closed_sequential", **sequential}), flush=True)
     concurrent = _closed_loop(
@@ -481,6 +506,15 @@ def main() -> None:
         if missing_tenant:
             failed.append(f"missing_tenant_series:{missing_tenant}")
 
+    steady_after = _steady_compile_count(url)
+    if steady_before < 0 or steady_after < 0:
+        steady_delta = None
+        failed.append("steady_compile_fetch")
+    else:
+        steady_delta = steady_after - steady_before
+        if steady_delta != 0:
+            failed.append(f"steady_recompiles:{steady_delta}")
+
     ratio = (
         concurrent["rows_per_s"] / sequential["rows_per_s"]
         if sequential["rows_per_s"]
@@ -498,6 +532,8 @@ def main() -> None:
                 "mean_flush_requests": concurrent["mean_flush_requests"],
                 "gate": args.gate or None,
                 "serving_series_present": not missing_series,
+                "steady_compile_delta": steady_delta,
+                "steady_compiles_total": max(steady_after, 0),
                 "failed": failed,
                 "pass": not failed,
             }
