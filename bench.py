@@ -14,6 +14,7 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -32,6 +33,13 @@ def make_data(n: int = NUM_ROWS, seed: int = 7) -> tuple[np.ndarray, np.ndarray]
     from isoforest_tpu.data import kddcup_http_hard
 
     return kddcup_http_hard(n=n, contamination=CONTAMINATION, seed=seed)
+
+
+def _peak_rss_bytes() -> int:
+    """Process high-water resident set in bytes (Linux ru_maxrss is KiB)."""
+    import resource
+
+    return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024
 
 
 def auroc(scores: np.ndarray, labels: np.ndarray) -> float:
@@ -680,6 +688,7 @@ def main() -> None:
                 "compile_seconds": round(telemetry.compile_seconds_total(), 3),
                 "compile_count": telemetry.compile_counts()["total"],
                 "peak_host_staging_bytes": telemetry.peak_host_staging_bytes(),
+                "peak_rss_bytes": _peak_rss_bytes(),
                 "resident_plane_bytes": {
                     k: v
                     for k, v in telemetry.resident_plane_bytes().items()
@@ -788,12 +797,112 @@ def full_sweep() -> None:
     )
 
 
+def bench_out_of_core() -> None:
+    """``python bench.py --out-of-core [--rows N]``: fit + score a synthetic
+    KDDCup-scale sharded source through the out-of-core data plane
+    (docs/out_of_core.md), one JSON line.
+
+    The source is written shard-by-shard (never materialising the full
+    dataset), then a single ``fit_source`` + ``score_source`` invocation
+    streams it back with bounded memory — ``peak_rss_bytes`` in the output
+    line is the proof, staying flat as ``--rows`` grows."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from isoforest_tpu import IsolationForest
+    from isoforest_tpu import telemetry
+    from isoforest_tpu.io.outofcore import read_scores, score_source
+    from isoforest_tpu.io.source import open_source, write_npy_shard
+
+    rows = 100_000_000
+    if "--rows" in sys.argv:
+        rows = int(sys.argv[sys.argv.index("--rows") + 1])
+    shard_rows = min(4_000_000, rows)
+    workdir = tempfile.mkdtemp(prefix="isoforest-ooc-")
+    source_dir = os.path.join(workdir, "source")
+    sink_dir = os.path.join(workdir, "scores")
+    os.makedirs(source_dir)
+    try:
+        t0 = time.perf_counter()
+        written = 0
+        index = 0
+        while written < rows:
+            n = min(shard_rows, rows - written)
+            X, _ = make_data(n=n, seed=7 + index)
+            write_npy_shard(
+                os.path.join(source_dir, f"shard-{index:05d}.npy"), X
+            )
+            written += n
+            index += 1
+        gen_s = time.perf_counter() - t0
+        print(
+            f"[bench] out-of-core: wrote {written:,} rows over {index} "
+            f"shard(s) in {gen_s:.1f}s",
+            file=sys.stderr,
+        )
+
+        src = open_source(source_dir)
+        est = IsolationForest(
+            num_estimators=NUM_TREES,
+            max_samples=float(NUM_SAMPLES),
+            contamination=CONTAMINATION,
+            random_seed=1,
+        )
+        t0 = time.perf_counter()
+        model = est.fit_source(src, baseline=False)
+        fit_s = time.perf_counter() - t0
+        print(f"[bench] out-of-core: fit in {fit_s:.1f}s", file=sys.stderr)
+
+        t0 = time.perf_counter()
+        summary = score_source(model, src, sink_dir)
+        score_s = time.perf_counter() - t0
+        scores = read_scores(sink_dir, num_shards=index)
+        anomaly_rate = float((scores > model.outlier_score_threshold).mean())
+
+        shard_tp = (
+            round(shard_rows / summary["shardSecondsMean"], 1)
+            if summary["shardSecondsMean"]
+            else None
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": f"out_of_core_fit_score_{rows // 1_000_000}M",
+                    "value": round(rows / (fit_s + score_s), 1),
+                    "unit": "rows/s",
+                    "backend": jax.devices()[0].platform,
+                    "rows": rows,
+                    "features": NUM_FEATURES,
+                    "shards": index,
+                    "shard_rows": shard_rows,
+                    "generate_s": round(gen_s, 3),
+                    "fit_s": round(fit_s, 3),
+                    "score_s": round(score_s, 3),
+                    "fit_rows_per_s": round(rows / fit_s, 1),
+                    "score_rows_per_s": summary["rowsPerSecond"],
+                    "shard_seconds_mean": summary["shardSecondsMean"],
+                    "shard_rows_per_s": shard_tp,
+                    "strategy": summary["strategy"],
+                    "anomaly_rate": round(anomaly_rate, 6),
+                    "peak_host_staging_bytes": telemetry.peak_host_staging_bytes(),
+                    "peak_rss_bytes": _peak_rss_bytes(),
+                }
+            )
+        )
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 if __name__ == "__main__":
     _install_flight_recorder()
     try:
         if "--full" in sys.argv:
             _ensure_live_backend()
             full_sweep()
+        elif "--out-of-core" in sys.argv:
+            bench_out_of_core()
         else:
             main()
     except Exception:

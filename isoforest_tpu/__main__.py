@@ -5,8 +5,13 @@ operational surface here is a small CLI over CSV files:
 
     python -m isoforest_tpu fit --input data.csv --output /tmp/model \\
         --num-estimators 100 --contamination 0.02 [--extended]
+    python -m isoforest_tpu fit --source /data/shards/ --output /tmp/model
+        # out-of-core: one streamed pass over .csv/.npy/.avro/.parquet shards
     python -m isoforest_tpu score --model /tmp/model --input data.csv \\
         --output scores.csv
+    python -m isoforest_tpu score --model /tmp/model --source /data/shards/ \\
+        --output /tmp/scores_sink [--resume] [--strategy gather]
+        # resumable: one sealed part per shard; --resume skips sealed parts
     python -m isoforest_tpu convert --model /tmp/model --output model.onnx
     python -m isoforest_tpu inspect --model /tmp/model [--tree 0]
     python -m isoforest_tpu telemetry [--format json|prometheus] \\
@@ -43,19 +48,24 @@ import sys
 import numpy as np
 
 
-def _parse_rows(lines_or_path, labeled: bool):
-    """One shared CSV parser for fit and score: rows are samples even for a
-    single-line file (``ndmin=2``)."""
-    data = np.loadtxt(lines_or_path, delimiter=",", comments="#", ndmin=2).astype(
-        np.float32
-    )
-    if labeled:
-        return data[:, :-1], data[:, -1]
-    return data, None
-
-
 def _load(path: str, labeled: bool):
-    return _parse_rows(path, labeled)
+    """Materialise (X, y) from any source spec — a single CSV, a directory
+    of shards, or a glob — read chunk-by-chunk through the sharded source
+    abstraction (io/source.py), so even a single huge CSV never buffers
+    more than one parsed chunk transiently above the final matrix."""
+    from .io.source import open_source
+
+    return open_source(path, labeled=labeled).read_all()
+
+
+def _iter_input_chunks(spec: str, labeled: bool, chunk_rows: int):
+    """Stream (X, y) chunks from any source spec (file / directory / glob)
+    without materialising it — the CLI analogue of Spark scoring a Dataset
+    partition by partition."""
+    from .io.source import open_source
+
+    for chunk in open_source(spec, labeled=labeled).iter_chunks(chunk_rows=chunk_rows):
+        yield chunk.X, chunk.y
 
 
 def _auroc(scores, labels) -> float:
@@ -78,7 +88,6 @@ def _load_model(path: str):
 def cmd_fit(args) -> int:
     from .models import ExtendedIsolationForest, IsolationForest
 
-    X, y = _load(args.input, args.labeled)
     kw = dict(
         num_estimators=args.num_estimators,
         max_samples=args.max_samples,
@@ -92,7 +101,17 @@ def cmd_fit(args) -> int:
         est = ExtendedIsolationForest(extension_level=args.extension_level, **kw)
     else:
         est = IsolationForest(**kw)
-    model = est.fit(X)
+    if args.source:
+        # out-of-core path: one streamed sampling pass, bounded memory at
+        # any source size (docs/out_of_core.md)
+        from .io.source import open_source
+
+        src = open_source(args.source, labeled=args.labeled)
+        model = est.fit_source(src, chunk_rows=args.chunk_rows)
+        y = None
+    else:
+        X, y = _load(args.input, args.labeled)
+        model = est.fit(X)
     model.save(args.output, overwrite=args.overwrite)
     summary = {
         "model": args.output,
@@ -100,49 +119,62 @@ def cmd_fit(args) -> int:
         "numSamples": model.num_samples,
         "threshold": model.outlier_score_threshold,
     }
+    if args.source:
+        summary["source"] = args.source
+        summary["sourceShards"] = src.num_shards
     if y is not None:
         summary["auroc"] = round(_auroc(model.score(X), y), 4)
     print(json.dumps(summary))
     return 0
 
 
-def _iter_csv_chunks(in_fh, labeled: bool, chunk_rows: int):
-    """Stream (X, y) chunks from an open CSV handle without materialising
-    the file — the CLI analogue of Spark scoring a Dataset partition by
-    partition."""
-    buf: list = []
-    for line in in_fh:
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        buf.append(line)
-        if len(buf) >= chunk_rows:
-            yield _parse_rows(buf, labeled)
-            buf = []
-    if buf:
-        yield _parse_rows(buf, labeled)
-
-
 def cmd_score(args) -> int:
     model = _load_model(args.model)
+    if args.source:
+        # out-of-core sharded path: scores stream into a resumable sink
+        # directory, one sealed part per shard (docs/out_of_core.md §5)
+        from .io.outofcore import score_source
+        from .io.source import open_source
+
+        if args.output == "-":
+            print(
+                "error: score --source writes a sink directory; pass "
+                "--output <dir>",
+                file=sys.stderr,
+            )
+            return 2
+        src = open_source(args.source, labeled=args.labeled)
+        summary = score_source(
+            model,
+            src,
+            args.output,
+            chunk_rows=args.chunk_rows,
+            strategy=args.strategy,
+            resume=args.resume,
+        )
+        summary["sink"] = args.output
+        print(json.dumps(summary))
+        return 0
     header = "outlierScore,predictedLabel"
-    # open (and thereby validate) the input BEFORE truncating the output —
+    # resolve (and thereby validate) the input BEFORE truncating the output —
     # a missing input must not destroy a pre-existing results file
-    with open(args.input) as in_fh:
-        out_fh = sys.stdout if args.output == "-" else open(args.output, "w")
-        try:
-            out_fh.write(header + "\n")
-            all_scores, all_labels = [], []
-            for X, y in _iter_csv_chunks(in_fh, args.labeled, args.chunk_rows):
-                scores = model.score(X)
-                labels = model.predict(scores)
-                np.savetxt(out_fh, np.stack([scores, labels], axis=1), delimiter=",")
-                if y is not None:
-                    all_scores.append(scores)
-                    all_labels.append(y)
-        finally:
-            if out_fh is not sys.stdout:
-                out_fh.close()
+    from .io.source import open_source
+
+    src = open_source(args.input, labeled=args.labeled)
+    out_fh = sys.stdout if args.output == "-" else open(args.output, "w")
+    try:
+        out_fh.write(header + "\n")
+        all_scores, all_labels = [], []
+        for chunk in src.iter_chunks(chunk_rows=args.chunk_rows):
+            scores = model.score(chunk.X, strategy=args.strategy)
+            labels = model.predict(scores)
+            np.savetxt(out_fh, np.stack([scores, labels], axis=1), delimiter=",")
+            if chunk.y is not None:
+                all_scores.append(scores)
+                all_labels.append(chunk.y)
+    finally:
+        if out_fh is not sys.stdout:
+            out_fh.close()
     if all_labels:
         auroc = _auroc(np.concatenate(all_scores), np.concatenate(all_labels))
         print(json.dumps({"auroc": round(auroc, 4)}), file=sys.stderr)
@@ -363,10 +395,9 @@ def cmd_monitor(args) -> int:
     server = telemetry.serve(port=args.port) if args.port is not None else None
     try:
         rows = 0
-        with open(args.input) as in_fh:
-            for X, _ in _iter_csv_chunks(in_fh, args.labeled, args.chunk_rows):
-                model.score(X)  # folds into the monitor
-                rows += len(X)
+        for X, _ in _iter_input_chunks(args.input, args.labeled, args.chunk_rows):
+            model.score(X)  # folds into the monitor
+            rows += len(X)
     finally:
         if server is not None:
             server.stop()
@@ -421,10 +452,9 @@ def cmd_manage(args) -> int:
     server = telemetry.serve(port=args.port) if args.port is not None else None
     try:
         rows = 0
-        with open(args.input) as in_fh:
-            for X, y in _iter_csv_chunks(in_fh, args.labeled, args.chunk_rows):
-                manager.score(X, y=y)
-                rows += len(X)
+        for X, y in _iter_input_chunks(args.input, args.labeled, args.chunk_rows):
+            manager.score(X, y=y)
+            rows += len(X)
     finally:
         if server is not None:
             server.stop()
@@ -603,9 +633,24 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="isoforest_tpu", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
 
-    fit = sub.add_parser("fit", help="train a model from a CSV")
-    fit.add_argument("--input", required=True)
+    fit = sub.add_parser(
+        "fit", help="train a model from a CSV or a sharded on-disk source"
+    )
+    fit_in = fit.add_mutually_exclusive_group(required=True)
+    fit_in.add_argument("--input", help="CSV file (materialised in memory)")
+    fit_in.add_argument(
+        "--source",
+        help="sharded source — directory, glob, or file of "
+        ".csv/.npy/.avro/.parquet shards; fit streams it out-of-core "
+        "(one bounded-memory pass, docs/out_of_core.md)",
+    )
     fit.add_argument("--output", required=True)
+    fit.add_argument(
+        "--chunk-rows",
+        type=int,
+        default=None,
+        help="rows per streamed chunk for --source (default 65536)",
+    )
     fit.add_argument("--labeled", action="store_true")
     fit.add_argument("--extended", action="store_true")
     fit.add_argument("--num-estimators", type=int, default=100)
@@ -619,9 +664,18 @@ def build_parser() -> argparse.ArgumentParser:
     fit.add_argument("--overwrite", action="store_true")
     fit.set_defaults(func=cmd_fit)
 
-    score = sub.add_parser("score", help="score a CSV with a saved model")
+    score = sub.add_parser(
+        "score", help="score a CSV or a sharded source with a saved model"
+    )
     score.add_argument("--model", required=True)
-    score.add_argument("--input", required=True)
+    score_in = score.add_mutually_exclusive_group(required=True)
+    score_in.add_argument("--input", help="CSV file, scored to --output CSV")
+    score_in.add_argument(
+        "--source",
+        help="sharded source — scores stream shard-by-shard into the "
+        "--output sink directory with resumable sealed parts "
+        "(docs/out_of_core.md §5)",
+    )
     score.add_argument("--output", default="-")
     score.add_argument("--labeled", action="store_true")
     score.add_argument(
@@ -631,6 +685,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="stream the input in chunks of this many rows — bounded memory "
         "for arbitrarily large unlabeled files (--labeled accumulates "
         "scores+labels for the final AUROC report)",
+    )
+    score.add_argument(
+        "--strategy",
+        default="auto",
+        help="scoring strategy (default auto); pin e.g. 'gather' to make a "
+        "--source sink resumable across machines",
+    )
+    score.add_argument(
+        "--resume",
+        action="store_true",
+        help="with --source: re-attach to an existing sink, skipping every "
+        "intact sealed shard (bitwise-identical final output)",
     )
     score.set_defaults(func=cmd_score)
 

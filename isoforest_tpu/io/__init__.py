@@ -1,3 +1,3 @@
-from . import avro, persistence
+from . import avro, outofcore, persistence, source
 
-__all__ = ["avro", "persistence"]
+__all__ = ["avro", "outofcore", "persistence", "source"]

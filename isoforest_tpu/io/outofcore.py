@@ -1,0 +1,308 @@
+"""Out-of-core scoring: stream a sharded source, seal scores shard-by-shard.
+
+:func:`score_source` is the scoring half of the out-of-core data plane
+(docs/out_of_core.md): each source shard is scored chunk-by-chunk through the
+model's streaming executor and its scores are sealed as one atomic part
+directory (``part-00007/`` with ``scores.npy`` + ``part.json`` +
+``_MANIFEST.json``) under the output sink. Because scoring is row-independent
+and chunking is bitwise-neutral (docs/pipeline.md §2), each sealed part is a
+deterministic function of (model, shard, strategy) — so a killed run re-run
+with ``resume=True`` skips every intact sealed part and produces final output
+bitwise-identical to an uninterrupted run. A ``fingerprint.json`` gate
+(model sha + source shard identity + strategy) refuses resumes against a
+different model, source, or scoring strategy — strategies are individually
+deterministic but not mutually bitwise-equal, which is why the *requested*
+strategy string is part of the identity (``"auto"`` included: its resolution
+is device-local and stable within a box, and pinning e.g. ``"gather"``
+makes the sink portable).
+
+Memory model: one decoded chunk + one shard's score vector at a time — RSS
+is bounded by ``O(chunk_rows * num_features + max_shard_rows)`` floats,
+independent of the source's total size.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..resilience import faults, manifest
+from ..resilience.checkpoint import CheckpointMismatchError
+from ..telemetry.events import record_event
+from ..telemetry.metrics import counter as _telemetry_counter
+from ..utils import logger
+from .persistence import _atomic_dir
+from .source import ShardedSource, open_source
+
+FINGERPRINT_NAME = "fingerprint.json"
+SUMMARY_NAME = "_SUMMARY.json"
+SINK_VERSION = 1
+
+# Sealed per-shard score parts (docs/observability.md §3).
+_SHARDS_SEALED_TOTAL = _telemetry_counter(
+    "isoforest_score_source_shards_sealed_total",
+    "Source shards whose scores were sealed by out-of-core scoring runs",
+)
+
+
+def _part_name(index: int) -> str:
+    return f"part-{index:05d}"
+
+
+def model_fingerprint(model) -> str:
+    """sha256 over everything that determines a score: the forest's packed
+    arrays, the ensemble normalisation constant, and the threshold."""
+    h = hashlib.sha256()
+    forest = model.forest
+    for field in type(forest)._fields:
+        arr = np.asarray(getattr(forest, field))
+        h.update(field.encode())
+        h.update(repr((arr.shape, str(arr.dtype))).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    h.update(
+        repr(
+            (
+                int(model.num_samples),
+                float(model.outlier_score_threshold),
+            )
+        ).encode()
+    )
+    return h.hexdigest()
+
+
+def _sink_fingerprint(model, source: ShardedSource, strategy: str) -> dict:
+    return {
+        "sinkVersion": SINK_VERSION,
+        "modelSha256": model_fingerprint(model),
+        "strategy": str(strategy),
+        "source": source.fingerprint(),
+    }
+
+
+def _write_json(path: str, payload: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def _load_sealed_part(sink_dir: str, index: int, shard) -> Optional[np.ndarray]:
+    """Return the sealed scores for shard ``index`` if the part is intact and
+    matches the shard's identity, else None (re-score)."""
+    part_dir = os.path.join(sink_dir, _part_name(index))
+    if not os.path.isdir(part_dir) or not manifest.present(part_dir):
+        return None
+    if manifest.verify(part_dir):
+        logger.warning(
+            "out-of-core sink: sealed part %s failed manifest verification; "
+            "re-scoring shard",
+            part_dir,
+        )
+        return None
+    try:
+        with open(os.path.join(part_dir, "part.json")) as fh:
+            meta = json.load(fh)
+        if (
+            meta.get("shardIndex") != index
+            or meta.get("shardName") != shard.name
+            or meta.get("sizeBytes") != shard.size_bytes
+        ):
+            return None
+        return np.load(os.path.join(part_dir, "scores.npy"))
+    except (OSError, ValueError, json.JSONDecodeError):
+        return None
+
+
+def score_source(
+    model,
+    source,
+    sink_dir: str,
+    *,
+    chunk_rows: Optional[int] = None,
+    strategy: str = "auto",
+    pipeline: Optional[bool] = None,
+    resume: bool = False,
+) -> dict:
+    """Score every row of ``source`` into ``sink_dir``, one sealed part per
+    shard; returns a summary dict (also written as ``_SUMMARY.json``).
+
+    ``resume=True`` re-attaches to an existing sink: the fingerprint gate
+    must match (else :class:`CheckpointMismatchError`), intact sealed parts
+    are skipped, and the final output is bitwise-identical to an
+    uninterrupted run. ``resume=False`` on a non-empty sink refuses rather
+    than silently reusing stale parts.
+    """
+    src = open_source(source) if not isinstance(source, ShardedSource) else source
+    fingerprint = _sink_fingerprint(model, src, strategy)
+    os.makedirs(sink_dir, exist_ok=True)
+    fp_path = os.path.join(sink_dir, FINGERPRINT_NAME)
+    if os.path.exists(fp_path):
+        with open(fp_path) as fh:
+            existing = json.load(fh)
+        if existing != fingerprint:
+            mismatched = sorted(
+                k
+                for k in set(existing) | set(fingerprint)
+                if existing.get(k) != fingerprint.get(k)
+            )
+            raise CheckpointMismatchError(
+                f"out-of-core sink {sink_dir!r} was written for a different "
+                f"{'/'.join(mismatched)}; refusing to "
+                f"{'resume' if resume else 'overwrite'} "
+                "(use a fresh sink directory)",
+                mismatched_fields=mismatched,
+            )
+        if not resume:
+            sealed = [
+                name
+                for name in os.listdir(sink_dir)
+                if name.startswith("part-")
+                and manifest.present(os.path.join(sink_dir, name))
+            ]
+            if sealed:
+                raise CheckpointMismatchError(
+                    f"out-of-core sink {sink_dir!r} already holds "
+                    f"{len(sealed)} sealed part(s); pass resume=True to "
+                    "continue it or use a fresh sink directory",
+                    mismatched_fields=["resume"],
+                )
+    else:
+        _write_json(fp_path, fingerprint)
+
+    t0 = time.perf_counter()
+    record_event(
+        "score_source.begin",
+        sink=os.path.basename(os.path.normpath(sink_dir)),
+        shards=src.num_shards,
+        resume=bool(resume),
+        strategy=str(strategy),
+    )
+
+    total_rows = 0
+    sealed_now = 0
+    skipped = 0
+    shard_seconds = []
+    for index, shard in enumerate(src.shards):
+        if resume:
+            scores = _load_sealed_part(sink_dir, index, shard)
+            if scores is not None:
+                total_rows += int(scores.shape[0])
+                skipped += 1
+                record_event(
+                    "score_source.shard_skipped",
+                    shard=index,
+                    rows=int(scores.shape[0]),
+                )
+                continue
+        t_shard = time.perf_counter()
+        parts = []
+        for chunk in src.iter_chunks(
+            chunk_rows=chunk_rows, start_shard=index, stop_shard=index + 1
+        ):
+            parts.append(
+                np.asarray(
+                    model.score(
+                        chunk.X,
+                        strategy=strategy,
+                        chunk_size=chunk_rows,
+                        pipeline=pipeline,
+                        nonfinite="allow",
+                    )
+                )
+            )
+        scores = (
+            np.concatenate(parts) if len(parts) != 1 else parts[0]
+        ) if parts else np.zeros((0,), dtype=np.float32)
+        part_dir = os.path.join(sink_dir, _part_name(index))
+        with _atomic_dir(part_dir, overwrite=True) as tmp:
+            np.save(os.path.join(tmp, "scores.npy"), scores)
+            with open(os.path.join(tmp, "part.json"), "w") as fh:
+                json.dump(
+                    {
+                        "shardIndex": index,
+                        "shardName": shard.name,
+                        "sizeBytes": shard.size_bytes,
+                        "rows": int(scores.shape[0]),
+                    },
+                    fh,
+                    indent=1,
+                    sort_keys=True,
+                )
+                fh.write("\n")
+            manifest.write(tmp)
+        elapsed = time.perf_counter() - t_shard
+        shard_seconds.append(elapsed)
+        total_rows += int(scores.shape[0])
+        sealed_now += 1
+        _SHARDS_SEALED_TOTAL.inc()
+        record_event(
+            "score_source.shard_sealed",
+            shard=index,
+            rows=int(scores.shape[0]),
+            seconds=round(elapsed, 6),
+        )
+        # preemption seam: fires AFTER the seal, like a real kill landing
+        # between shards (tests/test_out_of_core.py, CI smoke)
+        faults.check_score_shard(index)
+
+    seconds = time.perf_counter() - t0
+    summary = {
+        "shards": src.num_shards,
+        "sealed": sealed_now,
+        "skipped": skipped,
+        "rows": total_rows,
+        "seconds": round(seconds, 6),
+        "rowsPerSecond": round(total_rows / seconds, 3) if seconds > 0 else None,
+        "shardSecondsMean": (
+            round(sum(shard_seconds) / len(shard_seconds), 6)
+            if shard_seconds
+            else None
+        ),
+        "strategy": str(strategy),
+    }
+    _write_json(os.path.join(sink_dir, SUMMARY_NAME), summary)
+    record_event(
+        "score_source.complete",
+        rows=total_rows,
+        sealed=sealed_now,
+        skipped=skipped,
+        seconds=round(seconds, 6),
+    )
+    logger.info(
+        "out-of-core scoring: %d rows over %d shard(s) (%d sealed now, %d "
+        "resumed) in %.3fs",
+        total_rows, src.num_shards, sealed_now, skipped, seconds,
+    )
+    return summary
+
+
+def read_scores(sink_dir: str, num_shards: Optional[int] = None) -> np.ndarray:
+    """Concatenate the sealed per-shard scores of a completed sink in shard
+    order. Raises if any expected part is missing or unsealed."""
+    names = sorted(
+        name
+        for name in os.listdir(sink_dir)
+        if name.startswith("part-") and os.path.isdir(os.path.join(sink_dir, name))
+    )
+    if num_shards is not None and len(names) != num_shards:
+        raise FileNotFoundError(
+            f"sink {sink_dir!r} holds {len(names)} part(s), expected {num_shards}"
+        )
+    if not names:
+        raise FileNotFoundError(f"sink {sink_dir!r} holds no sealed parts")
+    parts = []
+    for name in names:
+        part_dir = os.path.join(sink_dir, name)
+        if not manifest.present(part_dir):
+            raise FileNotFoundError(f"part {part_dir!r} is not sealed")
+        issues = manifest.verify(part_dir)
+        if issues:
+            raise ValueError(f"part {part_dir!r} fails verification: {issues}")
+        parts.append(np.load(os.path.join(part_dir, "scores.npy")))
+    return np.concatenate(parts) if len(parts) > 1 else parts[0]

@@ -1,0 +1,433 @@
+"""Sharded on-disk data sources — the out-of-core data plane's input side.
+
+The reference reads training data as a Spark ``Dataset`` partitioned across
+executors; the single-process analogue is a :class:`ShardedSource`: an ordered
+list of shard files (CSV / NPY / Avro / Parquet), addressed by a directory, a
+glob, or a single file, streamed through a bounded-memory chunk iterator.
+Shard order is the sorted file-name order and global row indices are assigned
+sequentially across that order, so two passes over the same source enumerate
+byte-identical ``(global_row, features)`` pairs — the invariant the streamed
+bagging sampler (ops/bagging.StreamedBagger) and the resumable scoring sink
+(io/outofcore.score_source) both build their determinism on.
+
+Memory model (docs/out_of_core.md §4): ``iter_chunks`` holds at most one
+decoded chunk (``chunk_rows`` rows) plus, for Avro, one shard's compressed
+container bytes; nothing is ever concatenated across shards, so RSS is
+bounded by ``O(chunk_rows * num_features)`` regardless of source size.
+
+Formats:
+
+* ``.csv``  — textual rows, parsed exactly like the CLI's ``np.loadtxt``
+  path (``delimiter=","``, ``#`` comments, blank lines skipped).
+* ``.npy``  — 2-D float arrays, memory-mapped; row counts come from the
+  header, so counting a shard costs a stat + 128 bytes.
+* ``.avro`` — container files written by :func:`write_avro_shard` (records
+  ``{"features": [...]}`` or ``{"features": [...], "label": ...}``); the
+  per-block record counts in the container give row counts without decoding.
+* ``.parquet`` — gated on ``pyarrow`` being importable; absent installs get
+  a clear error naming the dependency instead of an ImportError mid-stream.
+
+``labeled=True`` treats the last column (CSV/NPY) or the ``label`` field
+(Avro/Parquet) as a label, excluded from features — the same convention as
+``python -m isoforest_tpu --labeled``.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import io as _io
+import os
+from dataclasses import dataclass, field
+from typing import Iterator, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from ..telemetry.metrics import counter as _telemetry_counter
+from . import avro as _avro
+
+# Rows streamed out of sharded sources, by shard format
+# (docs/observability.md §3).
+_SOURCE_ROWS_TOTAL = _telemetry_counter(
+    "isoforest_source_rows_total",
+    "Rows streamed from sharded on-disk sources, by shard format",
+    labelnames=("format",),
+)
+
+#: Recognised shard file extensions -> format names.
+SHARD_FORMATS = {
+    ".csv": "csv",
+    ".npy": "npy",
+    ".avro": "avro",
+    ".parquet": "parquet",
+}
+
+#: Default rows per streamed chunk — large enough to amortise per-chunk
+#: dispatch, small enough that a chunk of f32 features stays a few dozen MB.
+DEFAULT_CHUNK_ROWS = 1 << 16
+
+
+class SourceFormatError(ValueError):
+    """A shard has an unrecognised or unavailable format."""
+
+
+class SourceChunk(NamedTuple):
+    """One decoded chunk of a sequential pass.
+
+    ``global_start`` is the absolute row index of ``X[0]`` across the whole
+    source (shard order x row order) — the coordinate the streamed sampler
+    keys on. ``y`` is ``None`` for unlabeled sources.
+    """
+
+    X: np.ndarray
+    y: Optional[np.ndarray]
+    shard_index: int
+    global_start: int
+
+
+def _parquet_module():
+    try:
+        import pyarrow.parquet as pq  # type: ignore
+    except ImportError as exc:  # pragma: no cover - exercised via gate test
+        raise SourceFormatError(
+            "parquet shards require pyarrow, which is not installed; "
+            "convert the source to .npy/.csv/.avro shards or install pyarrow"
+        ) from exc
+    return pq
+
+
+@dataclass
+class Shard:
+    """One shard file: path + format + size, with a lazily-counted row count."""
+
+    path: str
+    format: str
+    size_bytes: int
+    _rows: Optional[int] = field(default=None, repr=False)
+
+    @property
+    def name(self) -> str:
+        return os.path.basename(self.path)
+
+    def count_rows(self) -> int:
+        """Row count, computed as cheaply as the format allows (npy header /
+        avro block counts / parquet metadata; CSV pays a line-counting pass).
+        Cached after the first call."""
+        if self._rows is None:
+            self._rows = _count_rows(self)
+        return self._rows
+
+
+def _count_rows(shard: Shard) -> int:
+    if shard.format == "npy":
+        with open(shard.path, "rb") as fh:
+            version = np.lib.format.read_magic(fh)
+            shape, _, _ = np.lib.format._read_array_header(fh, version)
+        return int(shape[0]) if shape else 0
+    if shard.format == "csv":
+        rows = 0
+        with open(shard.path, "r") as fh:
+            for line in fh:
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    rows += 1
+        return rows
+    if shard.format == "avro":
+        _, blocks = _avro.read_blocks(shard.path)
+        return int(sum(count for count, _ in blocks))
+    if shard.format == "parquet":
+        pq = _parquet_module()
+        return int(pq.ParquetFile(shard.path).metadata.num_rows)
+    raise SourceFormatError(f"unknown shard format {shard.format!r}")
+
+
+def _rows_from_records(records: Sequence[dict], labeled: bool):
+    X = np.asarray([r["features"] for r in records], dtype=np.float32)
+    if X.ndim != 2:
+        X = X.reshape(len(records), -1)
+    if labeled:
+        y = np.asarray(
+            [float(r.get("label", 0.0)) for r in records], dtype=np.float32
+        )
+        return X, y
+    return X, None
+
+
+def _split_label(data: np.ndarray, labeled: bool):
+    data = np.asarray(data, dtype=np.float32)
+    if data.ndim != 2:
+        data = data.reshape(data.shape[0], -1) if data.size else data.reshape(0, 1)
+    if labeled:
+        if data.shape[1] < 2:
+            raise ValueError(
+                f"labeled source needs >= 2 columns (features + label), "
+                f"got {data.shape[1]}"
+            )
+        return np.ascontiguousarray(data[:, :-1]), np.ascontiguousarray(data[:, -1])
+    return data, None
+
+
+def _iter_shard_csv(shard: Shard, labeled: bool, chunk_rows: int):
+    buf: list = []
+    with open(shard.path, "r") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            buf.append(line)
+            if len(buf) >= chunk_rows:
+                data = np.loadtxt(
+                    _io.StringIO("\n".join(buf)), delimiter=",", ndmin=2
+                )
+                buf.clear()
+                yield _split_label(data, labeled)
+        if buf:
+            data = np.loadtxt(_io.StringIO("\n".join(buf)), delimiter=",", ndmin=2)
+            yield _split_label(data, labeled)
+
+
+def _iter_shard_npy(shard: Shard, labeled: bool, chunk_rows: int):
+    mm = np.load(shard.path, mmap_mode="r")
+    if mm.ndim != 2:
+        raise SourceFormatError(
+            f"npy shard {shard.name} must be 2-D, got shape {mm.shape}"
+        )
+    for start in range(0, mm.shape[0], chunk_rows):
+        yield _split_label(np.array(mm[start : start + chunk_rows]), labeled)
+
+
+def _iter_shard_avro(shard: Shard, labeled: bool, chunk_rows: int):
+    schema, blocks = _avro.read_blocks(shard.path)
+    reader_schema = _avro._normalise(schema)
+    buf: list = []
+    for count, payload in blocks:
+        reader = _avro._Reader(payload)
+        for _ in range(count):
+            buf.append(_avro.decode_value(reader_schema, reader))
+            if len(buf) >= chunk_rows:
+                yield _rows_from_records(buf, labeled)
+                buf = []
+    if buf:
+        yield _rows_from_records(buf, labeled)
+
+
+def _iter_shard_parquet(shard: Shard, labeled: bool, chunk_rows: int):
+    pq = _parquet_module()
+    pf = pq.ParquetFile(shard.path)
+    for batch in pf.iter_batches(batch_size=chunk_rows):
+        cols = batch.schema.names
+        if "features" in cols:
+            X = np.asarray(batch.column("features").to_pylist(), dtype=np.float32)
+            if labeled:
+                y = np.asarray(batch.column("label").to_pylist(), dtype=np.float32)
+                yield X, y
+            else:
+                yield X, None
+        else:
+            data = np.column_stack(
+                [np.asarray(batch.column(c), dtype=np.float32) for c in cols]
+            )
+            yield _split_label(data, labeled)
+
+
+_SHARD_ITERATORS = {
+    "csv": _iter_shard_csv,
+    "npy": _iter_shard_npy,
+    "avro": _iter_shard_avro,
+    "parquet": _iter_shard_parquet,
+}
+
+
+class ShardedSource:
+    """An ordered, re-iterable set of on-disk shards.
+
+    Construction resolves and *sorts* the shard list once; every pass
+    (``iter_chunks``) enumerates the same rows in the same global order.
+    """
+
+    def __init__(self, shards: Sequence[Shard], labeled: bool = False):
+        if not shards:
+            raise ValueError("source matched no shard files")
+        self.shards: List[Shard] = list(shards)
+        self.labeled = bool(labeled)
+        self._num_features: Optional[int] = None
+
+    # -- metadata ----------------------------------------------------------
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.shards)
+
+    def shard_rows(self) -> List[int]:
+        """Per-shard row counts (cheap for npy/avro/parquet; one counting
+        pass per CSV shard, cached)."""
+        return [s.count_rows() for s in self.shards]
+
+    def total_rows(self) -> int:
+        return sum(self.shard_rows())
+
+    def num_features(self) -> int:
+        """Feature width, resolved by peeking at the first chunk of the
+        first shard (cached)."""
+        if self._num_features is None:
+            for chunk in self.iter_chunks(chunk_rows=1):
+                self._num_features = int(chunk.X.shape[1])
+                break
+            else:  # pragma: no cover - empty shards
+                raise ValueError("source has no rows")
+        return self._num_features
+
+    def fingerprint(self) -> dict:
+        """Identity of the source for resume gating: shard names + sizes +
+        the labeled flag. Deliberately excludes chunk_rows (chunking is
+        bitwise-neutral, docs/pipeline.md §2) and absolute paths (a moved
+        source directory stays resumable)."""
+        return {
+            "shards": [
+                {"name": s.name, "format": s.format, "sizeBytes": s.size_bytes}
+                for s in self.shards
+            ],
+            "labeled": self.labeled,
+        }
+
+    # -- streaming ---------------------------------------------------------
+
+    def iter_chunks(
+        self,
+        chunk_rows: Optional[int] = None,
+        start_shard: int = 0,
+        stop_shard: Optional[int] = None,
+    ) -> Iterator[SourceChunk]:
+        """Sequential bounded-memory pass: yields :class:`SourceChunk` with
+        absolute ``global_start`` row coordinates. ``start_shard`` /
+        ``stop_shard`` restrict the pass to a shard range (resume / per-shard
+        scoring) while keeping global coordinates — skipped leading shards
+        are counted, not decoded."""
+        chunk_rows = int(chunk_rows or DEFAULT_CHUNK_ROWS)
+        if chunk_rows <= 0:
+            raise ValueError(f"chunk_rows must be > 0, got {chunk_rows}")
+        stop = self.num_shards if stop_shard is None else min(stop_shard, self.num_shards)
+        global_row = sum(s.count_rows() for s in self.shards[:start_shard])
+        for index in range(start_shard, stop):
+            shard = self.shards[index]
+            shard_rows = 0
+            for X, y in _SHARD_ITERATORS[shard.format](shard, self.labeled, chunk_rows):
+                if X.shape[0] == 0:
+                    continue
+                if self._num_features is None:
+                    self._num_features = int(X.shape[1])
+                _SOURCE_ROWS_TOTAL.inc(X.shape[0], format=shard.format)
+                yield SourceChunk(X, y, index, global_row)
+                global_row += X.shape[0]
+                shard_rows += X.shape[0]
+            if shard._rows is None:
+                shard._rows = shard_rows
+            elif shard._rows != shard_rows:
+                raise ValueError(
+                    f"shard {shard.name} row count changed mid-run "
+                    f"({shard._rows} -> {shard_rows}); source must be immutable"
+                )
+
+    def read_all(self, chunk_rows: Optional[int] = None):
+        """Materialise the whole source as ``(X, y)`` — the compatibility
+        path for CLI commands that need the full matrix (fit --input,
+        telemetry, autotune). Still reads chunk-by-chunk, so peak transient
+        memory is one chunk above the final matrix."""
+        xs, ys = [], []
+        for chunk in self.iter_chunks(chunk_rows=chunk_rows):
+            xs.append(chunk.X)
+            if chunk.y is not None:
+                ys.append(chunk.y)
+        if not xs:
+            raise ValueError("source has no rows")
+        X = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
+        y = (np.concatenate(ys, axis=0) if len(ys) > 1 else ys[0]) if ys else None
+        return X, y
+
+
+def _shard_from_path(path: str) -> Shard:
+    ext = os.path.splitext(path)[1].lower()
+    fmt = SHARD_FORMATS.get(ext)
+    if fmt is None:
+        raise SourceFormatError(
+            f"unrecognised shard extension {ext!r} for {path!r} "
+            f"(expected one of {sorted(SHARD_FORMATS)})"
+        )
+    return Shard(path=path, format=fmt, size_bytes=os.path.getsize(path))
+
+
+def open_source(
+    spec: str, labeled: bool = False, formats: Optional[Sequence[str]] = None
+) -> ShardedSource:
+    """Open ``spec`` as a sharded source.
+
+    ``spec`` may be a directory (every recognised shard file inside, sorted
+    by name), a glob pattern (``shards/part-*.npy``), or a single file.
+    ``formats`` optionally restricts which extensions are picked up from a
+    directory (ignored for explicit globs/files).
+    """
+    if isinstance(spec, ShardedSource):
+        return spec
+    paths: List[str]
+    if os.path.isdir(spec):
+        wanted = set(formats) if formats else set(SHARD_FORMATS.values())
+        paths = sorted(
+            os.path.join(spec, name)
+            for name in os.listdir(spec)
+            if os.path.isfile(os.path.join(spec, name))
+            and SHARD_FORMATS.get(os.path.splitext(name)[1].lower()) in wanted
+        )
+        if not paths:
+            raise FileNotFoundError(
+                f"directory {spec!r} contains no shard files "
+                f"({sorted(SHARD_FORMATS)})"
+            )
+    elif os.path.isfile(spec):
+        # single explicit file: unknown extensions default to CSV (the
+        # historical CLI contract — `--input data.txt` parsed as CSV)
+        ext = os.path.splitext(spec)[1].lower()
+        if ext not in SHARD_FORMATS:
+            return ShardedSource(
+                [Shard(path=spec, format="csv", size_bytes=os.path.getsize(spec))],
+                labeled=labeled,
+            )
+        paths = [spec]
+    else:
+        paths = sorted(_glob.glob(spec))
+        if not paths:
+            raise FileNotFoundError(f"source {spec!r} matched no files")
+    return ShardedSource([_shard_from_path(p) for p in paths], labeled=labeled)
+
+
+# -- shard writers (synthetic sources, tests, bench) -----------------------
+
+
+def write_csv_shard(path: str, X: np.ndarray, y: Optional[np.ndarray] = None) -> None:
+    X = np.asarray(X, dtype=np.float32)
+    data = X if y is None else np.column_stack([X, np.asarray(y, dtype=np.float32)])
+    np.savetxt(path, data, delimiter=",", fmt="%.9g")
+
+
+def write_npy_shard(path: str, X: np.ndarray, y: Optional[np.ndarray] = None) -> None:
+    X = np.asarray(X, dtype=np.float32)
+    data = X if y is None else np.column_stack([X, np.asarray(y, dtype=np.float32)])
+    np.save(path, data)
+
+
+def write_avro_shard(path: str, X: np.ndarray, y: Optional[np.ndarray] = None) -> None:
+    """Write an Avro container shard with ``{"features": [...]}`` records
+    (plus ``"label"`` when ``y`` is given) via the pure-python codec."""
+    X = np.asarray(X, dtype=np.float32)
+    fields = [
+        {"name": "features", "type": {"type": "array", "items": "float"}}
+    ]
+    if y is not None:
+        fields.append({"name": "label", "type": "float"})
+        y = np.asarray(y, dtype=np.float32)
+        records = [
+            {"features": row.tolist(), "label": float(lab)}
+            for row, lab in zip(X, y)
+        ]
+    else:
+        records = [{"features": row.tolist()} for row in X]
+    schema = {"type": "record", "name": "Row", "fields": fields}
+    _avro.write_container(path, schema, records)

@@ -37,6 +37,8 @@ from .isolation_forest import (
     _blockwise_grow,
     _capture_fit_baseline,
     _compute_and_set_threshold,
+    _fit_from_sample_impl,
+    _fit_source_impl,
     _new_uid,
     _resolve_subsample_trees,
 )
@@ -185,6 +187,64 @@ class ExtendedIsolationForest(_ParamSetters):
         if baseline and _baseline_env_enabled():
             _capture_fit_baseline(model, X)
         return model
+
+    def fit_from_sample(
+        self,
+        X_sample,
+        bag,
+        *,
+        checkpoint_dir=None,
+        checkpoint_every=None,
+        resume: bool = False,
+        baseline: bool = True,
+        nonfinite: str = "warn",
+        sample_sha256=None,
+        source_rows=None,
+        block_callback=None,
+    ) -> "ExtendedIsolationForestModel":
+        """Fit from a pre-materialised sample — the EIF mirror of
+        :meth:`IsolationForest.fit_from_sample` (docs/out_of_core.md)."""
+        return _fit_from_sample_impl(
+            self,
+            X_sample,
+            bag,
+            extended=True,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            resume=resume,
+            baseline=baseline,
+            nonfinite=nonfinite,
+            sample_sha256=sample_sha256,
+            source_rows=source_rows,
+            block_callback=block_callback,
+        )
+
+    def fit_source(
+        self,
+        source,
+        *,
+        chunk_rows=None,
+        checkpoint_dir=None,
+        checkpoint_every=None,
+        resume: bool = False,
+        baseline: bool = True,
+        nonfinite: str = "warn",
+        block_callback=None,
+    ) -> "ExtendedIsolationForestModel":
+        """Out-of-core fit from a sharded source — the EIF mirror of
+        :meth:`IsolationForest.fit_source` (docs/out_of_core.md)."""
+        return _fit_source_impl(
+            self,
+            source,
+            extended=True,
+            chunk_rows=chunk_rows,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            resume=resume,
+            baseline=baseline,
+            nonfinite=nonfinite,
+            block_callback=block_callback,
+        )
 
     def save(self, path: str, overwrite: bool = False) -> None:
         from ..io.persistence import save_estimator

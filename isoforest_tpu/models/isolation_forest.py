@@ -12,6 +12,7 @@ vector-valued features column (the Dataset analogue); ``transform`` appends
 
 from __future__ import annotations
 
+import math
 import os
 import uuid
 from typing import Optional
@@ -155,6 +156,8 @@ def _blockwise_grow(
     height: int,
     extension_level=None,
     on_block=None,
+    bag_override=None,
+    sampler_sha256=None,
 ):
     """Preemption-safe growth shared by both estimators: grow the forest in
     checkpointed blocks of trees (docs/resilience.md §5).
@@ -168,6 +171,12 @@ def _blockwise_grow(
     Per-tree growth streams are already block-partition-invariant
     (``fold_in(k_grow, tree_id)``; verified bitwise in
     tests/test_checkpoint.py).
+
+    ``bag_override`` replaces the jitted bagging draw with precomputed bags
+    (the out-of-core streamed sampler's, docs/out_of_core.md §3); the key
+    split still happens so feature subsets and growth streams stay on the
+    same (k_feat, k_grow) coordinates, and ``sampler_sha256`` joins the
+    checkpoint fingerprint so a resume cannot mix samples.
     """
     from ..resilience import checkpoint as ckpt
     from ..resilience import faults
@@ -188,18 +197,22 @@ def _blockwise_grow(
         block_trees=block_trees,
         data_sha256=ckpt.data_fingerprint(X_host),
         extension_level=extension_level,
+        sampler_sha256=sampler_sha256,
     )
     state = ckpt.FitCheckpoint(checkpoint_dir, fingerprint)
     state.begin(resume=resume)
 
     k_bag, k_feat, k_grow = jax.random.split(key, 3)
-    bag = bagged_indices(
-        k_bag,
-        int(X_host.shape[0]),
-        resolved.num_samples,
-        num_trees,
-        params.bootstrap,
-    )
+    if bag_override is not None:
+        bag = jnp.asarray(bag_override, jnp.int32)
+    else:
+        bag = bagged_indices(
+            k_bag,
+            int(X_host.shape[0]),
+            resolved.num_samples,
+            num_trees,
+            params.bootstrap,
+        )
     fidx = feature_subsets(
         k_feat, int(X_host.shape[1]), resolved.num_features, num_trees
     )
@@ -246,6 +259,198 @@ def _blockwise_grow(
         }
     )
     return forest, state
+
+
+def _require_absolute_max_samples(params) -> int:
+    """Out-of-core fits can't resolve a fractional ``maxSamples`` — the
+    stream length is unknown until the pass completes — so the param must be
+    an absolute count (the reference default 256.0 qualifies)."""
+    if params.max_samples <= 1.0:
+        raise ValueError(
+            f"out-of-core fit requires an absolute maxSamples (> 1), got "
+            f"fraction {params.max_samples!r}; set max_samples to the "
+            "per-tree sample count (e.g. 256)"
+        )
+    return int(math.floor(params.max_samples))
+
+
+def _fit_from_sample_impl(
+    est,
+    X_sample,
+    bag,
+    *,
+    extended: bool,
+    checkpoint_dir=None,
+    checkpoint_every=None,
+    resume: bool = False,
+    baseline: bool = True,
+    nonfinite: str = "warn",
+    sample_sha256=None,
+    source_rows=None,
+    block_callback=None,
+):
+    """Fit shared by both estimators from a pre-materialised sample: the
+    union matrix ``X_sample [U, F]`` plus per-tree bags indexing into it
+    (``[num_estimators, num_samples]``) — exactly what the streamed sampler
+    (ops/bagging.StreamedBagger) emits. The bag replaces the jitted bagging
+    draw; feature subsets and growth keys still come from the same
+    ``(k_bag, k_feat, k_grow)`` split, so two fits given the same sample are
+    bitwise-identical regardless of how the sample was produced or whether
+    the growth was checkpointed."""
+    p = est.params
+    X = np.asarray(X_sample, dtype=np.float32)
+    if X.ndim != 2 or X.shape[0] == 0:
+        raise ValueError(f"sample matrix must be non-empty 2-D, got shape {X.shape}")
+    bag = np.asarray(bag)
+    if bag.ndim != 2:
+        raise ValueError(f"bag must be [trees, samples], got shape {bag.shape}")
+    if bag.shape[0] != p.num_estimators:
+        raise ValueError(
+            f"bag has {bag.shape[0]} trees but numEstimators={p.num_estimators}"
+        )
+    num_samples = _require_absolute_max_samples(p)
+    if bag.shape[1] != num_samples:
+        raise ValueError(
+            f"bag has {bag.shape[1]} samples per tree but maxSamples "
+            f"resolves to {num_samples}"
+        )
+    if bag.size and (int(bag.min()) < 0 or int(bag.max()) >= X.shape[0]):
+        raise ValueError(
+            f"bag indexes rows outside the sample matrix "
+            f"[0, {X.shape[0]}) (min={int(bag.min())}, max={int(bag.max())})"
+        )
+    check_non_finite(X, nonfinite)
+    U, F = int(X.shape[0]), int(X.shape[1])
+    # max(U, num_samples) keeps resolve_params' small-dataset clamp from
+    # shrinking num_samples below the bag width when the distinct-row union
+    # is small (heavily overlapping bootstrap bags).
+    resolved = resolve_params(p, F, max(U, num_samples))
+    ext_level = None
+    if extended:
+        from ..utils import resolve_extension_level
+
+        ext_level = resolve_extension_level(p.extension_level, resolved.num_features)
+    h = height_limit(resolved.num_samples)
+    key = jax.random.PRNGKey(np.uint32(p.random_seed & 0xFFFFFFFF))
+    Xd = jnp.asarray(X, jnp.float32)
+    if extended:
+        from ..ops.ext_growth import ExtendedForest, grow_extended_forest_block
+
+        forest_cls = ExtendedForest
+        grow_block = lambda tk, bg, fx: grow_extended_forest_block(
+            tk, Xd, bg, fx, height=h, extension_level=ext_level
+        )
+    else:
+        from ..ops.tree_growth import grow_forest_block
+
+        forest_cls = StandardForest
+        grow_block = lambda tk, bg, fx: grow_forest_block(tk, Xd, bg, fx, height=h)
+
+    kind = "extended" if extended else "standard"
+    phase_name = (
+        "extended_isolation_forest.fit.grow" if extended else "isolation_forest.fit.grow"
+    )
+    fit_checkpoint = None
+    with phase(phase_name):
+        if checkpoint_dir is not None:
+            forest, fit_checkpoint = _blockwise_grow(
+                checkpoint_dir,
+                resume,
+                checkpoint_every,
+                key,
+                Xd,
+                kind=kind,
+                forest_cls=forest_cls,
+                grow_block=grow_block,
+                params=p,
+                resolved=resolved,
+                height=h,
+                extension_level=ext_level,
+                on_block=block_callback,
+                bag_override=bag,
+                sampler_sha256=sample_sha256,
+            )
+        else:
+            _, k_feat, k_grow = jax.random.split(key, 3)  # k_bag replaced by `bag`
+            fidx = feature_subsets(k_feat, F, resolved.num_features, p.num_estimators)
+            tree_keys = per_tree_keys(k_grow, p.num_estimators)
+            forest = grow_block(tree_keys, jnp.asarray(bag, jnp.int32), fidx)
+        forest = jax.tree_util.tree_map(jax.block_until_ready, forest)
+
+    _FIT_ROWS_TOTAL.inc(int(source_rows) if source_rows else U, model=kind)
+    _FIT_TREES_TOTAL.inc(p.num_estimators, model=kind)
+    if extended:
+        from .extended import ExtendedIsolationForestModel
+
+        model = ExtendedIsolationForestModel(
+            forest=forest,
+            params=p,
+            num_samples=resolved.num_samples,
+            num_features=resolved.num_features,
+            extension_level=ext_level,
+            total_num_features=F,
+        )
+    else:
+        model = IsolationForestModel(
+            forest=forest,
+            params=p,
+            num_samples=resolved.num_samples,
+            num_features=resolved.num_features,
+            total_num_features=F,
+        )
+    model.fit_checkpoint = fit_checkpoint
+    model.finalize_scoring()
+    # contamination threshold estimated on the materialised sample (the only
+    # rows on hand): a ~T*S-row quantile estimate — docs/out_of_core.md §3
+    _compute_and_set_threshold(model, Xd)
+    if baseline and _baseline_env_enabled():
+        _capture_fit_baseline(model, X)
+    return model
+
+
+def _fit_source_impl(est, source, *, extended: bool, chunk_rows=None, **fit_kw):
+    """One-pass out-of-core fit shared by both estimators: stream the source
+    through the sampler, then fit from the materialised sample
+    (docs/out_of_core.md)."""
+    from ..io.source import open_source
+    from ..ops.bagging import (
+        StreamedBagger,
+        materialise_bootstrap_sample,
+        streamed_bootstrap_indices,
+    )
+
+    src = open_source(source)
+    p = est.params
+    num_samples = _require_absolute_max_samples(p)
+    if p.bootstrap:
+        # with replacement needs N up front (cheap for npy/avro/parquet
+        # shard headers; one counting pass for CSV), then one data pass
+        total = src.total_rows()
+        idx = streamed_bootstrap_indices(
+            p.random_seed, p.num_estimators, num_samples, total
+        )
+        sample = materialise_bootstrap_sample(
+            src.iter_chunks(chunk_rows=chunk_rows), idx
+        )
+    else:
+        bagger = StreamedBagger(p.random_seed, p.num_estimators, num_samples)
+        for chunk in src.iter_chunks(chunk_rows=chunk_rows):
+            bagger.consume(chunk.X)
+        sample = bagger.finalize()
+    logger.info(
+        "streamed sample: %d distinct rows from a %d-row source "
+        "(%d trees x %d samples)",
+        sample.X.shape[0], sample.total_rows, p.num_estimators, num_samples,
+    )
+    return _fit_from_sample_impl(
+        est,
+        sample.X,
+        sample.bag,
+        extended=extended,
+        sample_sha256=sample.sha256,
+        source_rows=sample.total_rows,
+        **fit_kw,
+    )
 
 
 class _ParamSetters:
@@ -443,6 +648,76 @@ class IsolationForest(_ParamSetters):
         if baseline and _baseline_env_enabled():
             _capture_fit_baseline(model, X)
         return model
+
+    def fit_from_sample(
+        self,
+        X_sample,
+        bag,
+        *,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: Optional[int] = None,
+        resume: bool = False,
+        baseline: bool = True,
+        nonfinite: str = "warn",
+        sample_sha256: Optional[str] = None,
+        source_rows: Optional[int] = None,
+        block_callback=None,
+    ) -> "IsolationForestModel":
+        """Fit from a pre-materialised per-tree sample: ``X_sample`` is the
+        ``[U, F]`` union of selected rows and ``bag`` the
+        ``[numEstimators, numSamples]`` indices into it (what
+        :class:`~isoforest_tpu.ops.bagging.StreamedBagger` emits). Growth,
+        threshold and baseline are computed from the sample alone, so the
+        result is independent of how (or from how many source rows) the
+        sample was drawn — the bitwise contract behind :meth:`fit_source`.
+        Supports the same ``checkpoint_dir``/``resume`` block-wise growth as
+        :meth:`fit`."""
+        return _fit_from_sample_impl(
+            self,
+            X_sample,
+            bag,
+            extended=False,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            resume=resume,
+            baseline=baseline,
+            nonfinite=nonfinite,
+            sample_sha256=sample_sha256,
+            source_rows=source_rows,
+            block_callback=block_callback,
+        )
+
+    def fit_source(
+        self,
+        source,
+        *,
+        chunk_rows: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: Optional[int] = None,
+        resume: bool = False,
+        baseline: bool = True,
+        nonfinite: str = "warn",
+        block_callback=None,
+    ) -> "IsolationForestModel":
+        """Out-of-core fit from a sharded on-disk source (a path / glob /
+        :class:`~isoforest_tpu.io.source.ShardedSource`): one sequential
+        bounded-memory pass streams the source through the one-pass sampler,
+        then fits from the materialised sample (docs/out_of_core.md).
+        Deterministic under ``random_seed`` and bitwise-invariant to
+        ``chunk_rows`` and shard-size choices. Requires an absolute
+        ``max_samples``."""
+        return _fit_source_impl(
+            self,
+            source,
+            extended=False,
+            chunk_rows=chunk_rows,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            resume=resume,
+            baseline=baseline,
+            nonfinite=nonfinite,
+            block_callback=block_callback,
+        )
 
     # -- persistence (estimator: params-only metadata, IsolationForest.scala:114-125)
     def save(self, path: str, overwrite: bool = False) -> None:

@@ -20,9 +20,12 @@ acceptance gates are statistical, SURVEY.md §7.4.3).
 from __future__ import annotations
 
 import functools
+import hashlib
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # Below this many transient elements the full per-tree permutation is cheap;
 # above it, an N-independent sampler must take over.
@@ -185,6 +188,257 @@ def feature_subsets(
         return jnp.sort(perm).astype(jnp.int32)
 
     return jax.vmap(subset)(tree_keys)
+
+
+# --------------------------------------------------------------------------- #
+# Streamed one-pass sampling (out-of-core fit, docs/out_of_core.md §3)
+# --------------------------------------------------------------------------- #
+#
+# The jitted samplers above need the full [N, F] matrix resident; an
+# out-of-core source only ever exposes one chunk at a time, in one sequential
+# pass. The streamed sampler keys every (tree, global_row) pair with a 64-bit
+# splitmix64 hash of (seed, tree, row) and keeps, per tree, the S rows with the
+# smallest keys — a symmetric function of i.i.d. draws, so every S-subset is
+# equally likely (the same argument as _topk_sample, with the opposite
+# extremum). Because keys depend only on the seed and the *absolute* row
+# index, the selected bags are bitwise-identical for any chunk-size choice and
+# across re-reads of the same source — the property the fit-parity and
+# resume guarantees are built on. Host-side numpy on purpose: the stream
+# arrives on the host, S*T rows is tiny, and no device round-trip is needed.
+
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+_MIX_1 = np.uint64(0xBF58476D1CE4E5B9)
+_MIX_2 = np.uint64(0x94D049BB133111EB)
+_KEY_SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)
+_ROW_SENTINEL = np.int64(2**63 - 1)
+# Rows hashed per inner block: keeps the [T, block] key transient ~tens of MB.
+_STREAM_BLOCK_ROWS = 1 << 16
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer over uint64 arrays (Steele et al. 2014) — a
+    bijective avalanche mix, implemented directly so the key stream is
+    independent of the numpy/jax RNG implementations."""
+    x = np.asarray(x, dtype=np.uint64).copy()
+    x ^= x >> np.uint64(30)
+    x *= _MIX_1
+    x ^= x >> np.uint64(27)
+    x *= _MIX_2
+    x ^= x >> np.uint64(31)
+    return x
+
+
+def _tree_salts(seed: int, num_trees: int) -> np.ndarray:
+    """Per-tree uint64 salts: mix(seed) advanced by the golden-gamma per tree
+    (the splitmix64 stream), then finalized — independent streams per tree."""
+    base = np.uint64(seed & 0xFFFFFFFFFFFFFFFF)
+    t = np.arange(1, num_trees + 1, dtype=np.uint64)
+    return _mix64(base + t * _GOLDEN)
+
+
+def _row_hash(global_rows: np.ndarray) -> np.ndarray:
+    """``uint64[C]`` fully-mixed per-row values — i.i.d.-uniform-quality keys
+    from absolute row indices, shared across trees (one splitmix per row)."""
+    return _mix64((global_rows.astype(np.uint64) + np.uint64(1)) * _GOLDEN)
+
+
+def _row_keys(
+    xor_salts: np.ndarray, mul_salts: np.ndarray, row_hash: np.ndarray
+) -> np.ndarray:
+    """``uint64[T, C]`` keys for (tree, absolute row) pairs.
+
+    Two-stage construction, chosen for throughput at the [T, C] scale (the
+    sampler's dominant cost at 100M+ rows): the expensive 8-op splitmix64
+    finalizer runs once per ROW (:func:`_row_hash`), and the per-tree stage
+    is a 2-round multiplicative scramble — xor a per-tree salt, multiply by
+    a per-tree odd constant, xor-shift, multiply by a fixed odd constant.
+    Per tree this is a bijection of uint64 composed with an i.i.d.-uniform
+    row key, so keys stay exactly i.i.d.-uniform per tree (bottom-S of them
+    is an exactly uniform S-subset); the per-tree salts + multipliers
+    decorrelate trees (cross-tree bag overlap is pinned at the binomial
+    S^2/N level in tests/test_out_of_core.py)."""
+    keys = np.bitwise_xor(xor_salts[:, None], row_hash[None, :])
+    keys *= mul_salts[:, None]
+    keys ^= keys >> np.uint64(29)
+    keys *= _MIX_2
+    return keys
+
+
+class StreamedSample(NamedTuple):
+    """The materialised output of a streamed sampling pass.
+
+    ``X`` is the union matrix of every selected row (``f32[U, F]``, rows in
+    ascending global-row order); ``bag`` indexes into it per tree
+    (``int32[T, S]``); ``rows`` holds the corresponding absolute source rows
+    (``int64[U]``); ``total_rows`` is the stream length consumed.
+    ``sha256`` fingerprints the sample content for checkpoint gating.
+    """
+
+    X: np.ndarray
+    bag: np.ndarray
+    rows: np.ndarray
+    total_rows: int
+    sha256: str
+
+
+def _sample_sha256(X: np.ndarray, bag: np.ndarray, rows: np.ndarray) -> str:
+    h = hashlib.sha256()
+    h.update(repr((X.shape, str(X.dtype), bag.shape)).encode())
+    h.update(np.ascontiguousarray(X).tobytes())
+    h.update(np.ascontiguousarray(bag).tobytes())
+    h.update(np.ascontiguousarray(rows).tobytes())
+    return h.hexdigest()
+
+
+class StreamedBagger:
+    """One-pass bottom-S reservoir over an arbitrarily long row stream.
+
+    Feed sequential chunks with :meth:`consume` (absolute row order, no gaps),
+    then :meth:`finalize`. Memory is bounded by the reservoirs
+    (``[T, S]`` keys + rows) plus the store of currently-selected feature rows
+    (at most ``T * S`` rows, typically far fewer due to overlap) — independent
+    of stream length. Sampling is without replacement per tree; for
+    ``bootstrap=True`` see :func:`streamed_bootstrap_indices`.
+    """
+
+    def __init__(self, seed: int, num_trees: int, num_samples: int):
+        if num_trees <= 0 or num_samples <= 0:
+            raise ValueError(
+                f"need num_trees > 0 and num_samples > 0, got "
+                f"{num_trees}/{num_samples}"
+            )
+        self.num_trees = int(num_trees)
+        self.num_samples = int(num_samples)
+        self._xor_salts = _tree_salts(seed, num_trees)
+        # independent odd multipliers per tree (odd => bijective mod 2^64)
+        self._mul_salts = _tree_salts(~seed & 0xFFFFFFFFFFFFFFFF, num_trees) | np.uint64(1)
+        # Reservoirs kept sorted ascending by (key, row): column -1 is the
+        # per-tree admission threshold.
+        self._res_keys = np.full(
+            (num_trees, num_samples), _KEY_SENTINEL, dtype=np.uint64
+        )
+        self._res_rows = np.full(
+            (num_trees, num_samples), _ROW_SENTINEL, dtype=np.int64
+        )
+        self._store: dict = {}  # global row -> f32 feature row
+        self._rows_seen = 0
+        self._num_features: Optional[int] = None
+
+    def consume(self, X_chunk: np.ndarray) -> None:
+        X = np.asarray(X_chunk, dtype=np.float32)
+        if X.ndim != 2:
+            raise ValueError(f"chunk must be 2-D, got shape {X.shape}")
+        if self._num_features is None:
+            self._num_features = X.shape[1]
+        elif X.shape[1] != self._num_features:
+            raise ValueError(
+                f"chunk width {X.shape[1]} != source width {self._num_features}"
+            )
+        start = self._rows_seen
+        for off in range(0, X.shape[0], _STREAM_BLOCK_ROWS):
+            self._consume_block(X[off : off + _STREAM_BLOCK_ROWS], start + off)
+        self._rows_seen += X.shape[0]
+
+    def _consume_block(self, X: np.ndarray, start: int) -> None:
+        rows = np.arange(start, start + X.shape[0], dtype=np.int64)
+        keys = _row_keys(self._xor_salts, self._mul_salts, _row_hash(rows))  # [T, C]
+        # A new row is admitted iff its key beats the tree's current max;
+        # key ties lose to the incumbent (smaller row index — the stream is
+        # sequential, so incumbents always predate the block).
+        cand = keys < self._res_keys[:, -1][:, None]
+        touched = np.nonzero(cand.any(axis=1))[0]
+        for t in touched:
+            ck, cr = keys[t, cand[t]], rows[cand[t]]
+            mk = np.concatenate([self._res_keys[t], ck])
+            mr = np.concatenate([self._res_rows[t], cr])
+            order = np.lexsort((mr, mk))[: self.num_samples]
+            self._res_keys[t] = mk[order]
+            self._res_rows[t] = mr[order]
+        if len(touched) == 0:
+            return
+        # Refresh the row store: add this block's survivors, drop evictees.
+        live = np.unique(self._res_rows)
+        live = live[live != _ROW_SENTINEL]
+        fresh = live[(live >= start) & (live < start + X.shape[0])]
+        for r in fresh.tolist():
+            self._store[r] = X[r - start].copy()
+        if len(self._store) > live.size:
+            live_set = set(live.tolist())
+            for r in [r for r in self._store if r not in live_set]:
+                del self._store[r]
+
+    def finalize(self) -> StreamedSample:
+        """Materialise ``(X, bag)``. Raises if the stream was shorter than
+        ``num_samples`` (cannot draw S distinct rows from fewer)."""
+        if self._rows_seen < self.num_samples:
+            raise ValueError(
+                f"cannot draw {self.num_samples} distinct rows from a "
+                f"{self._rows_seen}-row stream (bootstrap=False)"
+            )
+        rows = np.unique(self._res_rows)
+        rows = rows[rows != _ROW_SENTINEL]
+        X = np.stack([self._store[r] for r in rows.tolist()]).astype(np.float32)
+        bag = np.searchsorted(rows, self._res_rows).astype(np.int32)
+        return StreamedSample(
+            X=X,
+            bag=bag,
+            rows=rows,
+            total_rows=self._rows_seen,
+            sha256=_sample_sha256(X, bag, rows),
+        )
+
+
+def streamed_bootstrap_indices(
+    seed: int, num_trees: int, num_samples: int, total_rows: int
+) -> np.ndarray:
+    """With-replacement bags for the streamed path: ``int64[T, S]`` absolute
+    row indices, each slot an independent draw ``key(t, s) mod N`` from the
+    same splitmix64 stream as the reservoir (modulo bias ~N/2^64 —
+    negligible at any feasible N). Needs ``total_rows`` up front, so
+    bootstrap sources pay a row-counting pass before the data pass."""
+    if total_rows <= 0:
+        raise ValueError(f"dataset is empty (totalRows={total_rows})")
+    salts = _tree_salts(~seed & 0xFFFFFFFFFFFFFFFF, num_trees)
+    slots = np.arange(1, num_samples + 1, dtype=np.uint64) * _GOLDEN
+    keys = _mix64(salts[:, None] ^ _mix64(slots)[None, :])
+    return (keys % np.uint64(total_rows)).astype(np.int64)
+
+
+def materialise_bootstrap_sample(
+    chunks, indices: np.ndarray
+) -> StreamedSample:
+    """Collect the rows named by :func:`streamed_bootstrap_indices` in one
+    sequential pass over ``chunks`` (an iterable of objects with ``.X`` and
+    ``.global_start``). Returns the same :class:`StreamedSample` shape as the
+    reservoir path — ``X`` is the union of distinct rows, ``bag`` maps each
+    (tree, slot) to its union position."""
+    rows = np.unique(indices)
+    X_parts: dict = {}
+    total = 0
+    for chunk in chunks:
+        start = chunk.global_start
+        stop = start + chunk.X.shape[0]
+        total = stop
+        lo, hi = np.searchsorted(rows, [start, stop])
+        for r in rows[lo:hi].tolist():
+            X_parts[r] = np.asarray(
+                chunk.X[r - start], dtype=np.float32
+            ).copy()
+    missing = [r for r in rows.tolist() if r not in X_parts]
+    if missing:
+        raise ValueError(
+            f"bootstrap drew row {missing[0]} but the stream ended at "
+            f"{total} rows (source shrank between the counting and data passes?)"
+        )
+    X = np.stack([X_parts[r] for r in rows.tolist()]).astype(np.float32)
+    bag = np.searchsorted(rows, indices).astype(np.int32)
+    return StreamedSample(
+        X=X,
+        bag=bag,
+        rows=rows,
+        total_rows=total,
+        sha256=_sample_sha256(X, bag, rows),
+    )
 
 
 def gather_tree_data(X: jax.Array, bag_idx: jax.Array, feat_idx: jax.Array) -> jax.Array:

@@ -132,11 +132,17 @@ def fit_fingerprint(
     block_trees: int,
     data_sha256: str,
     extension_level: Optional[int] = None,
+    sampler_sha256: Optional[str] = None,
 ) -> Dict[str, object]:
     """Everything that determines the grown forest's bits (plus the block
     partition): a resumed fit must agree on every field or the resumed
-    forest could silently differ from the uninterrupted one."""
-    return {
+    forest could silently differ from the uninterrupted one.
+
+    ``sampler_sha256`` is set only by the out-of-core fit path (the streamed
+    sampler's sample-content hash, docs/out_of_core.md §3); it is added to
+    the fingerprint *conditionally* so checkpoints written before the field
+    existed keep resuming byte-for-byte."""
+    out = {
         "checkpointVersion": CHECKPOINT_VERSION,
         "kind": kind,
         "randomSeed": int(random_seed),
@@ -151,6 +157,9 @@ def fit_fingerprint(
         "extensionLevel": None if extension_level is None else int(extension_level),
         "dataSha256": str(data_sha256),
     }
+    if sampler_sha256 is not None:
+        out["samplerSha256"] = str(sampler_sha256)
+    return out
 
 
 def _fingerprint_sha(fingerprint: Dict[str, object]) -> str:

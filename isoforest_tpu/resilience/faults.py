@@ -16,6 +16,10 @@ single dict lookup when no fault is armed):
   :func:`check_fit_block` — ``kill_fit_after_block=<k>`` aborts the fit
   immediately after block ``k`` seals (the preemption-mid-fit case the
   resume path exists for);
+* out-of-core scoring (``io/outofcore.score_source``) ->
+  :func:`check_score_shard` — ``kill_score_after_shard=<k>`` aborts the
+  scoring run immediately after shard ``k``'s scores seal (the
+  preemption-mid-scoring case ``resume=True`` exists for);
 * ``parallel.mesh.initialize_distributed`` ->
   :func:`take_distributed_init_failure` — ``fail_distributed_init=<n>``
   makes the first ``n`` bring-up attempts raise (coordinator not up yet /
@@ -78,6 +82,7 @@ KNOWN_FAULTS = frozenset(
         "hide_native",
         "raise_strategy",
         "kill_fit_after_block",
+        "kill_score_after_shard",
         "kill_retrain_after_block",
         "corrupt_candidate",
         "fail_validation",
@@ -208,6 +213,25 @@ def check_fit_block(block_index: int) -> None:
             f"injected fault: fit killed after sealing block {block_index} "
             f"(kill_fit_after_block={value!r}) — resume with "
             "fit(..., resume=True)"
+        )
+
+
+def check_score_shard(shard_index: int) -> None:
+    """Raise :class:`FaultInjectedError` when ``kill_score_after_shard``
+    names the source shard whose scores just SEALED — the out-of-core
+    scoring preemption seam (io/outofcore.score_source). Like
+    :func:`check_fit_block` it fires after the seal, so the durable state is
+    exactly what a real kill landing between shards would leave behind;
+    ``score_source(..., resume=True)`` must then skip every sealed shard and
+    produce bitwise-identical final output (docs/out_of_core.md §5)."""
+    value = get("kill_score_after_shard")
+    if value is None or value is False:
+        return
+    if int(value) == int(shard_index):
+        raise FaultInjectedError(
+            f"injected fault: scoring killed after sealing shard {shard_index} "
+            f"(kill_score_after_shard={value!r}) — resume with "
+            "score_source(..., resume=True)"
         )
 
 

@@ -1,0 +1,371 @@
+"""Out-of-core data plane (docs/out_of_core.md): sharded sources, the
+one-pass streamed sampler, bitwise fit parity with the in-memory path, and
+resumable shard-sealed scoring.
+
+The contracts pinned here:
+
+* the keyed bottom-S reservoir draws uniform without-replacement samples —
+  inclusion counts sit inside binomial tolerance and pairwise tree overlap
+  at the S^2/N level (the decorrelation argument in ops/bagging.py);
+* the same seed yields **bitwise-identical** samples for any chunking of
+  the stream, so fits are reproducible across re-reads and shard layouts;
+* ``fit_source`` is bitwise-identical (forest arrays, threshold, scores)
+  to ``fit_from_sample`` on the equivalent materialised sample, std and
+  extended, plain and bootstrap;
+* a scoring run killed between shards (``kill_score_after_shard``) and
+  resumed produces output bitwise-identical to an uninterrupted run, and
+  the sink's fingerprint gate refuses mismatched model / strategy / resume.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from isoforest_tpu import ExtendedIsolationForest, IsolationForest
+from isoforest_tpu.io import source as srcmod
+from isoforest_tpu.io.outofcore import read_scores, score_source
+from isoforest_tpu.io.source import SourceFormatError, open_source
+from isoforest_tpu.ops.bagging import (
+    StreamedBagger,
+    materialise_bootstrap_sample,
+    streamed_bootstrap_indices,
+)
+from isoforest_tpu.resilience import CheckpointMismatchError, faults
+
+N, F = 6000, 5
+SEED = 23
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(41)
+    X = rng.normal(size=(N, F)).astype(np.float32)
+    X[:60] += 6.0
+    y = np.zeros(N, dtype=np.float32)
+    y[:60] = 1.0
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def shard_dir(tmp_path_factory, data):
+    """Four unevenly sized .npy shards covering ``data`` exactly."""
+    X, _ = data
+    d = tmp_path_factory.mktemp("shards")
+    bounds = [0, 1000, 2500, 5999, N]
+    for i in range(4):
+        srcmod.write_npy_shard(
+            str(d / f"part-{i:03d}.npy"), X[bounds[i] : bounds[i + 1]]
+        )
+    return str(d)
+
+
+def _chunks(X, sizes):
+    """SourceChunk-shaped stream of ``X`` cut at the given sizes (cycled)."""
+    out, start, i = [], 0, 0
+    while start < len(X):
+        n = sizes[i % len(sizes)]
+        out.append(
+            srcmod.SourceChunk(
+                X=X[start : start + n], y=None, shard_index=0, global_start=start
+            )
+        )
+        start += n
+        i += 1
+    return out
+
+
+class TestStreamedSampler:
+    def test_chunk_invariance_bitwise(self, data):
+        X, _ = data
+        samples = []
+        for sizes in ([N], [512], [7, 997, 64], [1, 2, 3]):
+            b = StreamedBagger(SEED, num_trees=8, num_samples=32)
+            for c in _chunks(X, sizes):
+                b.consume(c.X)
+            samples.append(b.finalize())
+        ref = samples[0]
+        for s in samples[1:]:
+            assert s.sha256 == ref.sha256
+            assert np.array_equal(s.X, ref.X)
+            assert np.array_equal(s.bag, ref.bag)
+            assert np.array_equal(s.rows, ref.rows)
+
+    def test_rows_map_back_to_source(self, data):
+        X, _ = data
+        b = StreamedBagger(SEED, num_trees=4, num_samples=16)
+        b.consume(X)
+        s = b.finalize()
+        assert np.array_equal(s.X, X[s.rows])
+        assert s.total_rows == N
+        # every bag row resolves inside the union, no tree repeats a row
+        assert s.bag.min() >= 0 and s.bag.max() < len(s.rows)
+        for t in range(4):
+            assert len(np.unique(s.bag[t])) == 16
+
+    def test_inclusion_probability_binomial(self):
+        # each of T trees draws S of n uniformly without replacement, so a
+        # row's inclusion count ~ Binomial(T, S/n): check the aggregate mean
+        # exactly and every per-row count within 5 sigma
+        n, S, T = 2000, 64, 300
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(n, 2)).astype(np.float32)
+        b = StreamedBagger(901, num_trees=T, num_samples=S)
+        b.consume(X)
+        s = b.finalize()
+        counts = np.zeros(n)
+        src_rows = s.rows[s.bag]  # [T, S] absolute source rows
+        for t in range(T):
+            counts[src_rows[t]] += 1
+        p = S / n
+        assert counts.sum() == T * S  # mean is exact by construction
+        sigma = np.sqrt(T * p * (1 - p))
+        assert np.abs(counts - T * p).max() < 5 * sigma
+
+    def test_cross_tree_overlap_binomial(self):
+        # pairwise overlap |A ^ B| ~ Hypergeometric mean S^2/n — the
+        # decorrelation contract behind the per-tree multiplicative
+        # scramble in ops/bagging._row_keys
+        n, S, T = 2000, 64, 60
+        rng = np.random.default_rng(6)
+        X = rng.normal(size=(n, 2)).astype(np.float32)
+        b = StreamedBagger(902, num_trees=T, num_samples=S)
+        b.consume(X)
+        s = b.finalize()
+        src_rows = s.rows[s.bag]
+        sets = [frozenset(src_rows[t].tolist()) for t in range(T)]
+        overlaps = [
+            len(sets[i] & sets[j]) for i in range(T) for j in range(i + 1, T)
+        ]
+        expected = S * S / n  # 2.048
+        mean = float(np.mean(overlaps))
+        assert abs(mean - expected) < 0.35
+
+    def test_insufficient_rows_raises(self):
+        b = StreamedBagger(1, num_trees=2, num_samples=64)
+        b.consume(np.zeros((10, 3), np.float32))
+        with pytest.raises(ValueError, match="64"):
+            b.finalize()
+
+    def test_bootstrap_chunk_invariance(self, data):
+        X, _ = data
+        idx = streamed_bootstrap_indices(SEED, num_trees=6, num_samples=48, total_rows=N)
+        assert idx.shape == (6, 48)
+        ref = materialise_bootstrap_sample(_chunks(X, [N]), idx)
+        for sizes in ([333], [7, 997]):
+            alt = materialise_bootstrap_sample(_chunks(X, sizes), idx)
+            assert np.array_equal(alt.X, ref.X)
+            assert np.array_equal(alt.bag, ref.bag)
+            assert alt.sha256 == ref.sha256
+
+
+class TestShardedSource:
+    def test_npy_roundtrip_and_bookkeeping(self, data, shard_dir):
+        X, _ = data
+        src = open_source(shard_dir)
+        assert src.num_shards == 4
+        assert src.total_rows() == N
+        assert src.num_features() == F
+        assert np.array_equal(src.read_all()[0], X)
+        seen = 0
+        for c in src.iter_chunks(chunk_rows=701):
+            assert c.global_start == seen
+            seen += c.X.shape[0]
+            assert c.X.shape[0] <= 701
+        assert seen == N
+
+    def test_csv_and_avro_roundtrip(self, tmp_path, data):
+        X, y = data
+        Xs, ys = X[:500], y[:500]
+        for fmt, writer in (
+            ("csv", srcmod.write_csv_shard),
+            ("avro", srcmod.write_avro_shard),
+        ):
+            d = tmp_path / fmt
+            d.mkdir()
+            writer(str(d / f"a.{fmt}"), Xs[:200], ys[:200])
+            writer(str(d / f"b.{fmt}"), Xs[200:], ys[200:])
+            got_X, got_y = open_source(str(d), labeled=True).read_all()
+            assert np.array_equal(got_X, Xs), fmt
+            assert np.array_equal(got_y, ys), fmt
+
+    def test_glob_and_single_file(self, shard_dir, data):
+        X, _ = data
+        pat = os.path.join(shard_dir, "part-00[01].npy")
+        src = open_source(pat)
+        assert src.num_shards == 2
+        assert np.array_equal(src.read_all()[0], X[:2500])
+        one = open_source(glob.glob(os.path.join(shard_dir, "*.npy"))[0])
+        assert one.num_shards == 1
+
+    def test_parquet_gate(self, tmp_path):
+        p = tmp_path / "x.parquet"
+        p.write_bytes(b"PAR1")
+        has_pyarrow = True
+        try:
+            import pyarrow.parquet  # noqa: F401
+        except ImportError:
+            has_pyarrow = False
+        if has_pyarrow:
+            pytest.skip("pyarrow present: gate not exercised")
+        with pytest.raises(SourceFormatError, match="pyarrow"):
+            open_source(str(p)).total_rows()
+
+    def test_empty_source_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            open_source(str(tmp_path))
+
+
+def _std():
+    return IsolationForest(
+        num_estimators=10, max_samples=64.0, contamination=0.02, random_seed=SEED
+    )
+
+
+def _ext():
+    return ExtendedIsolationForest(
+        num_estimators=10, max_samples=64.0, contamination=0.02, random_seed=SEED
+    )
+
+
+def _assert_models_bitwise(a, b, X_probe):
+    for field in type(a.forest)._fields:
+        fa = np.asarray(getattr(a.forest, field))
+        fb = np.asarray(getattr(b.forest, field))
+        assert np.array_equal(fa, fb, equal_nan=True), field
+    assert a.outlier_score_threshold == b.outlier_score_threshold
+    sa = np.asarray(a.score(X_probe, strategy="gather"))
+    sb = np.asarray(b.score(X_probe, strategy="gather"))
+    assert np.array_equal(sa, sb)
+
+
+class TestFitParity:
+    @pytest.mark.parametrize("make", [_std, _ext], ids=["std", "ext"])
+    def test_fit_source_bitwise_vs_fit_from_sample(self, make, data, shard_dir):
+        X, _ = data
+        b = StreamedBagger(SEED, num_trees=10, num_samples=64)
+        b.consume(X)
+        s = b.finalize()
+        ref = make().fit_from_sample(s.X, s.bag, baseline=False)
+        ooc = make().fit_source(shard_dir, chunk_rows=997, baseline=False)
+        _assert_models_bitwise(ref, ooc, X[:256])
+
+    def test_fit_source_chunk_rows_invariant(self, data, shard_dir):
+        X, _ = data
+        a = _std().fit_source(shard_dir, chunk_rows=64, baseline=False)
+        b = _std().fit_source(shard_dir, baseline=False)
+        _assert_models_bitwise(a, b, X[:256])
+
+    def test_bootstrap_fit_source(self, data, shard_dir):
+        X, _ = data
+
+        def est():
+            return IsolationForest(
+                num_estimators=8,
+                max_samples=48.0,
+                bootstrap=True,
+                contamination=0.02,
+                random_seed=SEED,
+            )
+
+        idx = streamed_bootstrap_indices(SEED, 8, 48, N)
+        s = materialise_bootstrap_sample(_chunks(X, [N]), idx)
+        ref = est().fit_from_sample(s.X, s.bag, baseline=False)
+        ooc = est().fit_source(shard_dir, chunk_rows=313, baseline=False)
+        _assert_models_bitwise(ref, ooc, X[:256])
+
+    def test_fractional_max_samples_rejected(self, shard_dir):
+        est = IsolationForest(num_estimators=4, max_samples=0.5, random_seed=1)
+        with pytest.raises(ValueError, match="absolute"):
+            est.fit_source(shard_dir)
+
+
+class TestScoreSink:
+    @pytest.fixture(scope="class")
+    def model(self, data, shard_dir):
+        return _std().fit_source(shard_dir, baseline=False)
+
+    def test_matches_in_memory_scoring(self, model, data, shard_dir, tmp_path):
+        X, _ = data
+        sink = str(tmp_path / "sink")
+        summary = score_source(model, shard_dir, sink, strategy="gather")
+        assert summary["shards"] == 4 and summary["sealed"] == 4
+        assert summary["rows"] == N
+        got = read_scores(sink, num_shards=4)
+        want = np.asarray(model.score(X, strategy="gather"))
+        assert np.array_equal(got, want)
+
+    def test_kill_and_resume_bitwise(self, model, shard_dir, tmp_path):
+        clean = str(tmp_path / "clean")
+        score_source(model, shard_dir, clean, strategy="gather")
+        sink = str(tmp_path / "killed")
+        with faults.inject(kill_score_after_shard=1):
+            with pytest.raises(faults.FaultInjectedError):
+                score_source(model, shard_dir, sink, strategy="gather")
+        # shards 0..1 sealed before the kill landed
+        sealed = sorted(
+            n for n in os.listdir(sink) if n.startswith("part-")
+        )
+        assert sealed == ["part-00000", "part-00001"]
+        summary = score_source(
+            model, shard_dir, sink, strategy="gather", resume=True
+        )
+        assert summary["skipped"] == 2 and summary["sealed"] == 2
+        assert np.array_equal(read_scores(sink), read_scores(clean))
+
+    def test_refuses_unflagged_reuse(self, model, shard_dir, tmp_path):
+        sink = str(tmp_path / "reuse")
+        score_source(model, shard_dir, sink, strategy="gather")
+        with pytest.raises(CheckpointMismatchError) as ei:
+            score_source(model, shard_dir, sink, strategy="gather")
+        assert list(ei.value.mismatched_fields) == ["resume"]
+
+    def test_refuses_strategy_and_model_mismatch(
+        self, model, data, shard_dir, tmp_path
+    ):
+        sink = str(tmp_path / "gate")
+        score_source(model, shard_dir, sink, strategy="gather")
+        with pytest.raises(CheckpointMismatchError) as ei:
+            score_source(model, shard_dir, sink, strategy="dense", resume=True)
+        assert "strategy" in ei.value.mismatched_fields
+        other = _ext().fit_source(shard_dir, baseline=False)
+        with pytest.raises(CheckpointMismatchError) as ei:
+            score_source(other, shard_dir, sink, strategy="gather", resume=True)
+        assert "modelSha256" in ei.value.mismatched_fields
+
+
+class TestCliOutOfCore:
+    def test_fit_and_score_via_source(self, shard_dir, data, tmp_path, capsys):
+        from isoforest_tpu.__main__ import main
+
+        X, _ = data
+        model_dir = str(tmp_path / "model")
+        rc = main(
+            [
+                "fit", "--source", shard_dir, "--output", model_dir,
+                "--num-estimators", "10", "--max-samples", "64",
+                "--contamination", "0.02", "--random-seed", str(SEED),
+            ]
+        )
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert summary["sourceShards"] == 4
+        assert summary["numTrees"] == 10
+
+        sink = str(tmp_path / "scores")
+        rc = main(
+            [
+                "score", "--model", model_dir, "--source", shard_dir,
+                "--output", sink, "--strategy", "gather",
+            ]
+        )
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["sealed"] == 4
+        got = read_scores(sink, num_shards=4)
+        from isoforest_tpu.models import IsolationForestModel
+
+        model = IsolationForestModel.load(model_dir)
+        assert np.array_equal(got, np.asarray(model.score(X, strategy="gather")))

@@ -1,0 +1,168 @@
+"""CI smoke for the out-of-core data plane (docs/out_of_core.md).
+
+End-to-end through the ``python -m isoforest_tpu`` CLI as real subprocesses:
+
+1. writes a small multi-shard ``.npy`` source,
+2. ``fit --source`` — the streamed one-pass sampler + block-wise growth —
+   and asserts the resulting model is **bitwise identical** (forest arrays,
+   threshold, scores) to an in-memory ``fit_from_sample`` on the equivalent
+   materialised sample,
+3. ``score --source`` into a sealed shard sink and asserts the concatenated
+   scores are bitwise equal to an in-memory ``model.score``,
+4. kills a fresh scoring run between shards (``ISOFOREST_TPU_FAULTS=
+   kill_score_after_shard=1`` in the subprocess environment, under
+   ``timeout`` so a hang is a hard failure), resumes it with ``--resume``,
+   and asserts the resumed sink is bitwise equal to the uninterrupted one.
+
+Run: ``python tools/out_of_core_smoke.py`` (exit 0 = pass).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+ROWS = 6000
+FEATURES = 4
+SHARDS = 4
+TREES = 16
+SAMPLES = 64
+SEED = 5
+SUBPROCESS_TIMEOUT = 240
+
+
+def _cli(args, env=None, check=True):
+    cmd = [sys.executable, "-m", "isoforest_tpu", *args]
+    proc = subprocess.run(
+        cmd,
+        capture_output=True,
+        text=True,
+        timeout=SUBPROCESS_TIMEOUT,
+        env={**os.environ, **(env or {})},
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent),
+    )
+    if check and proc.returncode != 0:
+        raise AssertionError(
+            f"CLI {args} exited {proc.returncode}\n"
+            f"stdout: {proc.stdout}\nstderr: {proc.stderr[-2000:]}"
+        )
+    return proc
+
+
+def main() -> int:
+    from isoforest_tpu import IsolationForest
+    from isoforest_tpu.io.outofcore import read_scores
+    from isoforest_tpu.io.persistence import load_model
+    from isoforest_tpu.io.source import write_npy_shard
+    from isoforest_tpu.ops.bagging import StreamedBagger
+
+    work = tempfile.mkdtemp(prefix="isoforest-ooc-smoke-")
+    try:
+        source_dir = os.path.join(work, "source")
+        os.makedirs(source_dir)
+        rng = np.random.default_rng(11)
+        X = rng.normal(size=(ROWS, FEATURES)).astype(np.float32)
+        X[:80] += 5.0
+        per = ROWS // SHARDS
+        for i in range(SHARDS):
+            write_npy_shard(
+                os.path.join(source_dir, f"shard-{i:03d}.npy"),
+                X[i * per : (i + 1) * per],
+            )
+
+        # --- fit through the CLI, parity vs in-memory fit_from_sample ---
+        model_dir = os.path.join(work, "model")
+        proc = _cli(
+            [
+                "fit", "--source", source_dir, "--output", model_dir,
+                "--num-estimators", str(TREES), "--max-samples", str(SAMPLES),
+                "--contamination", "0.02", "--random-seed", str(SEED),
+            ]
+        )
+        summary = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert summary["sourceShards"] == SHARDS, summary
+        model = load_model(model_dir)
+
+        bagger = StreamedBagger(SEED, num_trees=TREES, num_samples=SAMPLES)
+        bagger.consume(X)
+        sample = bagger.finalize()
+        ref = IsolationForest(
+            num_estimators=TREES,
+            max_samples=float(SAMPLES),
+            contamination=0.02,
+            random_seed=SEED,
+        ).fit_from_sample(sample.X, sample.bag, baseline=False)
+        for field in type(model.forest)._fields:
+            a = np.asarray(getattr(model.forest, field))
+            b = np.asarray(getattr(ref.forest, field))
+            assert np.array_equal(a, b, equal_nan=True), (
+                f"fit --source not bitwise vs in-memory: forest.{field}"
+            )
+        assert model.outlier_score_threshold == ref.outlier_score_threshold
+
+        # --- score through the CLI, parity vs in-memory model.score ---
+        clean_sink = os.path.join(work, "scores-clean")
+        _cli(
+            [
+                "score", "--model", model_dir, "--source", source_dir,
+                "--output", clean_sink, "--strategy", "gather",
+            ]
+        )
+        clean = read_scores(clean_sink, num_shards=SHARDS)
+        direct = np.asarray(model.score(X, strategy="gather"))
+        assert np.array_equal(clean, direct), "score --source not bitwise"
+
+        # --- kill between shards, resume, bitwise vs uninterrupted ---
+        sink = os.path.join(work, "scores-killed")
+        proc = _cli(
+            [
+                "score", "--model", model_dir, "--source", source_dir,
+                "--output", sink, "--strategy", "gather",
+            ],
+            env={"ISOFOREST_TPU_FAULTS": "kill_score_after_shard=1"},
+            check=False,
+        )
+        assert proc.returncode != 0, "injected kill did not fail the run"
+        sealed = sorted(n for n in os.listdir(sink) if n.startswith("part-"))
+        assert sealed == ["part-00000", "part-00001"], sealed
+        proc = _cli(
+            [
+                "score", "--model", model_dir, "--source", source_dir,
+                "--output", sink, "--strategy", "gather", "--resume",
+            ]
+        )
+        resumed = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert resumed["skipped"] == 2 and resumed["sealed"] == 2, resumed
+        assert np.array_equal(read_scores(sink, num_shards=SHARDS), clean), (
+            "resumed sink not bitwise vs uninterrupted"
+        )
+
+        print(
+            json.dumps(
+                {
+                    "out_of_core_smoke": "pass",
+                    "rows": ROWS,
+                    "shards": SHARDS,
+                    "fit_bitwise": True,
+                    "score_bitwise": True,
+                    "resume_bitwise": True,
+                }
+            )
+        )
+        return 0
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,6 +68,14 @@ def main() -> None:
         "(default: the autotuner-bucket-aligned platform chunk)",
     )
     ap.add_argument(
+        "--source",
+        default=None,
+        help="--mode score: stream rows from a sharded on-disk source "
+        "(directory / glob / file, docs/out_of_core.md) instead of the "
+        "synthetic in-memory matrix; --rows defaults to the source's total "
+        "row count. Same per-point JSON schema, plus a 'source' field.",
+    )
+    ap.add_argument(
         "--score-variants",
         action="store_true",
         help="measure replicated-forest vs 2-D (tree x row, psum) scoring "
@@ -99,9 +107,23 @@ def main() -> None:
     from isoforest_tpu.utils.math import max_nodes_for
 
     platform = jax.devices()[0].platform
-    rng = np.random.default_rng(0)
-    X_full = rng.normal(size=(args.rows, args.features)).astype(np.float32)
-    X_full[: args.rows // 100] += 5.0
+
+    source_obj = None
+    if args.source is not None:
+        if args.mode != "score":
+            ap.error("--source requires --mode score")
+        from isoforest_tpu.io.source import open_source
+
+        source_obj = open_source(args.source)
+        args.rows = min(args.rows, source_obj.total_rows()) if sys.argv.count(
+            "--rows"
+        ) else source_obj.total_rows()
+        args.features = source_obj.num_features()
+        X_full = None
+    else:
+        rng = np.random.default_rng(0)
+        X_full = rng.normal(size=(args.rows, args.features)).astype(np.float32)
+        X_full[: args.rows // 100] += 5.0
 
     def run(n_dev: int, rows: int, trees: int, mode: str) -> None:
         mesh = create_mesh(devices=jax.devices()[:n_dev])
@@ -193,14 +215,19 @@ def main() -> None:
         from isoforest_tpu.parallel import sharded_score
 
         if "model" not in _score_model:
+            fit_rows = min(args.rows, 1 << 16)
+            if source_obj is not None:
+                X_fit = next(source_obj.iter_chunks(chunk_rows=fit_rows)).X
+            else:
+                X_fit = X_full[:fit_rows]
             _score_model["model"] = IsolationForest(
                 num_estimators=args.trees,
                 max_samples=float(args.samples),
                 random_seed=1,
-            ).fit(X_full[: min(args.rows, 1 << 16)])
+            ).fit(X_fit)
         model = _score_model["model"]
         mesh = create_mesh(devices=jax.devices()[:n_dev])
-        X = X_full[:rows]
+        X = X_full[:rows] if source_obj is None else None
         # at least two chunks per run so the measurement exercises the
         # double-buffered pipeline, not just the single-shot path
         chunk = resolve_chunk_rows(
@@ -211,35 +238,54 @@ def main() -> None:
             multiple=n_dev,
         )
         kw = dict(pipeline=True, chunk_rows=chunk)
-        sharded_score(mesh, model.forest, X, model.num_samples, **kw)  # warm
+
+        def one_pass():
+            # source mode streams shard chunks straight off disk: memory is
+            # bounded by one chunk, the mesh never sees the whole matrix
+            if source_obj is None:
+                sharded_score(mesh, model.forest, X, model.num_samples, **kw)
+                return
+            done = 0
+            for c in source_obj.iter_chunks(chunk_rows=chunk):
+                x = c.X if c.X.shape[0] <= rows - done else c.X[: rows - done]
+                if x.shape[0] % n_dev:
+                    pad = n_dev - x.shape[0] % n_dev
+                    x = np.concatenate([x, np.zeros((pad, x.shape[1]), x.dtype)])
+                sharded_score(mesh, model.forest, x, model.num_samples, **kw)
+                done += min(c.X.shape[0], rows - done)
+                if done >= rows:
+                    return
+
+        one_pass()  # warm
         before = pipeline_stats("sharded")
         best = float("inf")
         for _ in range(3):
             t0 = time.perf_counter()
-            sharded_score(mesh, model.forest, X, model.num_samples, **kw)
+            one_pass()
             best = min(best, time.perf_counter() - t0)
         after = pipeline_stats("sharded")
-        line = json.dumps(
-            {
-                "metric": f"{mode}_scaling_score",
-                "devices": n_dev,
-                "rows": rows,
-                "trees": args.trees,
-                "value": round(best, 4),
-                "unit": "s",
-                "rows_per_s": round(rows / best, 1),
-                "backend": platform,
-                "mesh": dict(mesh.shape),
-                "chunk_rows": chunk,
-                "pipeline": {
-                    "chunks": after["chunks"] - before["chunks"],
-                    "h2d_seconds": round(
-                        after["h2d_seconds"] - before["h2d_seconds"], 6
-                    ),
-                    "overlap_efficiency": after["overlap_efficiency"],
-                },
-            }
-        )
+        point = {
+            "metric": f"{mode}_scaling_score",
+            "devices": n_dev,
+            "rows": rows,
+            "trees": args.trees,
+            "value": round(best, 4),
+            "unit": "s",
+            "rows_per_s": round(rows / best, 1),
+            "backend": platform,
+            "mesh": dict(mesh.shape),
+            "chunk_rows": chunk,
+            "pipeline": {
+                "chunks": after["chunks"] - before["chunks"],
+                "h2d_seconds": round(
+                    after["h2d_seconds"] - before["h2d_seconds"], 6
+                ),
+                "overlap_efficiency": after["overlap_efficiency"],
+            },
+        }
+        if source_obj is not None:
+            point["source"] = args.source
+        line = json.dumps(point)
         print(line, flush=True)
         out = (
             pathlib.Path(__file__).resolve().parent.parent
