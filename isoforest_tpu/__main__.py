@@ -34,6 +34,8 @@ operational surface here is a small CLI over CSV files:
         [--queue-deadline-ms 2000] [--no-lifecycle] [--max-seconds N]
     python -m isoforest_tpu serve --models-dir /tmp/models --port 9100 \\
         [--fleet-budget-mb 64] [--preload]  # POST /score/<model_id>
+    python -m isoforest_tpu route --models-dir /tmp/models --replicas 2 \\
+        [--port 9100]  # replicated tier: K replicas behind one router
 
 CSV rows are feature columns; ``--labeled`` treats the last column as a label
 (excluded from features; used to report AUROC after fit/score).
@@ -43,6 +45,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 import numpy as np
@@ -565,6 +568,87 @@ def cmd_serve(args) -> int:
             "batch_rows": config.batch_rows,
             "linger_ms": config.linger_ms,
         }
+    heartbeat = None
+    if args.replica_name and args.heartbeat_dir:
+        # replicated tier (docs/replication.md): advertise liveness to the
+        # fronting router. Write-only wiring — the replica's own /healthz
+        # deliberately does NOT read this directory (a dead PEER must not
+        # flip this replica unhealthy)
+        from .resilience.watchdog import HeartbeatWriter
+
+        os.makedirs(args.heartbeat_dir, exist_ok=True)
+        heartbeat = HeartbeatWriter(args.heartbeat_dir, args.replica_name)
+        heartbeat.start()
+        ready["replica"] = args.replica_name
+    stop = threading.Event()
+    try:
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    except ValueError:
+        pass  # not the main thread (in-process tests drive stop themselves)
+    print(json.dumps(ready), flush=True)
+    try:
+        stop.wait(args.max_seconds)  # None waits until SIGTERM/SIGINT
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if heartbeat is not None:
+            heartbeat.stop()
+        handle.close()
+    return 0
+
+
+def cmd_route(args) -> int:
+    """Front a replicated serving tier (docs/replication.md): spawn
+    ``--replicas`` fleet replicas over one ``--models-dir``, balance
+    ``POST /score/<model_id>`` across them with health-probe admission and
+    idempotent retries, watch ``CURRENT.json`` for rolling model pushes,
+    print one JSON ready line, and serve until SIGTERM/SIGINT (draining
+    in-flight requests, then the replicas, on the way down)."""
+    import signal
+    import threading
+
+    from .replication import RouterConfig, serve_router
+
+    config = RouterConfig(
+        probe_interval_s=args.probe_interval_s,
+        probe_timeout_s=args.probe_timeout_s,
+        stale_after_s=args.stale_after_s,
+        request_timeout_s=args.request_timeout_s,
+        drain_timeout_s=args.drain_timeout_s,
+        retry_attempts=args.retry_attempts,
+    )
+    replica_args = []
+    if args.batch_rows is not None:
+        replica_args += ["--batch-rows", str(args.batch_rows)]
+    if args.linger_ms is not None:
+        replica_args += ["--linger-ms", str(args.linger_ms)]
+    if args.fleet_budget_mb is not None:
+        replica_args += ["--fleet-budget-mb", str(args.fleet_budget_mb)]
+    if args.preload:
+        replica_args += ["--preload"]
+    if args.no_lifecycle:
+        replica_args += ["--no-lifecycle"]
+    if args.work_dir is not None:
+        replica_args += ["--work-dir", args.work_dir]
+    handle = serve_router(
+        args.models_dir,
+        replicas=args.replicas,
+        port=args.port,
+        host=args.host,
+        config=config,
+        work_root=args.work_dir,
+        replica_args=tuple(replica_args),
+    )
+    ready = {
+        "router": True,
+        "url": handle.url,
+        "endpoint": handle.url + "/score/<model_id>",
+        "models_dir": args.models_dir,
+        "replicas": [
+            {"name": r.name, "url": r.url, "pid": r.pid}
+            for r in handle.router.replicas
+        ],
+    }
     stop = threading.Event()
     try:
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -965,7 +1049,122 @@ def build_parser() -> argparse.ArgumentParser:
         help="exit after this many seconds (default: serve until "
         "SIGTERM/SIGINT) — CI smoke runs use it with `timeout`",
     )
+    srv.add_argument(
+        "--replica-name",
+        default=os.environ.get("ISOFOREST_TPU_REPLICA_NAME") or None,
+        help="replicated tier (docs/replication.md): this replica's name; "
+        "with --heartbeat-dir, writes heartbeat-<name>.json there so the "
+        "fronting router's /healthz tracks this process",
+    )
+    srv.add_argument(
+        "--heartbeat-dir",
+        default=None,
+        help="directory for this replica's liveness heartbeat file "
+        "(requires --replica-name). Deliberately NOT the "
+        "ISOFOREST_TPU_HEARTBEAT_DIR env: the replica only WRITES here — "
+        "its own /healthz must not 503 when a PEER dies",
+    )
     srv.set_defaults(func=cmd_serve)
+
+    rt = sub.add_parser(
+        "route",
+        help="front a replicated serving tier (docs/replication.md): spawn "
+        "K fleet replicas over one --models-dir and balance POST "
+        "/score/<model_id> across them with health-probe admission, "
+        "idempotent retries, drains and rolling model pushes",
+    )
+    rt.add_argument(
+        "--models-dir",
+        required=True,
+        help="the sealed model directory every replica serves (fleet "
+        "layout, docs/fleet.md)",
+    )
+    rt.add_argument(
+        "--replicas",
+        type=int,
+        default=2,
+        help="how many serving replicas to spawn (default 2)",
+    )
+    rt.add_argument("--host", default="127.0.0.1")
+    rt.add_argument(
+        "--port",
+        type=int,
+        default=0,
+        help="the router's HTTP port (0 = ephemeral, reported on the "
+        "ready line); replicas always bind ephemeral ports",
+    )
+    rt.add_argument(
+        "--probe-interval-s",
+        type=float,
+        default=1.0,
+        help="maintenance cadence: health probes + rolling-push passes",
+    )
+    rt.add_argument(
+        "--probe-timeout-s",
+        type=float,
+        default=2.0,
+        help="a replica whose /healthz answers slower than this is ejected",
+    )
+    rt.add_argument(
+        "--stale-after-s",
+        type=float,
+        default=15.0,
+        help="a replica whose heartbeat file is older than this is ejected",
+    )
+    rt.add_argument(
+        "--request-timeout-s",
+        type=float,
+        default=30.0,
+        help="one forward's wire budget before the router retries elsewhere",
+    )
+    rt.add_argument(
+        "--drain-timeout-s",
+        type=float,
+        default=30.0,
+        help="SIGTERM: how long to wait for in-flight requests to finish",
+    )
+    rt.add_argument(
+        "--retry-attempts",
+        type=int,
+        default=3,
+        help="forward attempts across replicas before a 503",
+    )
+    rt.add_argument(
+        "--batch-rows", type=int, default=None,
+        help="passed through to each spawned replica",
+    )
+    rt.add_argument(
+        "--linger-ms", type=float, default=None,
+        help="passed through to each spawned replica",
+    )
+    rt.add_argument(
+        "--fleet-budget-mb", type=float, default=None,
+        help="passed through to each spawned replica",
+    )
+    rt.add_argument(
+        "--preload", action="store_true",
+        help="passed through to each spawned replica",
+    )
+    rt.add_argument(
+        "--no-lifecycle", action="store_true",
+        help="passed through to each spawned replica",
+    )
+    rt.add_argument(
+        "--work-dir",
+        default=None,
+        help="lifecycle work ROOT shared by all replicas (each tenant at "
+        "<work-dir>/<model_id>); the router watches CURRENT.json under it "
+        "for rolling pushes. Default: <model_dir>.lifecycle next to each "
+        "sealed model",
+    )
+    rt.add_argument(
+        "--max-seconds",
+        type=float,
+        default=None,
+        help="exit after this many seconds (default: serve until "
+        "SIGTERM/SIGINT) — CI smoke runs use it with `timeout`",
+    )
+    rt.set_defaults(func=cmd_route)
 
     at = sub.add_parser(
         "autotune",

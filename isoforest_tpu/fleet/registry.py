@@ -587,16 +587,39 @@ class ModelRegistry:
         scores, _ = self.score_detail(model_id, rows)
         return scores
 
-    def score_detail(self, model_id: str, rows: np.ndarray):
+    def score_detail(
+        self,
+        model_id: str,
+        rows: np.ndarray,
+        idempotency_key: Optional[str] = None,
+    ):
         """(scores, info) where info carries the flush accounting, the
         generation and the active model reference the HTTP layer encodes.
         A request that races an eviction (service closed between lookup
-        and submit) retries once against the re-loaded service."""
+        and submit) retries once against the re-loaded service.
+        ``idempotency_key`` is the replicated tier's retry dedup
+        (docs/replication.md): a key this tenant's service already answered
+        replays fold-free (bitwise-same scores, drift counted once); a
+        fresh key is recorded once the flush succeeds."""
         for attempt in (0, 1):
             entry = self.ensure_resident(model_id)
             service = entry.service  # point-in-time: eviction-safe
             if service is None:
                 continue  # evicted between load and capture: reload
+            if idempotency_key is not None and service.idempotency_seen(
+                idempotency_key
+            ):
+                scores, generation = service.score_replay(rows)
+                info = {
+                    "model": service.model,
+                    "generation": generation,
+                    "flush_rows": int(np.asarray(rows).shape[0]),
+                    "flush_requests": 1,
+                    "queue_wait_s": 0.0,
+                    "flush_ctx": None,
+                    "replayed": True,
+                }
+                return scores, info
             try:
                 pending = service.coalescer.submit(rows)
             except CoalescerClosedError:
@@ -611,6 +634,7 @@ class ModelRegistry:
             scores = service.coalescer.result(
                 pending, timeout_s=entry.config.request_timeout_s
             )
+            service.record_idempotency(idempotency_key)
             model = service.model
             manager = service.manager
             info = {
@@ -626,6 +650,33 @@ class ModelRegistry:
             f"model {model_id!r} was evicted twice while the request was "
             "being admitted; retry"
         )
+
+    def refresh_from_current(self, model_id: str) -> dict:
+        """The per-tenant leg of a rolling model push
+        (docs/replication.md): re-read the tenant's ``CURRENT.json`` and
+        adopt a newer generation in place. A non-resident tenant reloads
+        nothing — its next lazy load resumes from ``CURRENT.json`` anyway,
+        so the push reaches it by construction. Raises
+        :class:`UnknownModelError` for unregistered ids."""
+        entry = self.entry(model_id)
+        with entry._lock:
+            manager = entry.manager if entry.resident else None
+        if manager is None:
+            return {
+                "model_id": entry.model_id,
+                "resident": entry.resident,
+                "lifecycle": entry.lifecycle,
+                "reloaded": False,
+                "generation": entry.generation,
+            }
+        changed = manager.refresh_from_current()
+        return {
+            "model_id": entry.model_id,
+            "resident": True,
+            "lifecycle": True,
+            "reloaded": bool(changed),
+            "generation": manager.generation,
+        }
 
     # ------------------------------------------------------------------ #
     # teardown

@@ -39,6 +39,7 @@ from ..serving.http import (
     _finish as _serving_finish,
     _parse_csv,
     _parse_json,
+    inbound_idempotency_key,
     inbound_trace_id,
 )
 from ..serving.coalescer import ServingError
@@ -52,6 +53,7 @@ from .registry import ModelRegistry, UnknownModelError
 
 SCORE_PREFIX = "/score/"
 MODELS_PATH = "/models"
+RELOAD_PREFIX = "/reload/"
 
 # same bucket shape as the single-model isoforest_serving_request_seconds
 # so per-tenant and deployment-wide latency compare bucket-for-bucket
@@ -150,7 +152,11 @@ class FleetService:
             except _BadRequest as exc:
                 return self._finish(model_id, t0, 400, _error_body(400, str(exc)))
             try:
-                scores, info = self.registry.score_detail(model_id, rows)
+                scores, info = self.registry.score_detail(
+                    model_id,
+                    rows,
+                    idempotency_key=inbound_idempotency_key(headers),
+                )
             except ServingError as exc:
                 return self._finish(
                     model_id, t0, exc.status, _error_body(exc.status, str(exc))
@@ -182,9 +188,34 @@ class FleetService:
                 "flush_rows": info["flush_rows"],
                 "flush_requests": info["flush_requests"],
             }
+            if info.get("replayed"):
+                # an idempotent retry re-scored fold-free (docs/replication.md §2)
+                doc["replayed"] = True
             return self._finish(model_id, t0, 200, json.dumps(doc) + "\n")
         except Exception as exc:  # encoder/accounting bug: still a typed 500
             return self._finish(model_id, t0, 500, _error_body(500, repr(exc)))
+
+    def handle_reload(self, model_id: str, body: bytes, headers, query: str = ""):
+        """``POST /reload/<model_id>`` — the per-tenant leg of a rolling
+        model push (docs/replication.md): re-read the tenant's
+        ``CURRENT.json`` and adopt a newer generation in place. 404 JSON on
+        an unknown tenant; a non-resident tenant reloads nothing (its next
+        lazy load resumes from ``CURRENT.json`` by construction)."""
+        try:
+            doc = self.registry.refresh_from_current(model_id)
+        except UnknownModelError as exc:
+            body_out = json.dumps(
+                {
+                    "error": str(exc),
+                    "status": 404,
+                    "model_id": model_id,
+                    "models": self.registry.model_ids(),
+                }
+            ) + "\n"
+            return 404, "application/json", body_out
+        except Exception as exc:  # a torn push must not kill the route
+            return 500, "application/json", _error_body(500, repr(exc))
+        return 200, "application/json", json.dumps(doc, sort_keys=True) + "\n"
 
     def handle_models(self, query: str = "") -> Tuple[int, str, str]:
         """``GET /models``: per-tenant state rows + the fleet roll-up."""
@@ -214,14 +245,18 @@ def mount_fleet(server, fleet: FleetService) -> None:
     """Register the fleet routes on a running
     :class:`~isoforest_tpu.telemetry.http.MetricsServer`."""
     server.register_post_prefix(SCORE_PREFIX, fleet.handle_score)
+    server.register_post_prefix(RELOAD_PREFIX, fleet.handle_reload)
     server.register_get(MODELS_PATH, fleet.handle_models)
     server.serving_state = fleet.state  # picked up by health()
+    server.is_replica = True  # arm the replica chaos seams on this server
 
 
 def unmount_fleet(server) -> None:
     server.unregister_post_prefix(SCORE_PREFIX)
+    server.unregister_post_prefix(RELOAD_PREFIX)
     server.unregister_get(MODELS_PATH)
     server.serving_state = None
+    server.is_replica = False
 
 
 def discover_models(models_dir: str) -> dict:

@@ -340,6 +340,7 @@ class ModelManager:
         chunk_size: Optional[int] = None,
         pipeline: Optional[bool] = None,
         return_generation: bool = False,
+        fold: bool = True,
     ) -> np.ndarray:
         """Score a served batch through the active model (folding the drift
         monitor), remember the rows in the retrain reservoir (labels too,
@@ -354,7 +355,11 @@ class ModelManager:
         the same lock hold as the model reference that scored — the only
         read that cannot race a concurrent hot-swap (a separate
         ``manager.generation`` read can observe the pre-swap number for a
-        post-swap score, or vice versa)."""
+        post-swap score, or vice versa). ``fold=False`` scores WITHOUT
+        feeding the drift monitor, the reservoir or the retrain trigger —
+        the idempotent-replay path of a replicated deployment
+        (docs/replication.md): a retried request whose first attempt
+        already folded must not count its rows twice."""
         with self._lock:
             # one lock hold pins model AND its generation together, so the
             # lifecycle.score span's generation attr names exactly the
@@ -373,9 +378,11 @@ class ModelManager:
                 strict=strict,
                 chunk_size=chunk_size,
                 pipeline=pipeline,
+                fold_monitor=fold,
             )
-        self.reservoir.fold(X, y)
-        self._maybe_trigger()
+        if fold:
+            self.reservoir.fold(X, y)
+            self._maybe_trigger()
         if return_generation:
             return scores, generation
         return scores
@@ -832,6 +839,100 @@ class ModelManager:
             json.dump(payload, fh, indent=1, sort_keys=True)
             fh.write("\n")
         os.replace(tmp, current)
+
+    def refresh_from_current(self) -> bool:
+        """Adopt a newer generation swapped into ``work_dir`` by ANOTHER
+        process — the rolling-push contract (docs/replication.md): a
+        ``manage``-driven retrain swaps and seals ``CURRENT.json`` once,
+        and every serving replica sharing the work dir picks the new
+        generation up here (driven by the router's watcher or an explicit
+        ``POST /reload``) without a restart.
+
+        Re-reads ``CURRENT.json``; when its generation is AHEAD of the
+        in-memory one, loads that sealed generation dir and flips the
+        active model under the swap lock — the same point-in-time flip
+        :meth:`_swap` performs, so every in-flight coalesced flush keeps
+        its complete model reference: responses are bitwise old-generation
+        or bitwise new-generation, never torn. Returns True when the
+        active model changed; any failure (torn pointer, unsealed dir,
+        missing baseline) logs a warning and keeps the incumbent — a
+        refresh is an optimisation, never a crash."""
+        current = os.path.join(self.work_dir, CURRENT_NAME)
+        try:
+            with open(current) as fh:
+                doc = json.load(fh)
+            target = int(doc["generation"])
+            path = doc["path"]
+        except OSError:
+            return False  # no pointer yet: nothing pushed
+        except (ValueError, KeyError, TypeError) as exc:
+            logger.warning(
+                "lifecycle: unreadable %s (%s); keeping generation %d",
+                current,
+                exc,
+                self.generation,
+            )
+            return False
+        with self._lock:
+            if target <= self.generation:
+                return False  # our own swap (or an older push): no-op
+        from ..io.persistence import load_model
+
+        try:
+            candidate = load_model(path)
+        except Exception as exc:
+            logger.warning(
+                "lifecycle: could not load pushed generation %d from %s "
+                "(%s); keeping generation %d",
+                target,
+                path,
+                exc,
+                self.generation,
+            )
+            return False
+        if candidate.baseline is None:
+            logger.warning(
+                "lifecycle: pushed generation %d at %s carries no "
+                "_BASELINE.json sidecar; keeping generation %d",
+                target,
+                path,
+                self.generation,
+            )
+            return False
+        with self._lock:
+            if target <= self.generation:
+                return False  # raced a concurrent swap/refresh past us
+            old = self._model
+            # the monitor object survives the adoption, exactly as in
+            # _swap: rebind re-targets it at the pushed baseline and
+            # re-arms the edge-triggered alerts
+            self._monitor.rebind(candidate.baseline)
+            candidate._monitor = self._monitor
+            old._monitor = None
+            self._model = candidate
+            self.generation = target
+            self.model_path = path
+            swapped = doc.get("swapped_unix_s")
+            self.last_swap_unix_s = (
+                float(swapped) if swapped is not None else float(self._clock())
+            )
+            self._consecutive = 0
+        _GENERATION.set(target)
+        if self.model_id is not None:
+            _FLEET_GENERATION.set(target, model_id=self.model_id)
+        record_event(
+            "lifecycle.refresh",
+            generation=target,
+            path=path,
+            swapped_unix_s=self.last_swap_unix_s,
+            **self._tenant_fields(),
+        )
+        logger.info(
+            "lifecycle: adopted pushed generation %d from %s (CURRENT.json)",
+            target,
+            path,
+        )
+        return True
 
     # ------------------------------------------------------------------ #
     # observability / teardown

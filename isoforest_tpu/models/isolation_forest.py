@@ -900,6 +900,7 @@ class IsolationForestModel:
         strategy: str = "auto",
         chunk_size: Optional[int] = None,
         pipeline: Optional[bool] = None,
+        fold_monitor: bool = True,
     ) -> np.ndarray:
         """Outlier scores ``2^(-E[h(x)]/c(n))`` for an ``[N, F]`` matrix.
 
@@ -918,7 +919,10 @@ class IsolationForestModel:
         ``chunk_size``/``pipeline`` forward to the streaming micro-batch
         executor (docs/pipeline.md): batches spanning multiple chunks
         double-buffer host→device transfer under compute, bitwise equal to
-        single-shot scoring."""
+        single-shot scoring. ``fold_monitor=False`` skips the attached
+        drift monitor's fold — the idempotent-replay path of a replicated
+        deployment (docs/replication.md) re-scores a retried request
+        without counting its rows twice; scores are unaffected."""
         X = np.asarray(X, np.float32)
         check_non_finite(X, nonfinite)
         validate_feature_vector_size(X.shape[1], self.total_num_features)
@@ -959,7 +963,7 @@ class IsolationForestModel:
                     pipeline=pipeline,
                 )
         monitor = self._monitor
-        if monitor is not None:
+        if monitor is not None and fold_monitor:
             # drift monitoring (docs/observability.md §8): fold the served
             # batch AFTER scoring so monitor cost never sits between the
             # caller and its scores on an alerting path

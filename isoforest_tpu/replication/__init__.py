@@ -1,0 +1,40 @@
+"""Replicated serving tier (docs/replication.md).
+
+A stdlib router process fronting K serving replicas over one sealed
+model directory: least-outstanding balancing, health-probe admission,
+idempotent retries across replica death, coordinated drains and rolling
+model pushes with zero failed requests. Entry points:
+
+* :func:`serve_router` / :class:`RouterHandle` — one-call assembly (the
+  ``python -m isoforest_tpu route`` subcommand).
+* :class:`Router` / :class:`Replica` / :class:`RouterConfig` — the
+  in-process pieces, driveable without subprocesses for tests.
+"""
+
+from .router import (
+    REPLICAS_PATH,
+    NoReplicaError,
+    Replica,
+    ReplicaRequestError,
+    Router,
+    RouterConfig,
+    RouterHandle,
+    mount_router,
+    serve_router,
+    spawn_replica,
+    unmount_router,
+)
+
+__all__ = [
+    "REPLICAS_PATH",
+    "NoReplicaError",
+    "Replica",
+    "ReplicaRequestError",
+    "Router",
+    "RouterConfig",
+    "RouterHandle",
+    "mount_router",
+    "serve_router",
+    "spawn_replica",
+    "unmount_router",
+]

@@ -48,7 +48,19 @@ single dict lookup when no fault is armed):
   strategies), ``slow_collective=<seconds>`` (stall cap) or
   ``slow_collective=<strategy>`` (stall only that strategy) simulates a
   hung kernel/collective; the stall polls its own arming so exiting
-  :func:`inject` releases any abandoned watchdog thread promptly.
+  :func:`inject` releases any abandoned watchdog thread promptly;
+* the replicated serving tier (docs/replication.md) -> three seams:
+  :func:`take_replica_kill` — ``kill_replica_during_score[=<n>|exit]``
+  kills the replica on a scoring request: the HTTP layer severs the
+  connection without a response (``=<n>``: the n-th request from now;
+  ``exit`` hard-exits the process for subprocess drills; ONE-SHOT like
+  :func:`take_retrain_kill` — the router's retry proves the recovery);
+  :func:`maybe_wedge_healthz` — ``wedge_replica_healthz[=<seconds>]``
+  stalls ``GET /healthz`` while armed (the wedged-but-listening replica
+  the router's probe timeout must eject, and re-admit on disarm);
+  :func:`push_stalled` — ``stall_current_json_push`` freezes the router's
+  rolling-push watcher (no ``CURRENT.json`` generation propagates while
+  armed; disarming resumes exactly where it stopped).
 
 :class:`FakeClock` is the injectable time source the retry/watchdog tests
 drive: deterministic ``now``/``sleep`` so every backoff schedule and
@@ -92,6 +104,9 @@ KNOWN_FAULTS = frozenset(
         "break_pipeline_stage",
         "fail_fleet_load",
         "evict_during_score",
+        "kill_replica_during_score",
+        "wedge_replica_healthz",
+        "stall_current_json_push",
     }
 )
 
@@ -390,6 +405,92 @@ def maybe_slow_collective(
     start = clock()
     while active("slow_collective") and clock() - start < limit:
         sleep(0.01)
+
+
+def take_replica_kill() -> Optional[str]:
+    """Consume a ``kill_replica_during_score`` token at the replica HTTP
+    layer's scoring dispatch; returns what the kill should look like:
+    ``"sever"`` (close the connection without a response — the client sees
+    a torn wire, exactly what a SIGKILL mid-request looks like from the
+    router's side) or ``"exit"`` (hard-exit the process, the subprocess
+    drill), or ``None`` (no kill). Value forms: ``True``/``1`` sever the
+    next scoring request, ``<n>`` the n-th from now (the countdown
+    decrements in place), ``"exit"`` hard-exits on the next one. ONE-SHOT
+    like :func:`take_retrain_kill`: a real replica death does not recur on
+    the retried request, and the router's retry-on-another-replica path is
+    exactly what the seam exists to prove."""
+    for frame in reversed(_STACK):
+        if "kill_replica_during_score" in frame:
+            value = frame["kill_replica_during_score"]
+            if value is None or value is False:
+                continue  # consumed frame: fall through to any outer one
+            if isinstance(value, str) and not value.isdigit():
+                frame["kill_replica_during_score"] = False
+                return "exit" if value == "exit" else "sever"
+            remaining = int(value)
+            if remaining <= 1:
+                frame["kill_replica_during_score"] = False
+                return "sever"
+            frame["kill_replica_during_score"] = remaining - 1
+            return None
+    global _ENV_REPLICA_KILL_STATE
+    if _ENV_REPLICA_KILL_STATE == "consumed":
+        return None
+    value = _parse_env().get("kill_replica_during_score")
+    if value is None or value is False:
+        return None
+    if isinstance(value, str) and not value.isdigit():
+        _ENV_REPLICA_KILL_STATE = "consumed"
+        return "exit" if value == "exit" else "sever"
+    remaining = (
+        int(value)
+        if _ENV_REPLICA_KILL_STATE is None
+        else int(_ENV_REPLICA_KILL_STATE)
+    )
+    if remaining <= 1:
+        _ENV_REPLICA_KILL_STATE = "consumed"
+        return "sever"
+    _ENV_REPLICA_KILL_STATE = remaining - 1
+    return None
+
+
+# env-armed countdown state: None (untouched), an int (requests left), or
+# "consumed" (the one-shot fired)
+_ENV_REPLICA_KILL_STATE: Optional[FaultValue] = None
+
+
+def maybe_wedge_healthz(
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+) -> None:
+    """Stall while ``wedge_replica_healthz`` is armed — the replica whose
+    process is alive (socket accepts) but whose health answer never comes,
+    the case a router probe TIMEOUT (not a connect failure) must eject.
+    Value forms: ``True`` (30 s cap) or a number (that many seconds). Like
+    :func:`maybe_slow_collective`, the stall re-checks its own arming every
+    10 ms so exiting :func:`inject` releases the wedged handler thread
+    promptly."""
+    value = get("wedge_replica_healthz")
+    if value is None or value is False:
+        return
+    limit = 30.0
+    if not isinstance(value, bool):
+        try:
+            limit = float(value)
+        except (TypeError, ValueError):
+            pass
+    start = clock()
+    while active("wedge_replica_healthz") and clock() - start < limit:
+        sleep(0.01)
+
+
+def push_stalled() -> bool:
+    """True while ``stall_current_json_push`` is armed — the router's
+    rolling-push watcher then makes NO propagation progress (no replica
+    learns of a new ``CURRENT.json`` generation), proving in-flight
+    requests keep answering bitwise old-generation scores until the stall
+    clears and the push converges (docs/replication.md)."""
+    return active("stall_current_json_push")
 
 
 class FakeClock:

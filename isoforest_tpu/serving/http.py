@@ -49,8 +49,13 @@ from ..telemetry.spans import TraceContext, span, with_context
 from .coalescer import ServingError
 
 SCORE_PATH = "/score"
+RELOAD_PATH = "/reload"
 
 TRACE_HEADER = "X-Isoforest-Trace"
+# one scoring request's identity across router retries
+# (docs/replication.md): a replica that already ANSWERED this key re-scores
+# without re-folding the drift monitor — retried flushes never double-count
+IDEMPOTENCY_HEADER = "X-Isoforest-Idempotency-Key"
 # accepted inbound trace ids: our own hex ids plus dotted/dashed client
 # ids; anything else (header injection, oversized junk) is ignored and the
 # server mints its own id instead
@@ -127,6 +132,15 @@ def inbound_trace_id(headers) -> Optional[str]:
     return None
 
 
+def inbound_idempotency_key(headers) -> Optional[str]:
+    """The sanitised ``X-Isoforest-Idempotency-Key``, or None (same
+    alphabet as trace ids: junk is ignored rather than indexed)."""
+    raw = headers.get(IDEMPOTENCY_HEADER) if headers is not None else None
+    if raw and _TRACE_ID_RE.match(raw):
+        return raw
+    return None
+
+
 def handle_score(
     service, body: bytes, headers, query: str = ""
 ) -> Tuple[int, str, str, Dict[str, str]]:
@@ -160,6 +174,33 @@ def _respond(service, body: bytes, headers, query: str, sp) -> Tuple[int, str, s
         except _BadRequest as exc:
             return _finish(t0, 400, _error_body(400, str(exc)))
         sp.set_attrs(rows=int(rows.shape[0]))
+        idem_key = inbound_idempotency_key(headers)
+        if idem_key is not None and service.idempotency_seen(idem_key):
+            # a router retry of a request this replica ALREADY answered
+            # (the first response died on the wire): re-score fold-free —
+            # bitwise the same scores, the drift monitor counts the rows
+            # once (docs/replication.md)
+            try:
+                scores, generation = service.score_replay(rows)
+            except Exception as exc:
+                return _finish(t0, 500, _error_body(500, repr(exc)))
+            sp.set_attrs(idempotent_replay=True)
+            if csv:
+                out = "outlierScore\n" + "".join(
+                    f"{float(s)!r}\n" for s in scores
+                )
+                return _finish(t0, 200, out, "text/csv; charset=utf-8")
+            doc = {
+                "scores": [float(s) for s in scores],
+                "predictions": [float(p) for p in service.predict(scores)],
+                "rows": int(rows.shape[0]),
+                "single": single,
+                "generation": generation,
+                "flush_rows": int(rows.shape[0]),
+                "flush_requests": 1,
+                "replayed": True,
+            }
+            return _finish(t0, 200, json.dumps(doc) + "\n")
         try:
             pending = service.coalescer.submit(rows)
             scores = service.coalescer.result(
@@ -169,6 +210,9 @@ def _respond(service, body: bytes, headers, query: str, sp) -> Tuple[int, str, s
             return _finish(t0, exc.status, _error_body(exc.status, str(exc)))
         except Exception as exc:  # scoring failure: typed 500, never a hang
             return _finish(t0, 500, _error_body(500, repr(exc)))
+        # the flush folded these rows: remember the key BEFORE the response
+        # hits the wire, so a retry after a torn write replays fold-free
+        service.record_idempotency(idem_key)
         # where the latency went + which flush served us: the request trace
         # names its flush (a DIFFERENT trace, reachable via the flush
         # span's link back to this request — docs/observability.md §9)
@@ -215,17 +259,46 @@ def _finish(
     return status, content_type, body
 
 
+def handle_reload(service, body: bytes, headers, query: str = ""):
+    """``POST /reload`` — adopt a newer generation another process swapped
+    into the shared work dir (``CURRENT.json``), the per-replica leg of a
+    rolling model push (docs/replication.md). Always 200 with the
+    post-reload state; a lifecycle-less deployment reports
+    ``lifecycle: false`` and reloads nothing."""
+    manager = service.manager
+    if manager is None:
+        doc = {"reloaded": False, "lifecycle": False, "generation": None}
+        return 200, "application/json", json.dumps(doc) + "\n"
+    try:
+        changed = manager.refresh_from_current()
+    except Exception as exc:  # a torn push must not kill the route
+        return 500, "application/json", _error_body(500, repr(exc))
+    doc = {
+        "reloaded": bool(changed),
+        "lifecycle": True,
+        "generation": manager.generation,
+    }
+    return 200, "application/json", json.dumps(doc) + "\n"
+
+
 def mount(server, service) -> None:
-    """Register ``POST /score`` on a running
+    """Register ``POST /score`` (+ ``POST /reload``) on a running
     :class:`~isoforest_tpu.telemetry.http.MetricsServer` and add the
     service's state to its ``/healthz`` payload."""
     server.register_post(
         SCORE_PATH,
         lambda body, headers, query="": handle_score(service, body, headers, query),
     )
+    server.register_post(
+        RELOAD_PATH,
+        lambda body, headers, query="": handle_reload(service, body, headers, query),
+    )
     server.serving_state = service.state  # picked up by health()
+    server.is_replica = True  # arm the replica chaos seams on this server
 
 
 def unmount(server) -> None:
     server.unregister_post(SCORE_PATH)
+    server.unregister_post(RELOAD_PATH)
     server.serving_state = None
+    server.is_replica = False

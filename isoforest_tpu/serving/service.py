@@ -24,10 +24,12 @@ tests) use: load → manage (resume) → mount → prewarm → handle.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
+import threading
 import time
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -50,6 +52,10 @@ class ServingConfig:
     queue_deadline_ms: float = 2000.0
     request_timeout_s: float = 30.0
     score_timeout_s: Optional[float] = None
+    # answered idempotency keys remembered per service (LRU): a router
+    # retry replaying one of them re-scores WITHOUT re-folding the drift
+    # monitor/reservoir (docs/replication.md)
+    idempotency_capacity: int = 4096
 
 
 class ScoringService:
@@ -92,6 +98,14 @@ class ScoringService:
             queue_deadline_s=self.config.queue_deadline_ms / 1e3,
             clock=clock,
             start=start,
+        )
+        # idempotency keys this service already ANSWERED (LRU set): the
+        # replicated tier's retry dedup (docs/replication.md). A key lands
+        # here only after its scores were computed and folded — a retry
+        # whose first attempt died before scoring replays the normal path.
+        self._idempotency_lock = threading.Lock()
+        self._idempotency_seen: "collections.OrderedDict[str, None]" = (
+            collections.OrderedDict()
         )
         self.started_unix_s = time.time()
 
@@ -143,6 +157,56 @@ class ScoringService:
 
     def predict(self, scores: np.ndarray) -> np.ndarray:
         return self.model.predict(scores)
+
+    # ------------------------------------------------------------------ #
+    # idempotent replay (docs/replication.md)
+    # ------------------------------------------------------------------ #
+
+    def idempotency_seen(self, key: str) -> bool:
+        """True when ``key`` was already answered by this service — the
+        retried request must take :meth:`score_replay`, not fold again."""
+        with self._idempotency_lock:
+            if key in self._idempotency_seen:
+                self._idempotency_seen.move_to_end(key)
+                return True
+            return False
+
+    def record_idempotency(self, key: Optional[str]) -> None:
+        """Remember an ANSWERED key (bounded LRU). Called after scoring
+        succeeded — a request that died before its flush never lands here,
+        so its retry folds normally (it was never counted)."""
+        if not key:
+            return
+        with self._idempotency_lock:
+            self._idempotency_seen[key] = None
+            self._idempotency_seen.move_to_end(key)
+            while len(self._idempotency_seen) > self.config.idempotency_capacity:
+                self._idempotency_seen.popitem(last=False)
+
+    def score_replay(self, rows: np.ndarray) -> Tuple[np.ndarray, Optional[int]]:
+        """``(scores, generation)`` for a replayed idempotent request:
+        scores directly on the active model WITHOUT folding the drift
+        monitor, the reservoir or the retrain trigger — the first attempt
+        already counted these rows. Bitwise identical to the coalesced
+        path (coalesced == direct ``model.score`` is the serving tier's
+        standing parity guarantee, docs/serving.md)."""
+        rows = np.asarray(rows, np.float32)
+        timeout_s = self.config.score_timeout_s
+        kwargs = {}
+        if int(rows.shape[0]) > self._max_warm_bucket:
+            kwargs = {"chunk_size": self._max_warm_bucket, "pipeline": True}
+        if self.manager is not None:
+            return self.manager.score(
+                rows,
+                timeout_s=timeout_s,
+                return_generation=True,
+                fold=False,
+                **kwargs,
+            )
+        scores = self._bare_model.score(
+            rows, timeout_s=timeout_s, fold_monitor=False, **kwargs
+        )
+        return scores, None
 
     # ------------------------------------------------------------------ #
 

@@ -172,6 +172,13 @@ class _Handler(BaseHTTPRequestHandler):
                 json.dumps(doc, sort_keys=True) + "\n",
             )
         elif path in ("/healthz", "/health"):
+            if owner.is_replica:
+                # chaos seam (docs/replication.md): a wedged replica
+                # answers /healthz slower than the router's probe timeout —
+                # the router must eject it, not hang behind it
+                from ..resilience import faults
+
+                faults.maybe_wedge_healthz()
             payload, healthy = owner.health()
             self._reply(
                 200 if healthy else 503,
@@ -254,6 +261,23 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         body = self.rfile.read(length) if length else b""
+        if owner.is_replica and (path + "/").startswith("/score/"):
+            # chaos seam (docs/replication.md): a replica that dies while
+            # holding a scoring request — the router must retry it
+            # elsewhere with zero client-visible failures. Gated on
+            # is_replica so the ROUTER's own /score front (same server
+            # class, same process in tests) never consumes the fault.
+            from ..resilience import faults
+
+            action = faults.take_replica_kill()
+            if action == "exit":
+                os._exit(17)  # the whole replica process, mid-request
+            if action == "sever":
+                # drop the connection without a response: the client sees
+                # RemoteDisconnected, exactly what a SIGKILL'd peer looks
+                # like from the wire
+                self.close_connection = True
+                return
         extra_headers = None
         try:
             result = handler(body, self.headers, query)
@@ -316,6 +340,11 @@ class MetricsServer:
         self.post_prefix_routes: dict = {}
         self.get_routes: dict = {}
         self.serving_state = None
+        # True while a scoring service (single-model or fleet) is mounted:
+        # arms the replica chaos seams (kill-during-score, wedged healthz)
+        # for THIS server only — a replication router shares the server
+        # class and must never consume a fault meant for its replicas
+        self.is_replica = False
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.owner = self  # type: ignore[attr-defined]

@@ -485,7 +485,7 @@ def build_bundle(trace_limit: int = 10, event_tail: int = 200) -> dict:
     except Exception as exc:  # pragma: no cover - tuning import failure
         autotune = {"error": repr(exc)}
     timeline = [e.as_dict() for e in _events.get_events()]
-    return {
+    doc = {
         "schema": BUNDLE_SCHEMA,
         "generated_unix_s": round(time.time(), 3),
         "config": config_fingerprint(),
@@ -501,6 +501,33 @@ def build_bundle(trace_limit: int = 10, event_tail: int = 200) -> dict:
         "compiles": compile_counts(),
         "memory": memory_watermarks(),
     }
+    with _LOCK:
+        providers = dict(_BUNDLE_PROVIDERS)
+    for name, provider in sorted(providers.items()):
+        try:
+            doc[name] = provider()
+        except Exception as exc:  # a broken provider must not kill the bundle
+            doc[name] = {"error": repr(exc)}
+    return doc
+
+
+# Dynamic bundle sections: a subsystem that only sometimes lives in the
+# process (the replication router, docs/replication.md) registers a zero-arg
+# provider here; its snapshot rides every bundle while registered. The
+# static BUNDLE_SECTIONS tuple stays the baseline contract.
+_BUNDLE_PROVIDERS: dict = {}
+
+
+def register_bundle_section(name: str, provider) -> None:
+    """Attach ``provider()`` output as section ``name`` of every future
+    debug bundle (replaces any provider already at ``name``)."""
+    with _LOCK:
+        _BUNDLE_PROVIDERS[str(name)] = provider
+
+
+def unregister_bundle_section(name: str) -> None:
+    with _LOCK:
+        _BUNDLE_PROVIDERS.pop(str(name), None)
 
 
 def write_bundle(path: str, **kw) -> dict:
@@ -552,10 +579,12 @@ __all__ = [
     "note_host_staging",
     "peak_host_staging_bytes",
     "plane_placement",
+    "register_bundle_section",
     "release_resident_plane",
     "reset_resources",
     "resident_plane_bytes",
     "resources_enabled",
+    "unregister_bundle_section",
     "warmup_scope",
     "write_bundle",
 ]

@@ -614,7 +614,12 @@ def check_spans_documented(project: Project) -> List[Finding]:
 HTTP_FILE = "isoforest_tpu/telemetry/http.py"
 # the three docs whose tables carry endpoint rows (docs/observability.md
 # §8/§9, docs/serving.md, docs/fleet.md §3)
-ENDPOINT_DOCS = (OBS_DOC, "docs/serving.md", "docs/fleet.md")
+ENDPOINT_DOCS = (
+    OBS_DOC,
+    "docs/serving.md",
+    "docs/fleet.md",
+    "docs/replication.md",
+)
 # do_GET built-ins that legitimately have no docs-table row: the index
 # page and the /healthz spelling alias
 ENDPOINT_ALIASES = {"/", "/health"}

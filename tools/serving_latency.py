@@ -37,6 +37,13 @@ against that tenant's model dir — and additionally asserts the per-tenant
 ``isoforest_fleet_{request_seconds,responses_total}{model_id=}`` series
 exist in ``/snapshot``.
 
+With ``--router`` the generator drives a replication ROUTER
+(docs/replication.md) instead of a replica: the ``isoforest_router_*``
+series replace the serving ones, the trace/steady-compile phases are
+skipped (they live in the replicas), and every closed-loop non-2xx is a
+failure — the replicated tier's contract is zero failed requests even
+while a replica is killed mid-run.
+
 Every phase prints one JSON line; the final line carries the verdict.
 Exits non-zero on parity failure, a missed gate, or missing serving series.
 """
@@ -184,13 +191,14 @@ def _open_loop(url, rows_pool, rps, duration, rows_per_request, max_inflight=64)
     }
 
 
-def _server_histogram_summary(url):
-    """p50/p95/p99 of ``isoforest_serving_request_seconds`` from the
-    server's /snapshot, interpolated with the same le-bucket rule
+def _server_histogram_summary(url, metric_name="isoforest_serving_request_seconds"):
+    """p50/p95/p99 of the request-latency histogram from the server's
+    /snapshot (``isoforest_router_request_seconds`` in --router mode),
+    interpolated with the same le-bucket rule
     ``telemetry.metrics.Histogram.quantile`` uses."""
     with urllib.request.urlopen(url + "/snapshot", timeout=10) as resp:
         doc = json.loads(resp.read())
-    metric = doc.get("metrics", {}).get("isoforest_serving_request_seconds")
+    metric = doc.get("metrics", {}).get(metric_name)
     if not metric or not metric.get("series"):
         return None
     series = metric["series"][0]
@@ -367,6 +375,14 @@ SERVING_SERIES = (
     "isoforest_serving_responses_total",
 )
 
+# what a replication ROUTER's own /metrics must carry instead (the serving
+# series live in its replicas, docs/replication.md)
+ROUTER_SERIES = (
+    "isoforest_router_request_seconds",
+    "isoforest_router_requests_total",
+    "isoforest_router_replicas_admitted",
+)
+
 
 def _check_tenant_series(url, model_id):
     """With --model-id, the deployment's /snapshot must carry the
@@ -424,6 +440,16 @@ def main() -> None:
         help="fail unless concurrent rows/s >= gate * sequential rows/s "
         "(0 = report only)",
     )
+    ap.add_argument(
+        "--router",
+        action="store_true",
+        help="--url points at a replication ROUTER (docs/replication.md): "
+        "check the isoforest_router_* series instead of the serving ones, "
+        "skip the trace/steady-compile/tenant-series phases (those live in "
+        "the replicas), and treat EVERY closed-loop non-2xx as a failure — "
+        "the replicated tier's contract is zero failed requests even while "
+        "replicas die mid-run",
+    )
     args = ap.parse_args()
     url = args.url.rstrip("/")
     if args.model_id:
@@ -454,7 +480,7 @@ def main() -> None:
     # steady-compile watermark BEFORE the measured phases: the serve
     # prewarmed its buckets and marked steady, so the measured traffic
     # below must not trigger a single further XLA compile
-    steady_before = _steady_compile_count(url)
+    steady_before = None if args.router else _steady_compile_count(url)
 
     sequential = _closed_loop(url, rows_pool, 1, args.duration, args.rows_per_request)
     print(json.dumps({"phase": "closed_sequential", **sequential}), flush=True)
@@ -462,6 +488,12 @@ def main() -> None:
         url, rows_pool, args.concurrency, args.duration, args.rows_per_request
     )
     print(json.dumps({"phase": "closed_concurrent", **concurrent}), flush=True)
+    if args.router:
+        # the replicated tier's contract: zero failed requests, even while
+        # a replica is killed mid-run (the router retries idempotently)
+        errors = {**sequential["errors"], **concurrent["errors"]}
+        if errors:
+            failed.append(f"router_failed_requests:{errors}")
 
     if args.rps > 0:
         open_loop = _open_loop(
@@ -469,12 +501,21 @@ def main() -> None:
         )
         print(json.dumps({"phase": "open_loop", **open_loop}), flush=True)
 
-    trace = _trace_phase(url, rows_pool, args.rows_per_request)
-    print(json.dumps({"phase": "trace", **trace}), flush=True)
-    if not trace["pass"]:
-        failed.append("trace")
+    if not args.router:
+        # the trace phase reads GET /trace on the SAME process that scored;
+        # behind a router the request trace lives in whichever replica
+        # served it, so the phase is meaningful only against a replica
+        trace = _trace_phase(url, rows_pool, args.rows_per_request)
+        print(json.dumps({"phase": "trace", **trace}), flush=True)
+        if not trace["pass"]:
+            failed.append("trace")
 
-    latency = _server_histogram_summary(url)
+    latency = _server_histogram_summary(
+        url,
+        "isoforest_router_request_seconds"
+        if args.router
+        else "isoforest_serving_request_seconds",
+    )
     print(json.dumps({"phase": "server_latency", "histogram": latency}), flush=True)
 
     try:
@@ -483,11 +524,12 @@ def main() -> None:
     except Exception as exc:
         metrics_body = ""
         failed.append(f"metrics_fetch:{exc!r}")
-    missing_series = [s for s in SERVING_SERIES if s not in metrics_body]
+    expected_series = ROUTER_SERIES if args.router else SERVING_SERIES
+    missing_series = [s for s in expected_series if s not in metrics_body]
     if missing_series:
         failed.append(f"missing_series:{missing_series}")
 
-    if args.model_id:
+    if args.model_id and not args.router:
         try:
             missing_tenant = _check_tenant_series(url, args.model_id)
         except Exception as exc:
@@ -506,14 +548,19 @@ def main() -> None:
         if missing_tenant:
             failed.append(f"missing_tenant_series:{missing_tenant}")
 
-    steady_after = _steady_compile_count(url)
-    if steady_before < 0 or steady_after < 0:
-        steady_delta = None
-        failed.append("steady_compile_fetch")
+    if args.router:
+        # the router process never compiles — the watermark lives in its
+        # replicas, each already gated by their own serving smoke
+        steady_after, steady_delta = -1, None
     else:
-        steady_delta = steady_after - steady_before
-        if steady_delta != 0:
-            failed.append(f"steady_recompiles:{steady_delta}")
+        steady_after = _steady_compile_count(url)
+        if steady_before < 0 or steady_after < 0:
+            steady_delta = None
+            failed.append("steady_compile_fetch")
+        else:
+            steady_delta = steady_after - steady_before
+            if steady_delta != 0:
+                failed.append(f"steady_recompiles:{steady_delta}")
 
     ratio = (
         concurrent["rows_per_s"] / sequential["rows_per_s"]
