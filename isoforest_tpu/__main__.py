@@ -505,7 +505,18 @@ def cmd_serve(args) -> int:
         queue_deadline_ms=args.queue_deadline_ms,
         request_timeout_s=args.request_timeout_s,
         score_timeout_s=args.score_timeout_s,
+        weight=args.weight,
     )
+    weights = {}
+    for spec in args.tenant_weight or ():
+        model_id, sep, value = spec.partition("=")
+        if not sep or not model_id:
+            print(
+                f"error: --tenant-weight expects MODEL_ID=WEIGHT, got {spec!r}",
+                file=sys.stderr,
+            )
+            return 2
+        weights[model_id] = float(value)
     warm = sorted({int(s) for s in args.warm_batch_sizes.split(",") if s})
     manager_kwargs = {
         "drift_debounce": args.debounce,
@@ -534,6 +545,7 @@ def cmd_serve(args) -> int:
             work_root=args.work_dir,
             manager_kwargs=manager_kwargs,
             preload=args.preload,
+            weights=weights or None,
         )
         ready = {
             "serving": True,
@@ -568,6 +580,26 @@ def cmd_serve(args) -> int:
             "batch_rows": config.batch_rows,
             "linger_ms": config.linger_ms,
         }
+    autopilot = None
+    if args.autopilot:
+        from .autopilot import Autopilot, AutopilotConfig, mount_autopilot
+
+        ap_config = AutopilotConfig(
+            high_water=args.autopilot_high_water,
+            low_water=args.autopilot_low_water,
+            engage_ticks=args.autopilot_engage_ticks,
+            recover_ticks=args.autopilot_recover_ticks,
+            tick_interval_s=args.autopilot_interval_s,
+            subsample_trees=args.autopilot_subsample_trees,
+            strict=args.autopilot_strict,
+        )
+        if args.models_dir is not None:
+            autopilot = Autopilot(registry=handle.registry, config=ap_config)
+        else:
+            autopilot = Autopilot(services=[handle.service], config=ap_config)
+        mount_autopilot(handle.server, autopilot)
+        autopilot.start()
+        ready["autopilot"] = True
     heartbeat = None
     if args.replica_name and args.heartbeat_dir:
         # replicated tier (docs/replication.md): advertise liveness to the
@@ -593,6 +625,8 @@ def cmd_serve(args) -> int:
     finally:
         if heartbeat is not None:
             heartbeat.stop()
+        if autopilot is not None:
+            autopilot.close()
         handle.close()
     return 0
 
@@ -1048,6 +1082,79 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="exit after this many seconds (default: serve until "
         "SIGTERM/SIGINT) — CI smoke runs use it with `timeout`",
+    )
+    srv.add_argument(
+        "--autopilot",
+        action="store_true",
+        help="arm the overload autopilot (docs/autopilot.md): under "
+        "sustained queue pressure walk the reversible brownout ladder — "
+        "widen coalescing, shed low-weight tenants (429 + Retry-After), "
+        "degrade quality (q16 + subsampled forest) — and recover "
+        "rung-by-rung when pressure drops",
+    )
+    srv.add_argument(
+        "--autopilot-high-water",
+        type=float,
+        default=0.5,
+        help="queue-fill fraction at/above which ticks count toward "
+        "engaging the next brownout rung",
+    )
+    srv.add_argument(
+        "--autopilot-low-water",
+        type=float,
+        default=0.15,
+        help="queue-fill fraction at/below which ticks count toward "
+        "lifting the deepest engaged rung (hysteresis: must be below "
+        "--autopilot-high-water)",
+    )
+    srv.add_argument(
+        "--autopilot-engage-ticks",
+        type=int,
+        default=3,
+        help="consecutive high-water ticks before one rung engages",
+    )
+    srv.add_argument(
+        "--autopilot-recover-ticks",
+        type=int,
+        default=6,
+        help="consecutive low-water ticks before one rung lifts",
+    )
+    srv.add_argument(
+        "--autopilot-interval-s",
+        type=float,
+        default=0.5,
+        help="control-loop tick interval",
+    )
+    srv.add_argument(
+        "--autopilot-subsample-trees",
+        type=float,
+        default=0.5,
+        help="rung 3: fraction of the forest scored while quality is "
+        "degraded (FastForest-style prefix subsample)",
+    )
+    srv.add_argument(
+        "--autopilot-strict",
+        action="store_true",
+        help="report pressure but REFUSE every brownout rung (the "
+        "degradation ladder's strict=True opt-out; autopilot.refused "
+        "events mark each refusal)",
+    )
+    srv.add_argument(
+        "--weight",
+        type=float,
+        default=1.0,
+        help="this deployment's shed-priority weight class "
+        "(docs/autopilot.md; fleet tenants can override per tenant with "
+        "--tenant-weight)",
+    )
+    srv.add_argument(
+        "--tenant-weight",
+        action="append",
+        default=None,
+        metavar="MODEL_ID=WEIGHT",
+        help="fleet mode: per-tenant shed-priority weight (repeatable); "
+        "tenants below the fleet's highest weight class are shed first "
+        "under the autopilot's rung 2",
     )
     srv.add_argument(
         "--replica-name",

@@ -1,0 +1,27 @@
+"""Overload autopilot: closed-loop SLO control with a reversible brownout
+ladder (docs/autopilot.md).
+
+The controller that turns two PRs of sensors (traces, compile/memory
+accounting, the serving histograms) into action: under sustained queue
+pressure it widens coalescing toward throughput, sheds low-weight tenants
+with typed 429s, and finally spends bounded accuracy (q16 +
+``subsample_trees``) — every rung a documented degradation-ladder entry,
+every transition an ``autopilot.*`` event, recovery rung-by-rung with
+hysteresis. ``python -m isoforest_tpu serve ... --autopilot`` arms it.
+"""
+
+from .controller import (
+    RUNG_REASONS,
+    Autopilot,
+    AutopilotConfig,
+    current_rung,
+    mount_autopilot,
+)
+
+__all__ = [
+    "RUNG_REASONS",
+    "Autopilot",
+    "AutopilotConfig",
+    "current_rung",
+    "mount_autopilot",
+]

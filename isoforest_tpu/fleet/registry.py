@@ -115,6 +115,8 @@ class ModelLoadError(ServingError):
     next request (HTTP 503 — retriable; other tenants are unaffected)."""
 
     status = 503
+    # loads are retried on the very next request: a short, fixed backoff
+    retry_after_s = 1.0
 
 
 def layout_nbytes(model) -> int:
@@ -210,6 +212,11 @@ class ManagedEntry:
                 manager.retrain_in_progress if manager is not None else False
             ),
             "last_load_error": self.last_load_error,
+            # autopilot visibility (docs/autopilot.md): the tenant's shed
+            # priority class and any active brownout state
+            "weight": self.config.weight,
+            "shed": service.shed if service is not None else False,
+            "quality": service.quality if service is not None else None,
         }
         return doc
 
@@ -318,6 +325,15 @@ class ModelRegistry:
         with self._lock:
             entries = [self._entries[k] for k in sorted(self._entries)]
         return [e.state() for e in entries]
+
+    def resident_services(self) -> List[ScoringService]:
+        """Point-in-time references to every resident tenant's scoring
+        service (the autopilot's sensor/actuator set, docs/autopilot.md).
+        Safe to call from any thread; entries mid-eviction simply drop
+        out of the snapshot."""
+        with self._lock:
+            entries = list(self._entries.values())
+        return [e.service for e in entries if e.service is not None]
 
     def state(self) -> dict:
         """Fleet-level state (plain JSON types)."""
@@ -606,6 +622,9 @@ class ModelRegistry:
             service = entry.service  # point-in-time: eviction-safe
             if service is None:
                 continue  # evicted between load and capture: reload
+            # the autopilot's shed rung refuses this tenant before any
+            # queue or replay work (typed 429 + Retry-After)
+            service.check_admission()
             if idempotency_key is not None and service.idempotency_seen(
                 idempotency_key
             ):
@@ -645,6 +664,9 @@ class ModelRegistry:
                 "queue_wait_s": pending.queue_wait_s,
                 "flush_ctx": pending.flush_ctx,
             }
+            degraded = service.quality
+            if degraded is not None:
+                info["degraded"] = degraded
             return scores, info
         raise ModelLoadError(
             f"model {model_id!r} was evicted twice while the request was "

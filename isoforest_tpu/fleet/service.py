@@ -87,11 +87,13 @@ class FleetService:
         status: int,
         body: str,
         content_type: str = "application/json",
-    ) -> Tuple[int, str, str]:
+        retry_after_s: Optional[float] = None,
+    ) -> Tuple[int, str, str, dict]:
         """Account one tenant response: the unlabelled serving series keep
         the deployment-wide totals, the ``{model_id=}`` twins separate the
-        tenants."""
-        out = _serving_finish(t0, status, body, content_type)
+        tenants. Backpressure statuses (429/503) carry ``Retry-After``
+        like the single-model path."""
+        out = _serving_finish(t0, status, body, content_type, retry_after_s)
         _FLEET_REQUEST_SECONDS.observe(
             time.perf_counter() - t0, model_id=model_id
         )
@@ -112,17 +114,19 @@ class FleetService:
                 "serving.request", path=SCORE_PREFIX + model_id,
                 model_id=model_id,
             ) as sp:
-                status, content_type, payload = self._respond(
+                status, content_type, payload, extra = self._respond(
                     model_id, body, headers, query, sp
                 )
                 sp.set_attrs(status=status)
                 trace_id = sp.trace_id or inbound
-        resp_headers = {TRACE_HEADER: trace_id} if trace_id else {}
+        resp_headers = dict(extra)
+        if trace_id:
+            resp_headers[TRACE_HEADER] = trace_id
         return status, content_type, payload, resp_headers
 
     def _respond(
         self, model_id: str, body: bytes, headers, query: str, sp
-    ) -> Tuple[int, str, str]:
+    ) -> Tuple[int, str, str, dict]:
         t0 = time.perf_counter()
         try:
             try:
@@ -159,7 +163,11 @@ class FleetService:
                 )
             except ServingError as exc:
                 return self._finish(
-                    model_id, t0, exc.status, _error_body(exc.status, str(exc))
+                    model_id,
+                    t0,
+                    exc.status,
+                    _error_body(exc.status, str(exc)),
+                    retry_after_s=exc.retry_after_s,
                 )
             except Exception as exc:  # scoring failure: typed 500, never a hang
                 return self._finish(model_id, t0, 500, _error_body(500, repr(exc)))
@@ -191,6 +199,9 @@ class FleetService:
             if info.get("replayed"):
                 # an idempotent retry re-scored fold-free (docs/replication.md §2)
                 doc["replayed"] = True
+            if info.get("degraded"):
+                # autopilot quality rung: degradation reported on the wire
+                doc["degraded"] = info["degraded"]
             return self._finish(model_id, t0, 200, json.dumps(doc) + "\n")
         except Exception as exc:  # encoder/accounting bug: still a typed 500
             return self._finish(model_id, t0, 500, _error_body(500, repr(exc)))
@@ -218,16 +229,24 @@ class FleetService:
         return 200, "application/json", json.dumps(doc, sort_keys=True) + "\n"
 
     def handle_models(self, query: str = "") -> Tuple[int, str, str]:
-        """``GET /models``: per-tenant state rows + the fleet roll-up."""
+        """``GET /models``: per-tenant state rows + the fleet roll-up.
+        When an overload autopilot is attached the roll-up names its
+        current brownout rung (docs/autopilot.md)."""
+        from ..autopilot import current_rung
+
         doc = self.registry.state()
         doc["models"] = self.registry.models_state()
+        doc["autopilot_rung"] = current_rung()
         return 200, "application/json", json.dumps(doc, sort_keys=True) + "\n"
 
     def state(self) -> dict:
         """``/healthz`` serving section: the fleet roll-up plus a
         per-tenant lifecycle subsection each."""
+        from ..autopilot import current_rung
+
         doc = self.registry.state()
         doc["fleet"] = True
+        doc["autopilot_rung"] = current_rung()
         doc["tenants"] = {
             row["model_id"]: {
                 "resident": row["resident"],
@@ -235,6 +254,9 @@ class FleetService:
                 "queue_rows": row["queue_rows"],
                 "retrain_in_progress": row["retrain_in_progress"],
                 "pinned": row["pinned"],
+                "weight": row["weight"],
+                "shed": row["shed"],
+                "quality": row["quality"],
             }
             for row in self.registry.models_state()
         }
@@ -312,6 +334,7 @@ def serve_fleet(
     work_root: Optional[str] = None,
     manager_kwargs: Optional[dict] = None,
     preload: bool = False,
+    weights: Optional[dict] = None,
 ) -> FleetHandle:
     """Assemble a multi-tenant fleet over sealed model directories:
 
@@ -323,9 +346,13 @@ def serve_fleet(
        + ``GET /models`` on it.
 
     ``work_root`` hosts per-tenant lifecycle dirs (``<work_root>/<id>``;
-    default ``<model_dir>.lifecycle`` next to each model). Returns the
-    :class:`FleetHandle`.
+    default ``<model_dir>.lifecycle`` next to each model). ``weights``
+    maps ``model_id -> priority weight`` for the autopilot's shed rung
+    (docs/autopilot.md); unnamed tenants keep ``config.weight``. Returns
+    the :class:`FleetHandle`.
     """
+    import dataclasses
+
     from ..telemetry.http import serve as _telemetry_serve
 
     if (models_dir is None) == (models is None):
@@ -342,11 +369,17 @@ def serve_fleet(
         lifecycle=lifecycle,
         manager_kwargs=manager_kwargs,
     )
+    base_config = config or ServingConfig()
     for model_id, path in sorted(mapping.items()):
         work_dir = (
             os.path.join(work_root, model_id) if work_root else None
         )
-        registry.register(model_id, path, work_dir=work_dir)
+        tenant_config = None
+        if weights and model_id in weights:
+            tenant_config = dataclasses.replace(
+                base_config, weight=float(weights[model_id])
+            )
+        registry.register(model_id, path, work_dir=work_dir, config=tenant_config)
     server = _telemetry_serve(port=port, host=host)
     fleet = FleetService(registry)
     mount_fleet(server, fleet)

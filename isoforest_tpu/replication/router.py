@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import math
 import os
 import subprocess
 import sys
@@ -330,7 +331,11 @@ class Router:
             ) + "\n"
             _ROUTER_REQUEST_SECONDS.observe(time.perf_counter() - t0)
             _ROUTER_REQUESTS.inc(replica="none", code=503)
-            return 503, "application/json", payload, {}
+            # a draining router never recovers: the backoff just needs to
+            # push the client to another tier within a probe interval
+            return 503, "application/json", payload, {
+                "Retry-After": self._retry_after_value()
+            }
         inbound = inbound_trace_id(headers)
         # the request's identity across retries: adopt the client's key or
         # mint one — either way every forward of THIS request carries the
@@ -340,6 +345,7 @@ class Router:
         tried: set = set()
         served: List[Replica] = []
         trace_id = inbound
+        retry_after: Optional[str] = None
         ctx = TraceContext(inbound) if inbound else None
         try:
             with with_context(ctx):
@@ -360,13 +366,15 @@ class Router:
                         )
 
                     try:
-                        replica, status, ctype, payload = retry_call(
-                            _attempt,
-                            policy=self.config.retry_policy(),
-                            retry_on=(ReplicaRequestError, NoReplicaError),
-                            describe=f"router forward {path}",
-                            clock=self._clock,
-                            sleep=self._sleep,
+                        replica, status, ctype, payload, retry_after = (
+                            retry_call(
+                                _attempt,
+                                policy=self.config.retry_policy(),
+                                retry_on=(ReplicaRequestError, NoReplicaError),
+                                describe=f"router forward {path}",
+                                clock=self._clock,
+                                sleep=self._sleep,
+                            )
                         )
                         served.append(replica)
                     except RetryError as exc:
@@ -379,6 +387,9 @@ class Router:
                                 "attempts": exc.attempts,
                             }
                         ) + "\n"
+                        # every replica is down/wedged: a probe pass may
+                        # re-admit one — tell the client to wait that long
+                        retry_after = self._retry_after_value()
                     sp.set_attrs(
                         status=status,
                         replica=served[0].name if served else None,
@@ -393,7 +404,18 @@ class Router:
         _ROUTER_REQUEST_SECONDS.observe(time.perf_counter() - t0)
         _ROUTER_REQUESTS.inc(replica=name, code=status)
         resp_headers = {TRACE_HEADER: trace_id} if trace_id else {}
+        if retry_after is not None:
+            # a replica's backpressure answer travels VERBATIM: its
+            # Retry-After is the queue-drain estimate of the machine that
+            # actually refused, not anything the router should re-derive
+            resp_headers["Retry-After"] = retry_after
         return status, ctype, payload, resp_headers
+
+    def _retry_after_value(self) -> str:
+        """The router's own ``Retry-After`` for 503s it mints itself
+        (draining, retry budget exhausted): one probe interval — the
+        soonest admission state can change — floored to 1 s."""
+        return str(max(1, math.ceil(self.config.probe_interval_s)))
 
     def _forward(
         self,
@@ -404,10 +426,16 @@ class Router:
         query: str,
         trace_id: Optional[str],
         idem_key: str,
-    ) -> Tuple[Replica, int, str, str]:
+    ) -> Tuple[Replica, int, str, str, Optional[str]]:
         """One forward to one replica. An HTTP response (any status) is the
-        replica's authoritative answer and passes through; wire death
-        ejects the replica and raises the retryable error."""
+        replica's authoritative answer and passes through — a 429/503
+        backpressure refusal is an ANSWER, not wire death: it consumes no
+        retry attempt, ticks no retry counter, emits no
+        ``router.replica_retry`` event, and its ``Retry-After`` header
+        travels back verbatim (re-forwarding refused load elsewhere would
+        convert one replica's backpressure into tier-wide congestion).
+        Only wire death (connection severed, timeout) ejects the replica
+        and raises the retryable error."""
         with self._lock:
             replica.outstanding += 1
         _ROUTER_OUTSTANDING.inc()
@@ -426,10 +454,16 @@ class Router:
                     payload = resp.read().decode("utf-8")
                     status = resp.status
                     ctype = resp.headers.get("Content-Type") or "application/json"
+                    retry_after = resp.headers.get("Retry-After")
             except urllib.error.HTTPError as exc:
+                # authoritative pass-through (docstring): 4xx/5xx — and in
+                # particular 429/503 backpressure — RETURNS here rather
+                # than raising a retryable error, so it never mints a
+                # retry attempt
                 payload = exc.read().decode("utf-8", errors="replace")
                 status = exc.code
                 ctype = exc.headers.get("Content-Type") or "application/json"
+                retry_after = exc.headers.get("Retry-After")
             except (http.client.HTTPException, OSError) as exc:
                 # URLError (incl. timeouts/refused) is an OSError; a severed
                 # connection is RemoteDisconnected — all wire death
@@ -446,7 +480,7 @@ class Router:
                 ) from exc
             with self._lock:
                 replica.requests += 1
-            return replica, status, ctype, payload
+            return replica, status, ctype, payload, retry_after
         finally:
             with self._lock:
                 replica.outstanding -= 1

@@ -129,6 +129,32 @@ LADDER: Dict[str, str] = {
         "like drift_alert, this rung flags an operational event, not a "
         "compute fallback, so it is deliberately strict-exempt"
     ),
+    # overload-autopilot rungs (autopilot/controller.py, docs/autopilot.md)
+    "autopilot_widen_batch": (
+        "sustained queue pressure -> the controller widens the live "
+        "coalescer's max_linger_s/max_batch_rows toward the "
+        "throughput-optimal bucket: scores stay BITWISE identical (batch "
+        "composition never affects a row's score — the serving tier's "
+        "standing parity guarantee); only per-request latency trades "
+        "against throughput, and the original policy is restored "
+        "rung-by-rung on recovery"
+    ),
+    "autopilot_shed_low_weight": (
+        "queue pressure persists at the widened batch policy -> tenants "
+        "below the fleet's highest ServingConfig.weight class are refused "
+        "with a typed 429 (ShedError) + Retry-After; surviving tenants' "
+        "scores remain BITWISE identical and their admission ladder is "
+        "untouched — shed traffic is refused crisply, never half-served"
+    ),
+    "autopilot_quality_degrade": (
+        "queue pressure persists after shedding -> scoring drops to the "
+        "q16 quantized plane and/or a subsample_trees prefix of the "
+        "forest (FastForest, arxiv 2004.02423): path-length normalisation "
+        "rescales to the surviving tree count automatically, an ELIGIBLE "
+        "q16 run is bitwise-equal to its f32 traversal family, and the "
+        "response/flush span say 'degraded' — quality loss is reported, "
+        "never silent; full fidelity returns on recovery"
+    ),
     # load-time rung (io/persistence.py, on_corrupt='drop')
     "dropped_trees": (
         "corrupt trees dropped at load -> valid smaller forest: path-length "

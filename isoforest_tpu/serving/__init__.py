@@ -24,7 +24,13 @@ from .coalescer import (
     ServingError,
 )
 from .http import SCORE_PATH, handle_score, mount, unmount
-from .service import ScoringService, ServingConfig, ServingHandle, serve_model
+from .service import (
+    ScoringService,
+    ServingConfig,
+    ServingHandle,
+    ShedError,
+    serve_model,
+)
 
 __all__ = [
     "SCORE_PATH",
@@ -37,6 +43,7 @@ __all__ = [
     "ServingConfig",
     "ServingError",
     "ServingHandle",
+    "ShedError",
     "handle_score",
     "mount",
     "serve_model",

@@ -93,9 +93,13 @@ _FLUSHES = _counter(
 
 class ServingError(Exception):
     """Base class for serving-layer refusals; ``status`` is the HTTP code
-    the endpoint maps the error to (docs/serving.md backpressure table)."""
+    the endpoint maps the error to (docs/serving.md backpressure table).
+    ``retry_after_s`` is the server's drain estimate — every 429/503
+    response carries it as an integer ``Retry-After`` header so clients
+    back off for a grounded interval instead of guessing."""
 
     status = 500
+    retry_after_s: Optional[float] = None
 
 
 class QueueFullError(ServingError):
@@ -232,17 +236,21 @@ class MicroBatchCoalescer:
             if self._queue:
                 age = now - self._queue[0].enqueued_at
                 if age > self.queue_deadline_s:
-                    raise QueueStaleError(
+                    exc: ServingError = QueueStaleError(
                         f"oldest queued request is {age:.3f}s old "
                         f"(> queue_deadline_s={self.queue_deadline_s:g}); "
                         "the scoring backend is not draining the queue"
                     )
+                    exc.retry_after_s = self.queue_deadline_s
+                    raise exc
             if self._pending_rows + n > self.max_queue_rows:
-                raise QueueFullError(
+                exc = QueueFullError(
                     f"{n} rows would overflow the admission queue "
                     f"({self._pending_rows}/{self.max_queue_rows} rows "
                     "pending); back off and retry"
                 )
+                exc.retry_after_s = self._drain_estimate_s_locked()
+                raise exc
             pending = _Pending(rows, now, ctx=_current_context())
             self._queue.append(pending)
             self._pending_rows += n
@@ -278,6 +286,56 @@ class MicroBatchCoalescer:
     def pending_rows(self) -> int:
         with self._cond:
             return self._pending_rows
+
+    def _drain_estimate_s_locked(self) -> float:
+        """Rough time to drain the current backlog: flushes needed at the
+        configured batch size, each paced by the linger window (floored so
+        a zero-linger coalescer still advertises a sane backoff). Caller
+        holds the lock; feeds the ``Retry-After`` header on 429s."""
+        flushes = max(1, -(-self._pending_rows // self.max_batch_rows))
+        return flushes * max(self.max_linger_s, 0.05)
+
+    def reconfigure(
+        self,
+        *,
+        max_batch_rows: Optional[int] = None,
+        max_linger_s: Optional[float] = None,
+    ) -> dict:
+        """Adjust the flush policy on a LIVE coalescer (the autopilot's
+        rung-1 knob, docs/autopilot.md). Takes effect under the condition
+        lock so in-flight submits/flushes see one consistent policy: queued
+        requests are never lost, split, or double-drained across the
+        change — the next ``_due_locked`` simply evaluates the new
+        thresholds. Returns the policy that was in force BEFORE the change
+        so the caller can revert. Same validation as the constructor."""
+        with self._cond:
+            previous = {
+                "max_batch_rows": self.max_batch_rows,
+                "max_linger_s": self.max_linger_s,
+            }
+            new_batch = (
+                self.max_batch_rows
+                if max_batch_rows is None
+                else int(max_batch_rows)
+            )
+            new_linger = (
+                self.max_linger_s if max_linger_s is None else float(max_linger_s)
+            )
+            if new_batch < 1:
+                raise ValueError(f"max_batch_rows must be >= 1, got {new_batch}")
+            if self.max_queue_rows < new_batch:
+                raise ValueError(
+                    f"max_batch_rows ({new_batch}) must stay <= max_queue_rows "
+                    f"({self.max_queue_rows}) or the size trigger can never fire"
+                )
+            if new_linger < 0:
+                raise ValueError(f"max_linger_s must be >= 0, got {new_linger}")
+            self.max_batch_rows = new_batch
+            self.max_linger_s = new_linger
+            # wake the flusher: the new policy may make a waiting batch due
+            # (shorter linger) or let it keep filling (wider batch)
+            self._cond.notify_all()
+        return previous
 
     def _due_locked(self) -> Tuple[List[_Pending], Optional[str]]:
         """(batch, cause) when a flush is due, else ([], None). Caller

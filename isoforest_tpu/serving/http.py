@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import io
 import json
+import math
 import re
 import time
 from typing import Dict, Optional, Tuple
@@ -152,16 +153,20 @@ def handle_score(
     ctx = TraceContext(inbound) if inbound else None
     with with_context(ctx):
         with span("serving.request", path=SCORE_PATH) as sp:
-            status, content_type, payload = _respond(
+            status, content_type, payload, extra = _respond(
                 service, body, headers, query, sp
             )
             sp.set_attrs(status=status)
             trace_id = sp.trace_id or inbound
-    resp_headers = {TRACE_HEADER: trace_id} if trace_id else {}
+    resp_headers = dict(extra)
+    if trace_id:
+        resp_headers[TRACE_HEADER] = trace_id
     return status, content_type, payload, resp_headers
 
 
-def _respond(service, body: bytes, headers, query: str, sp) -> Tuple[int, str, str]:
+def _respond(
+    service, body: bytes, headers, query: str, sp
+) -> Tuple[int, str, str, Dict[str, str]]:
     t0 = time.perf_counter()
     content_type = (headers.get("Content-Type") or "").lower()
     csv = "csv" in content_type or "format=csv" in (query or "")
@@ -174,6 +179,17 @@ def _respond(service, body: bytes, headers, query: str, sp) -> Tuple[int, str, s
         except _BadRequest as exc:
             return _finish(t0, 400, _error_body(400, str(exc)))
         sp.set_attrs(rows=int(rows.shape[0]))
+        try:
+            # the autopilot's shed rung refuses this tenant BEFORE any
+            # queue or replay work — a typed 429 with Retry-After
+            service.check_admission()
+        except ServingError as exc:
+            return _finish(
+                t0,
+                exc.status,
+                _error_body(exc.status, str(exc)),
+                retry_after_s=exc.retry_after_s,
+            )
         idem_key = inbound_idempotency_key(headers)
         if idem_key is not None and service.idempotency_seen(idem_key):
             # a router retry of a request this replica ALREADY answered
@@ -207,7 +223,12 @@ def _respond(service, body: bytes, headers, query: str, sp) -> Tuple[int, str, s
                 pending, timeout_s=service.config.request_timeout_s
             )
         except ServingError as exc:
-            return _finish(t0, exc.status, _error_body(exc.status, str(exc)))
+            return _finish(
+                t0,
+                exc.status,
+                _error_body(exc.status, str(exc)),
+                retry_after_s=exc.retry_after_s,
+            )
         except Exception as exc:  # scoring failure: typed 500, never a hang
             return _finish(t0, 500, _error_body(500, repr(exc)))
         # the flush folded these rows: remember the key BEFORE the response
@@ -242,6 +263,11 @@ def _respond(service, body: bytes, headers, query: str, sp) -> Tuple[int, str, s
             "flush_rows": pending.flush_rows,
             "flush_requests": pending.flush_requests,
         }
+        quality = service.quality
+        if quality is not None:
+            # quality loss is never silent (docs/autopilot.md): a flush
+            # scored on the sliced/q16 brownout path says so on the wire
+            doc["degraded"] = quality
         return _finish(t0, 200, json.dumps(doc) + "\n")
     except Exception as exc:  # encoder/accounting bug: still a typed 500
         return _finish(t0, 500, _error_body(500, repr(exc)))
@@ -251,12 +277,30 @@ def _error_body(status: int, message: str) -> str:
     return json.dumps({"error": message, "status": status}) + "\n"
 
 
+def retry_after_headers(
+    status: int, retry_after_s: Optional[float] = None
+) -> Dict[str, str]:
+    """The ``Retry-After`` header for a backpressure response: every
+    429/503 carries one (integer seconds, >= 1) so clients back off for a
+    server-grounded interval — the raiser's queue-drain estimate when it
+    provided one (``ServingError.retry_after_s``), else a 1 s floor.
+    Non-backpressure statuses get no header."""
+    if status not in (429, 503):
+        return {}
+    seconds = 1 if retry_after_s is None else max(1, math.ceil(retry_after_s))
+    return {"Retry-After": str(int(seconds))}
+
+
 def _finish(
-    t0: float, status: int, body: str, content_type: str = "application/json"
-) -> Tuple[int, str, str]:
+    t0: float,
+    status: int,
+    body: str,
+    content_type: str = "application/json",
+    retry_after_s: Optional[float] = None,
+) -> Tuple[int, str, str, Dict[str, str]]:
     _REQUEST_SECONDS.observe(time.perf_counter() - t0)
     _RESPONSES.inc(code=status)
-    return status, content_type, body
+    return status, content_type, body, retry_after_headers(status, retry_after_s)
 
 
 def handle_reload(service, body: bytes, headers, query: str = ""):

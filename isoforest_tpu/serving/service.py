@@ -36,7 +36,7 @@ import numpy as np
 from ..telemetry.events import record_event
 from ..telemetry.spans import set_span_attrs
 from ..utils.logging import logger
-from .coalescer import MicroBatchCoalescer
+from .coalescer import MicroBatchCoalescer, ServingError
 
 
 @dataclasses.dataclass
@@ -56,6 +56,20 @@ class ServingConfig:
     # retry replaying one of them re-scores WITHOUT re-folding the drift
     # monitor/reservoir (docs/replication.md)
     idempotency_capacity: int = 4096
+    # priority class for the autopilot's shed rung (docs/autopilot.md):
+    # under sustained overload, tenants with lower weight are refused
+    # (typed 429 + Retry-After) before higher-weight neighbors. The
+    # highest weight class attached to a controller is never shed.
+    weight: float = 1.0
+
+
+class ShedError(ServingError):
+    """Admission refused by the overload autopilot's shed rung: this
+    tenant's weight class is temporarily browned out so higher-priority
+    traffic keeps its SLO (HTTP 429 — retriable; ``Retry-After`` carries
+    the controller's recovery-window estimate, docs/autopilot.md)."""
+
+    status = 429
 
 
 class ScoringService:
@@ -107,6 +121,17 @@ class ScoringService:
         self._idempotency_seen: "collections.OrderedDict[str, None]" = (
             collections.OrderedDict()
         )
+        # autopilot brownout state (docs/autopilot.md). Reads/writes are
+        # single attribute assignments (GIL-atomic); the controller owns
+        # transitions, the request path only reads.
+        self._shed = False
+        self._shed_retry_after_s: Optional[float] = None
+        # (subsample_fraction or None, force_q16) when the quality rung is
+        # engaged; None = full-fidelity scoring
+        self._quality: Optional[Tuple[Optional[float], bool]] = None
+        # cache of the sliced brownout subforest keyed by the source
+        # forest's identity + fraction (rebuilt across hot-swaps)
+        self._subforest_cache: Optional[Tuple[int, int, object]] = None
         self.started_unix_s = time.time()
 
     # ------------------------------------------------------------------ #
@@ -115,6 +140,127 @@ class ScoringService:
     def model(self):
         """The CURRENT active model (post any hot-swap)."""
         return self.manager.model if self.manager is not None else self._bare_model
+
+    # ------------------------------------------------------------------ #
+    # autopilot brownout knobs (docs/autopilot.md)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def shed(self) -> bool:
+        return self._shed
+
+    def set_shed(
+        self, active: bool, retry_after_s: Optional[float] = None
+    ) -> None:
+        """Engage/lift the shed rung for this tenant. While active every
+        admission is refused with :class:`ShedError` (429) before touching
+        the queue; ``retry_after_s`` is the controller's estimate of when
+        the rung may lift (the response's ``Retry-After``)."""
+        self._shed_retry_after_s = retry_after_s if active else None
+        self._shed = bool(active)
+
+    def check_admission(self) -> None:
+        """Admission gate ahead of the coalescer: raises :class:`ShedError`
+        while this tenant's weight class is browned out. Called by every
+        request entry point (HTTP handler, fleet registry, :meth:`score`)."""
+        if self._shed:
+            exc = ShedError(
+                f"tenant {self.model_id or 'default'} "
+                f"(weight={self.config.weight:g}) is shed by the overload "
+                "autopilot; retry after the brownout lifts"
+            )
+            exc.retry_after_s = self._shed_retry_after_s
+            raise exc
+
+    @property
+    def quality(self) -> Optional[dict]:
+        """The active quality degradation, or None at full fidelity."""
+        q = self._quality
+        if q is None:
+            return None
+        return {"subsample_trees": q[0], "q16": q[1]}
+
+    def set_quality(
+        self,
+        subsample_trees: Optional[float] = None,
+        force_q16: bool = False,
+    ) -> None:
+        """Engage/lift the quality rung: score every subsequent flush on
+        the first ``subsample_trees`` fraction of the active forest and/or
+        the q16 quantized plane. ``set_quality()`` with no arguments
+        restores full fidelity. The degradation is never silent: responses
+        carry a ``degraded`` field and the flush span is annotated."""
+        if subsample_trees is not None:
+            f = float(subsample_trees)
+            if not 0.0 < f <= 1.0:
+                raise ValueError(
+                    f"subsample_trees must be in (0, 1], got {f:g}"
+                )
+            if f == 1.0:
+                subsample_trees = None
+            else:
+                subsample_trees = f
+        if subsample_trees is None and not force_q16:
+            self._quality = None
+            self._subforest_cache = None
+            return
+        self._quality = (subsample_trees, bool(force_q16))
+
+    def _degraded_forest(self, model, fraction: Optional[float]):
+        """The brownout subforest: the FIRST ``fraction`` of the trees
+        (FastForest, arxiv 2004.02423 — trees are i.i.d., so a prefix is
+        an unbiased subsample and ``score_matrix`` renormalizes the path
+        length to the surviving tree count automatically). Cached per
+        (source forest, tree count) so repeated flushes reuse one array
+        identity — the packed-layout cache stays warm across flushes."""
+        forest = model.forest
+        if fraction is None:
+            return forest
+        total = int(forest.feature.shape[0])
+        keep = max(1, int(total * fraction))
+        if keep >= total:
+            return forest
+        cache = self._subforest_cache
+        if cache is not None and cache[0] == id(forest) and cache[1] == keep:
+            return cache[2]
+        sub = type(forest)(*(leaf[:keep] for leaf in forest))
+        self._subforest_cache = (id(forest), keep, sub)
+        return sub
+
+    def _score_quality_degraded(self, X: np.ndarray) -> np.ndarray:
+        """One coalesced flush under the autopilot's quality rung: a
+        point-in-time reference of the active model scored through
+        :func:`~isoforest_tpu.ops.traversal.score_matrix` on the sliced
+        subforest and/or the q16 plane. Deliberately bypasses the manager
+        fold — degraded scores must not feed the drift baseline (they
+        would read as artificial drift) nor the retrain reservoir."""
+        from ..ops.traversal import score_matrix
+
+        fraction, force_q16 = self._quality or (None, False)
+        manager = self.manager
+        model = manager.model if manager is not None else self._bare_model
+        generation = manager.generation if manager is not None else 0
+        forest = self._degraded_forest(model, fraction)
+        kwargs = {}
+        if int(X.shape[0]) > self._max_warm_bucket:
+            kwargs = {"chunk_size": self._max_warm_bucket, "pipeline": True}
+        scores = score_matrix(
+            forest,
+            X,
+            model.num_samples,
+            strategy="q16" if force_q16 else "auto",
+            expected_features=int(model.total_num_features),
+            timeout_s=self.config.score_timeout_s,
+            **kwargs,
+        )
+        set_span_attrs(
+            model_id=self.model_id,
+            generation=generation,
+            degraded="quality",
+            subsample_trees=fraction if fraction is not None else 1.0,
+            q16=force_q16,
+        )
+        return np.asarray(scores)
 
     def _score_batch(self, X: np.ndarray) -> np.ndarray:
         """One coalesced flush: a single scoring call on one complete model
@@ -128,6 +274,8 @@ class ScoringService:
         is compiled on a live request, and the flusher returns to the
         queue sooner. Scores are bitwise identical; the 429/503 admission
         ladder is untouched (it runs at submit time, before scoring)."""
+        if self._quality is not None:
+            return self._score_quality_degraded(X)
         timeout_s = self.config.score_timeout_s
         kwargs = {}
         if int(X.shape[0]) > self._max_warm_bucket:
@@ -150,6 +298,7 @@ class ScoringService:
         """Blocking request-side score: enqueue, coalesce, demultiplex.
         Raises the :mod:`.coalescer` admission/timeout errors (the HTTP
         layer maps them to 429/503)."""
+        self.check_admission()
         pending = self.coalescer.submit(rows)
         return self.coalescer.result(
             pending, timeout_s=self.config.request_timeout_s
@@ -269,8 +418,10 @@ class ScoringService:
         ``/healthz`` alongside the lifecycle section."""
         doc = {
             "model_id": self.model_id,
-            "batch_rows": self.config.batch_rows,
-            "linger_ms": self.config.linger_ms,
+            # live coalescer policy, not the construction-time config —
+            # the autopilot's rung 1 reconfigures these on the fly
+            "batch_rows": self.coalescer.max_batch_rows,
+            "linger_ms": self.coalescer.max_linger_s * 1e3,
             "max_queue_rows": self.config.max_queue_rows,
             "queue_deadline_ms": self.config.queue_deadline_ms,
             "queue_rows": self.coalescer.pending_rows,
@@ -278,6 +429,9 @@ class ScoringService:
                 self.manager.generation if self.manager is not None else None
             ),
             "lifecycle": self.manager is not None,
+            "weight": self.config.weight,
+            "shed": self._shed,
+            "quality": self.quality,
         }
         return doc
 

@@ -1,0 +1,533 @@
+"""Overload autopilot: closed-loop SLO control (docs/autopilot.md).
+
+Acceptance matrix (the ISSUE's chaos proof, all threadless on FakeClock —
+zero real sleeps, every wait event-driven):
+
+  * sustained queue pressure walks the brownout ladder rung-by-rung —
+    ``autopilot_widen_batch`` then ``autopilot_shed_low_weight`` then
+    ``autopilot_quality_degrade`` — each engagement logged exactly once
+    (degradation report count == 1), event-sequenced (``autopilot.engage``
+    in rung order) and gauge-visible (``isoforest_autopilot_rung``);
+  * a pressure drop recovers rung-by-rung with hysteresis: each lift waits
+    its own ``recover_ticks`` debounce, the dead band between the
+    watermarks holds the rung with NO transitions (no oscillation), and
+    rung 0 restores the exact original coalescer policy;
+  * while a low-weight tenant is shed (typed 429 + ``Retry-After``), its
+    higher-weight neighbor stays all-200 over real ``handle_score`` calls
+    with BITWISE-identical scores;
+  * ``strict=True`` refuses every rung visibly (``autopilot.refused``
+    events, no degradation recorded, no knob touched);
+  * the coalescer's runtime ``reconfigure`` is safe mid-traffic: queued
+    requests are never lost, split or double-drained across a policy
+    change, and their demuxed scores stay bitwise.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from isoforest_tpu import IsolationForest, telemetry
+from isoforest_tpu.autopilot import (
+    RUNG_REASONS,
+    Autopilot,
+    AutopilotConfig,
+    current_rung,
+)
+from isoforest_tpu.autopilot import controller as _controller
+from isoforest_tpu.resilience import faults
+from isoforest_tpu.resilience.degradation import (
+    degradations,
+    reset_degradations,
+)
+from isoforest_tpu.serving import (
+    MicroBatchCoalescer,
+    ScoringService,
+    ServingConfig,
+    ShedError,
+    handle_score,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    reset_degradations()
+    yield
+    telemetry.reset()
+    reset_degradations()
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(512, 5)).astype(np.float32)
+    X[:40] += 4.0
+    return X
+
+
+@pytest.fixture(scope="module")
+def model(data):
+    return IsolationForest(
+        num_estimators=12, max_samples=64.0, random_seed=1
+    ).fit(data)
+
+
+def _service(model, fc, *, weight=1.0, model_id=None, **cfg):
+    """A threadless tenant on the FakeClock: pressure is whatever rows sit
+    unpumped in its queue."""
+    cfg.setdefault("batch_rows", 8)
+    cfg.setdefault("linger_ms", 10.0)
+    cfg.setdefault("max_queue_rows", 32)
+    return ScoringService(
+        model=model,
+        config=ServingConfig(weight=weight, **cfg),
+        clock=fc.now,
+        start=False,
+        model_id=model_id,
+    )
+
+
+def _pressurize(service, rows_pool, n_rows=24):
+    """Queue ``n_rows`` without pumping -> pressure n_rows/max_queue_rows."""
+    pendings = []
+    for i in range(0, n_rows, 8):
+        pendings.append(service.coalescer.submit(rows_pool[i : i + 8]))
+    return pendings
+
+
+def _drain(service, fc):
+    """Pump until the queue is empty (advancing past the linger deadline
+    for any undersized tail)."""
+    for _ in range(64):
+        if service.coalescer.pending_rows == 0:
+            return
+        if service.coalescer.pump() == 0:
+            fc.advance(service.coalescer.max_linger_s + 1e-3)
+    assert service.coalescer.pending_rows == 0, "queue failed to drain"
+
+
+def _event_kinds(prefix="autopilot."):
+    return [e.kind for e in telemetry.get_events() if e.kind.startswith(prefix)]
+
+
+def _autopilot_degradations():
+    return {
+        ev.reason: ev.count
+        for ev in degradations()
+        if ev.reason.startswith("autopilot_")
+    }
+
+
+class TestLadderDescent:
+    def test_sustained_pressure_walks_all_three_rungs(self, model, data):
+        """Overload -> rung-by-rung descent, each rung exactly-once logged,
+        event-sequenced and gauge-visible; the ladder never runs past its
+        last rung."""
+        fc = faults.FakeClock()
+        service = _service(model, fc)
+        ap = Autopilot(
+            services=[service],
+            config=AutopilotConfig(engage_ticks=2, recover_ticks=3),
+            clock=fc.now,
+        )
+        try:
+            _pressurize(service, data)  # 24/32 rows = 0.75 >= high_water
+            assert ap.pressure() == pytest.approx(0.75)
+
+            assert ap.tick() == 0, "one high tick is below the debounce"
+            assert ap.tick() == 1, "engage_ticks=2 -> rung 1 on tick 2"
+            # rung 1 actuator: the LIVE coalescer widened toward throughput
+            assert service.coalescer.max_batch_rows == 16
+            assert service.coalescer.max_linger_s == pytest.approx(0.040)
+            assert _controller._RUNG_GAUGE.value() == 1
+            assert current_rung() == 1
+
+            ap.tick()
+            assert ap.tick() == 2, "pressure persists -> rung 2"
+            # single attached service IS the top weight class: never shed
+            assert not service.shed
+
+            ap.tick()
+            assert ap.tick() == 3, "pressure persists -> rung 3"
+            assert service.quality == {"subsample_trees": 0.5, "q16": True}
+            assert _controller._RUNG_GAUGE.value() == 3
+
+            for _ in range(4):
+                assert ap.tick() == 3, "no rung 4 exists; the ladder holds"
+
+            # exactly-once: one degradation-report entry per rung, count 1
+            assert _autopilot_degradations() == {
+                "autopilot_widen_batch": 1,
+                "autopilot_shed_low_weight": 1,
+                "autopilot_quality_degrade": 1,
+            }
+            # event-sequenced: engage events in rung order, nothing else
+            engages = [
+                e for e in telemetry.get_events() if e.kind == "autopilot.engage"
+            ]
+            assert [e.fields["rung"] for e in engages] == [1, 2, 3]
+            assert [e.fields["reason"] for e in engages] == list(RUNG_REASONS)
+            assert ap.state()["rung_reason"] == "autopilot_quality_degrade"
+        finally:
+            ap.close()
+            service.close()
+        assert current_rung() is None, "close() detaches the process slot"
+
+    def test_dead_band_holds_rung_without_oscillation(self, model, data):
+        """Pressure between the watermarks argues NEITHER threshold: the
+        rung holds, both debounce counters stay reset, no events fire."""
+        fc = faults.FakeClock()
+        service = _service(model, fc)
+        ap = Autopilot(
+            services=[service],
+            config=AutopilotConfig(engage_ticks=1, recover_ticks=1),
+            clock=fc.now,
+        )
+        try:
+            _pressurize(service, data)
+            assert ap.tick() == 1
+            # drain one widened flush (two 8-row waiters ride it):
+            # 24 -> 8 rows = 0.25, inside the dead band
+            assert service.coalescer.pump() == 2
+            assert ap.pressure() == pytest.approx(0.25)
+            events_before = len(_event_kinds())
+            for _ in range(10):
+                assert ap.tick() == 1, "dead band must hold the rung"
+            state = ap.state()
+            assert state["high_ticks"] == 0 and state["low_ticks"] == 0
+            assert len(_event_kinds()) == events_before, (
+                "a dead-band tick must not emit transitions — even with "
+                "1-tick debounce on BOTH sides (the anti-oscillation proof)"
+            )
+        finally:
+            ap.close()
+            service.close()
+
+
+class TestRecovery:
+    def test_pressure_drop_recovers_rung_by_rung_with_hysteresis(
+        self, model, data
+    ):
+        """Full descent, then a drained queue: each lift pays its own
+        recover_ticks debounce, knobs restore in reverse order, and rung 0
+        is the exact original coalescer policy."""
+        fc = faults.FakeClock()
+        service = _service(model, fc)
+        ap = Autopilot(
+            services=[service],
+            config=AutopilotConfig(engage_ticks=1, recover_ticks=3),
+            clock=fc.now,
+        )
+        try:
+            _pressurize(service, data)
+            for want in (1, 2, 3):
+                assert ap.tick() == want
+            _drain(service, fc)
+            assert ap.pressure() == 0.0
+
+            # rung 3 -> 2: quality lifts first, only after 3 low ticks
+            assert ap.tick() == 3 and ap.tick() == 3
+            assert service.quality is not None, "hysteresis still holding"
+            assert ap.tick() == 2
+            assert service.quality is None, "recovery lifted quality first"
+            assert service.coalescer.max_batch_rows == 16, (
+                "one lift per debounce window: the widen rung is still held"
+            )
+
+            # rung 2 -> 1 (shed lifts; single service was never shed)
+            assert ap.tick() == 2 and ap.tick() == 2
+            assert ap.tick() == 1
+
+            # rung 1 -> 0: the original policy comes back exactly
+            assert ap.tick() == 1 and ap.tick() == 1
+            assert ap.tick() == 0
+            assert service.coalescer.max_batch_rows == 8
+            assert service.coalescer.max_linger_s == pytest.approx(0.010)
+            assert _controller._RUNG_GAUGE.value() == 0
+
+            recoveries = [
+                e
+                for e in telemetry.get_events()
+                if e.kind == "autopilot.recover"
+            ]
+            assert [
+                (e.fields["rung"], e.fields["to_rung"]) for e in recoveries
+            ] == [(3, 2), (2, 1), (1, 0)]
+            # fully recovered: scoring is bitwise the direct model again
+            p = service.coalescer.submit(data[:8])
+            assert service.coalescer.pump() == 1
+            np.testing.assert_array_equal(
+                service.coalescer.result(p, timeout_s=0), model.score(data[:8])
+            )
+        finally:
+            ap.close()
+            service.close()
+
+
+class TestShedNeighbors:
+    def test_shed_tenant_429_neighbor_bitwise_all_200(self, model, data):
+        """Rung 2 over two weight classes: the low-weight tenant gets typed
+        429s with Retry-After, its queued work still completes bitwise, and
+        the top-weight neighbor answers 200 with BITWISE scores through the
+        real HTTP handler for the whole brownout."""
+        fc = faults.FakeClock()
+        # gold serves live traffic (threaded, zero linger -> immediate
+        # flushes); bronze is the threadless pressure source on FakeClock
+        gold = ScoringService(
+            model=model,
+            config=ServingConfig(
+                batch_rows=64, linger_ms=0.0, request_timeout_s=60.0, weight=1.0
+            ),
+            model_id="gold",
+        )
+        bronze = _service(model, fc, weight=0.25, model_id="bronze")
+        config = AutopilotConfig(
+            engage_ticks=1, recover_ticks=1, tick_interval_s=0.5
+        )
+        ap = Autopilot(services=[gold, bronze], config=config, clock=fc.now)
+        try:
+            queued = _pressurize(bronze, data)
+            assert ap.tick() == 1
+            assert ap.tick() == 2
+            assert bronze.shed and not gold.shed, (
+                "only the sub-top weight class is shed"
+            )
+
+            # shed tenant: typed 429 before any queue work
+            with pytest.raises(ShedError) as exc:
+                bronze.check_admission()
+            assert exc.value.status == 429
+            assert exc.value.retry_after_s == pytest.approx(
+                max(config.recover_ticks * config.tick_interval_s, 1.0)
+            )
+            body = json.dumps(
+                {"rows": [[float(v) for v in r] for r in data[:2]]}
+            ).encode()
+            status, _, payload, resp_headers = handle_score(bronze, body, {})
+            assert status == 429
+            assert resp_headers["Retry-After"] == "1"
+            assert "shed" in json.loads(payload)["error"]
+
+            # the neighbor stays all-200 and bitwise through the brownout
+            direct = [float(s) for s in model.score(data[:16])]
+            for _ in range(3):
+                status, _, payload, _ = handle_score(
+                    gold,
+                    json.dumps(
+                        {"rows": [[float(v) for v in r] for r in data[:16]]}
+                    ).encode(),
+                    {},
+                )
+                assert status == 200
+                assert json.loads(payload)["scores"] == direct
+
+            # work bronze queued BEFORE the shed still completes bitwise —
+            # the rung refuses new admissions, it never drops accepted work
+            _drain(bronze, fc)
+            np.testing.assert_array_equal(
+                bronze.coalescer.result(queued[0], timeout_s=0),
+                model.score(data[:8]),
+            )
+
+            # recovery lifts the shed and the neighbor's widened policy
+            assert ap.tick() == 1
+            assert not bronze.shed
+            bronze.check_admission()  # admits again
+            assert ap.tick() == 0
+            assert gold.coalescer.max_batch_rows == 64
+        finally:
+            ap.close()
+            gold.close()
+            bronze.close()
+
+
+class TestStrictOptOut:
+    def test_strict_refuses_every_rung_visibly(self, model, data):
+        """strict=True turns the autopilot report-only: every engagement
+        attempt raises inside degrade() BEFORE recording, an
+        autopilot.refused event fires, and no knob moves."""
+        fc = faults.FakeClock()
+        service = _service(model, fc)
+        ap = Autopilot(
+            services=[service],
+            config=AutopilotConfig(engage_ticks=1, strict=True),
+            clock=fc.now,
+        )
+        try:
+            _pressurize(service, data)
+            for _ in range(3):
+                assert ap.tick() == 0, "strict holds rung 0 forever"
+            assert service.coalescer.max_batch_rows == 8, "no knob moved"
+            assert not service.shed and service.quality is None
+            refused = [
+                e
+                for e in telemetry.get_events()
+                if e.kind == "autopilot.refused"
+            ]
+            assert len(refused) == 3
+            assert {e.fields["reason"] for e in refused} == {
+                "autopilot_widen_batch"
+            }, "the ladder never advances past the refused rung"
+            assert _autopilot_degradations() == {}, (
+                "strict raises BEFORE the report records"
+            )
+        finally:
+            ap.close()
+            service.close()
+
+
+class TestRuntimeReconfigure:
+    """Satellite: the coalescer's reconfigure() mid-traffic — queued work
+    is never lost, split or double-drained across a policy change, and
+    demuxed scores stay bitwise (threadless pump on FakeClock)."""
+
+    @staticmethod
+    def _echo(X):
+        return np.asarray(X, np.float64).sum(axis=1)
+
+    def _coalescer(self, fc, **kw):
+        kw.setdefault("max_batch_rows", 8)
+        kw.setdefault("max_linger_s", 0.010)
+        kw.setdefault("max_queue_rows", 32)
+        kw.setdefault("queue_deadline_s", 10.0)
+        return MicroBatchCoalescer(
+            self._echo, clock=fc.now, start=False, **kw
+        )
+
+    def test_narrowing_batch_makes_waiting_work_due(self, data):
+        fc = faults.FakeClock()
+        c = self._coalescer(fc)
+        a = c.submit(data[:3])
+        b = c.submit(data[3:6])
+        assert c.pump() == 0, "6 rows < 8 and linger not reached"
+        previous = c.reconfigure(max_batch_rows=4)
+        assert previous == {"max_batch_rows": 8, "max_linger_s": 0.010}
+        # size trigger now due; whole-waiter rule flushes A alone (A+B
+        # would exceed the new batch) — B is NOT lost, it rides the next
+        assert c.pump() == 1
+        np.testing.assert_array_equal(
+            c.result(a, timeout_s=0), self._echo(data[:3])
+        )
+        fc.advance(0.010)
+        assert c.pump() == 1
+        np.testing.assert_array_equal(
+            c.result(b, timeout_s=0), self._echo(data[3:6])
+        )
+        assert b.flush_requests == 1 and c.pending_rows == 0
+        assert c.pump() == 0, "nothing left to double-drain"
+        c.close()
+
+    def test_shortened_linger_applies_to_queued_request(self, data):
+        fc = faults.FakeClock()
+        c = self._coalescer(fc)
+        p = c.submit(data[:3])
+        fc.advance(0.005)
+        assert c.pump() == 0, "5ms < the 10ms linger"
+        c.reconfigure(max_linger_s=0.004)
+        assert c.pump() == 1, "already-waited 5ms >= the NEW 4ms linger"
+        np.testing.assert_array_equal(
+            c.result(p, timeout_s=0), self._echo(data[:3])
+        )
+        c.close()
+
+    def test_widening_mid_traffic_holds_and_coalesces(self, data):
+        """The autopilot's actual rung-1 move: widen while requests are
+        queued — the old deadline no longer fires, later arrivals coalesce
+        into ONE flush, and every request demuxes bitwise."""
+        fc = faults.FakeClock()
+        c = self._coalescer(fc)
+        a = c.submit(data[:5])
+        c.reconfigure(max_batch_rows=16, max_linger_s=0.040)
+        fc.advance(0.012)
+        assert c.pump() == 0, "past the OLD 10ms linger, held by the new"
+        b = c.submit(data[5:8])
+        fc.advance(0.030)  # t=42ms: past the new linger for A
+        assert c.pump() == 2, "ONE flush serves both waiters"
+        np.testing.assert_array_equal(
+            c.result(a, timeout_s=0), self._echo(data[:5])
+        )
+        np.testing.assert_array_equal(
+            c.result(b, timeout_s=0), self._echo(data[5:8])
+        )
+        assert a.flush_requests == 2 and a.flush_rows == 8
+        assert a.flush_rows == b.flush_rows, "same flush, no split"
+        assert c.pump() == 0 and c.pending_rows == 0
+        c.close()
+
+    def test_reconfigure_validation_leaves_policy_intact(self, data):
+        fc = faults.FakeClock()
+        c = self._coalescer(fc)
+        with pytest.raises(ValueError):
+            c.reconfigure(max_batch_rows=0)
+        with pytest.raises(ValueError):
+            c.reconfigure(max_batch_rows=64)  # > max_queue_rows=32
+        with pytest.raises(ValueError):
+            c.reconfigure(max_linger_s=-0.001)
+        assert c.max_batch_rows == 8
+        assert c.max_linger_s == pytest.approx(0.010)
+        c.close()
+
+
+class TestConfigValidation:
+    def test_watermarks_and_ticks(self):
+        with pytest.raises(ValueError):
+            AutopilotConfig(high_water=0.2, low_water=0.5)
+        with pytest.raises(ValueError):
+            AutopilotConfig(engage_ticks=0)
+        with pytest.raises(ValueError):
+            AutopilotConfig(subsample_trees=0.0)
+        with pytest.raises(ValueError):
+            AutopilotConfig(widen_batch_factor=0.5)
+
+    def test_exactly_one_sensor_set(self, model):
+        with pytest.raises(ValueError):
+            Autopilot()
+        with pytest.raises(ValueError):
+            Autopilot(services=[], registry=object())
+
+
+class TestQualityRung:
+    def test_degraded_scores_reported_never_silent(self, model, data):
+        """Rung 3 end-to-end through the HTTP handler: the response says
+        'degraded' (subsample fraction + q16) while active, and full
+        fidelity returns bitwise after set_quality() lifts."""
+        from isoforest_tpu.ops.traversal import score_matrix
+
+        # threaded with zero linger: handle_score's wait is event-driven
+        service = ScoringService(
+            model=model,
+            config=ServingConfig(
+                batch_rows=16, linger_ms=0.0, request_timeout_s=60.0
+            ),
+        )
+        try:
+            service.set_quality(subsample_trees=0.5, force_q16=True)
+            body = json.dumps(
+                {"rows": [[float(v) for v in r] for r in data[:16]]}
+            ).encode()
+            status, _, payload, _ = handle_score(service, body, {})
+            assert status == 200
+            doc = json.loads(payload)
+            assert doc["degraded"] == {"subsample_trees": 0.5, "q16": True}
+            # the degraded path itself is deterministic: bitwise the direct
+            # score_matrix on the same 6-tree prefix (path-length
+            # normalisation rescales to the surviving trees automatically)
+            forest = model.forest
+            keep = forest.feature.shape[0] // 2
+            assert keep == 6, "12-tree fixture halves to a 6-tree prefix"
+            prefix = type(forest)(*(leaf[:keep] for leaf in forest))
+            direct = score_matrix(
+                prefix, data[:16], model.num_samples, strategy="q16"
+            )
+            assert doc["scores"] == [float(s) for s in direct]
+
+            service.set_quality()  # lift: full fidelity restores bitwise
+            assert service.quality is None
+            status, _, payload, _ = handle_score(service, body, {})
+            assert status == 200
+            doc = json.loads(payload)
+            assert "degraded" not in doc
+            assert doc["scores"] == [float(s) for s in model.score(data[:16])]
+        finally:
+            service.close()

@@ -241,6 +241,52 @@ class TestRoutedScoring:
 
 
 # --------------------------------------------------------------------------- #
+# backpressure pass-through: a 429 is an ANSWER, never a retry
+# --------------------------------------------------------------------------- #
+
+
+class TestBackpressurePassThrough:
+    def test_replica_429_passes_through_with_zero_retry_attempts(
+        self, tier, data
+    ):
+        """A replica's backpressure refusal is its authoritative answer:
+        the router must spend ZERO retry attempts on it (re-forwarding
+        refused load converts one replica's brownout into tier-wide
+        congestion), eject nothing, and forward the refusing machine's
+        ``Retry-After`` VERBATIM — the drain estimate belongs to the
+        machine that refused, not the router."""
+        # the autopilot's rung-2 actuator, applied on both replicas
+        for handle in tier.handles:
+            handle.registry.ensure_resident("alpha").service.set_shed(
+                True, retry_after_s=7.0
+            )
+        body = json.dumps({"rows": data[:2].tolist()}).encode()
+        requests_before = sum(r.requests for r in tier.replicas)
+        status, _, payload, headers = tier.router.handle_score_model(
+            "alpha", body, {}
+        )
+        assert status == 429
+        assert headers["Retry-After"] == "7", "the replica's estimate, verbatim"
+        assert "shed" in payload
+        # exactly ONE forward happened: no retry budget was minted for an
+        # answered request, nobody was ejected, no retry telemetry fired
+        assert sum(r.requests for r in tier.replicas) == requests_before + 1
+        assert all(r.admitted for r in tier.replicas)
+        assert not telemetry.get_events(kind="router.replica_retry")
+        assert _counter_value("isoforest_router_retries_total") == 0.0
+        assert _counter_value(
+            "isoforest_router_requests_total", code="429"
+        ) == 1.0
+
+        # the brownout lifts: the same tenant admits again through the
+        # same router with no residual admission state
+        for handle in tier.handles:
+            handle.registry.ensure_resident("alpha").service.set_shed(False)
+        status, _, _, _ = tier.router.handle_score_model("alpha", body, {})
+        assert status == 200
+
+
+# --------------------------------------------------------------------------- #
 # chaos: kill_replica_during_score
 # --------------------------------------------------------------------------- #
 

@@ -537,6 +537,9 @@ class TestStatusMapping:
 
             self.coalescer = _Coal()
 
+        def check_admission(self):
+            return None
+
         def predict(self, scores):  # pragma: no cover - submit raises
             return scores
 

@@ -11,6 +11,13 @@ Drives a running ``python -m isoforest_tpu serve`` deployment over HTTP
 * **open-loop** behaviour at a target arrival rate (``--rps``): achieved
   rate plus error/backpressure counts — the regime where admission control
   (429/503) matters, since arrivals do not slow down when the server does;
+* **overload** (``--target-rps``): drive PAST capacity against a
+  ``serve ... --autopilot`` deployment (docs/autopilot.md) and prove the
+  closed loop end-to-end — the brownout ladder must engage (max observed
+  ``isoforest_autopilot_rung`` >= 1, a nonzero ``autopilot.*`` event
+  trail), goodput and shed fraction are measured from the answered status
+  mix, and once the burst stops the controller must recover rung-by-rung
+  to 0 within ``--overload-recovery-timeout``;
 * **server-side** p50/p95/p99 from the deployment's OWN
   ``isoforest_serving_request_seconds`` histogram (fetched from
   ``/snapshot``, quantiles interpolated exactly as the server would) — not
@@ -188,6 +195,97 @@ def _open_loop(url, rows_pool, rps, duration, rows_per_request, max_inflight=64)
         "achieved_rps": round(stats["sent"] / elapsed, 1),
         "status": {str(k): v for k, v in sorted(stats["status"].items())},
         "dropped_inflight_cap": stats["dropped_inflight"],
+    }
+
+
+def _autopilot_status(url):
+    """(rung, pressure, autopilot-event-count) from the server's own
+    /snapshot — rung is the ``isoforest_autopilot_rung`` gauge (-1 when the
+    snapshot is unreadable or the gauge absent, i.e. no autopilot armed),
+    pressure the ``isoforest_autopilot_pressure`` gauge, and the count is
+    every ``autopilot.*`` event still in the bounded timeline."""
+    try:
+        with urllib.request.urlopen(url + "/snapshot", timeout=10) as resp:
+            doc = json.loads(resp.read())
+    except Exception:
+        return -1, None, 0
+    metrics = doc.get("metrics", {})
+
+    def gauge(name):
+        series = (metrics.get(name) or {}).get("series") or []
+        return float(series[0]["value"]) if series else None
+
+    rung = gauge("isoforest_autopilot_rung")
+    pressure = gauge("isoforest_autopilot_pressure")
+    events = sum(
+        1
+        for e in doc.get("events", [])
+        if str(e.get("kind", "")).startswith("autopilot.")
+    )
+    return (-1 if rung is None else int(rung)), pressure, events
+
+
+def _overload_phase(
+    url, rows_pool, target_rps, duration, rows_per_request, recovery_timeout_s
+):
+    """Drive an open-loop burst PAST capacity and watch the autopilot's
+    closed loop from the outside: a sampler thread polls the rung/pressure
+    gauges through the burst (max rung observed = how far down the ladder
+    the controller walked), goodput/shed are measured from the answered
+    status mix, and after the burst the phase waits for the controller to
+    recover — rung-by-rung, hysteresis-debounced — back to rung 0."""
+    rung0, _, _ = _autopilot_status(url)
+    peak = {"rung": max(rung0, 0), "pressure": 0.0}
+    stop = threading.Event()
+
+    def sample():
+        while not stop.wait(0.2):
+            rung, pressure, _ = _autopilot_status(url)
+            peak["rung"] = max(peak["rung"], rung)
+            if pressure is not None:
+                peak["pressure"] = max(peak["pressure"], pressure)
+
+    sampler = threading.Thread(target=sample, daemon=True)
+    sampler.start()
+    burst = _open_loop(
+        url, rows_pool, target_rps, duration, rows_per_request, max_inflight=128
+    )
+    stop.set()
+    sampler.join(timeout=5)
+
+    status = {int(k): v for k, v in burst["status"].items()}
+    answered = sum(v for k, v in status.items() if k > 0)
+    ok = status.get(200, 0)
+    shed = status.get(429, 0)
+    # the burst is over: pressure drains, so the controller must lift every
+    # rung it took — slower than descent (recover_ticks hysteresis), which
+    # is exactly why this poll loop has its own generous timeout
+    recovered = False
+    recovery_s = None
+    final_rung = -1
+    t_rec = time.perf_counter()
+    deadline = t_rec + recovery_timeout_s
+    while time.perf_counter() < deadline:
+        final_rung, _, _ = _autopilot_status(url)
+        if final_rung == 0:
+            recovered = True
+            recovery_s = round(time.perf_counter() - t_rec, 2)
+            break
+        time.sleep(0.25)
+    _, _, events = _autopilot_status(url)
+    return {
+        "target_rps": target_rps,
+        "duration_s": burst["duration_s"],
+        "sent": burst["sent"],
+        "status": burst["status"],
+        "goodput_rps": round(ok / max(burst["duration_s"], 1e-9), 1),
+        "shed_fraction": round(shed / max(answered, 1), 4),
+        "max_rung": peak["rung"],
+        "peak_pressure": round(peak["pressure"], 3),
+        "autopilot_events": events,
+        "recovered_to_rung0": recovered,
+        "recovery_s": recovery_s,
+        "final_rung": final_rung,
     }
 
 
@@ -432,6 +530,37 @@ def main() -> None:
         default=0.0,
         help="open-loop target arrival rate (0 = skip the open-loop phase)",
     )
+    ap.add_argument(
+        "--target-rps",
+        type=float,
+        default=0.0,
+        help="overload-phase arrival rate, set PAST the deployment's "
+        "capacity against a serve run armed with --autopilot "
+        "(docs/autopilot.md): proves ladder engagement, measures "
+        "goodput/shed fraction, and gates recovery to rung 0 "
+        "(0 = skip the overload phase)",
+    )
+    ap.add_argument(
+        "--overload-duration",
+        type=float,
+        default=8.0,
+        help="seconds to hold the --target-rps burst (long enough for the "
+        "controller's engage_ticks debounce to walk multiple rungs)",
+    )
+    ap.add_argument(
+        "--overload-recovery-timeout",
+        type=float,
+        default=30.0,
+        help="seconds to wait after the burst for the autopilot to recover "
+        "rung-by-rung to rung 0 (recovery is hysteresis-slowed by design)",
+    )
+    ap.add_argument(
+        "--overload-p99-ms",
+        type=float,
+        default=0.0,
+        help="fail the overload phase unless the server-side p99 stays "
+        "under this bound even through the burst (0 = report only)",
+    )
     ap.add_argument("--parity-rows", type=int, default=64)
     ap.add_argument(
         "--gate",
@@ -562,6 +691,35 @@ def main() -> None:
             if steady_delta != 0:
                 failed.append(f"steady_recompiles:{steady_delta}")
 
+    overload = None
+    if args.target_rps > 0 and not args.router:
+        # deliberately AFTER the steady-compile watermark: the quality rung
+        # (autopilot_quality_degrade) scores a subsample_trees prefix of the
+        # forest — a bucket shape the prewarm never compiled, so that one
+        # compile is the rung's documented cost, not a steady-state anomaly
+        overload = _overload_phase(
+            url,
+            rows_pool,
+            args.target_rps,
+            args.overload_duration,
+            args.rows_per_request,
+            args.overload_recovery_timeout,
+        )
+        if args.overload_p99_ms > 0:
+            after = _server_histogram_summary(url)
+            overload["p99_ms"] = after["p99_ms"] if after else None
+            if after and after["p99_ms"] > args.overload_p99_ms:
+                failed.append(
+                    f"overload_p99:{after['p99_ms']}>{args.overload_p99_ms}"
+                )
+        print(json.dumps({"phase": "overload", **overload}), flush=True)
+        if overload["max_rung"] < 1:
+            failed.append("overload_ladder_never_engaged")
+        if not overload["autopilot_events"]:
+            failed.append("overload_no_autopilot_events")
+        if not overload["recovered_to_rung0"]:
+            failed.append(f"overload_no_recovery:rung={overload['final_rung']}")
+
     ratio = (
         concurrent["rows_per_s"] / sequential["rows_per_s"]
         if sequential["rows_per_s"]
@@ -581,6 +739,9 @@ def main() -> None:
                 "serving_series_present": not missing_series,
                 "steady_compile_delta": steady_delta,
                 "steady_compiles_total": max(steady_after, 0),
+                "goodput_rps": overload["goodput_rps"] if overload else None,
+                "shed_fraction": overload["shed_fraction"] if overload else None,
+                "autopilot_max_rung": overload["max_rung"] if overload else None,
                 "failed": failed,
                 "pass": not failed,
             }
