@@ -26,6 +26,10 @@ operational surface here is a small CLI over CSV files:
     python -m isoforest_tpu manage /tmp/model --input live.csv \\
         [--work-dir /tmp/model.lifecycle] [--debounce 3] [--window-rows N] \\
         [--mode full|sliding] [--threshold 0.25] [--port 9101]
+    python -m isoforest_tpu stream /tmp/model --source live_shards/ \\
+        [--window-s 60 --slide-s 30 --lateness-s 5] [--follow] \\
+        [--reservoir decay --half-life-s 3600] [--retrain-every 1] \\
+        [--port 9101]  # rows are event_ts,f1,...,fn[,label]
     python -m isoforest_tpu autotune [--format json|table] [--clear] \\
         [--warm --input data.csv [--model /tmp/model] \\
          --batch-sizes 1024,65536 [--refresh]]
@@ -448,6 +452,8 @@ def cmd_manage(args) -> int:
         window_rows=args.window_rows,
         min_window_rows=args.min_window_rows,
         mode=args.mode,
+        reservoir=args.reservoir,
+        reservoir_half_life_s=args.half_life_s,
         checkpoint_every=args.checkpoint_every,
         background=False,  # retrains run inline: the CLI is deterministic
         monitor_kwargs={"min_rows": args.min_rows},
@@ -470,6 +476,138 @@ def cmd_manage(args) -> int:
         summary["last_validation"] = manager.last_validation.as_dict()
     manager.close()
     print(json.dumps(summary, indent=1, sort_keys=True))
+    return 0
+
+
+def cmd_stream(args) -> int:
+    """Online anomaly detection over an event-time stream
+    (docs/streaming.md): tail a shard directory / CSV file, listen on a TCP
+    line protocol, or read stdin; score every timestamped row with bounded
+    lag through the micro-batch coalescer; and run the window-cadenced
+    retrain/validate/swap loop as the steady state. Rows are
+    ``event_ts,f1,...,fn[,label]``. Prints the stream summary as JSON;
+    ``--port`` serves live /metrics + /traces/recent while streaming, and
+    ``--hold-seconds`` keeps that endpoint up after the source ends so a
+    harness can pull traces and the debug bundle before SIGTERM."""
+    import signal
+    import threading
+    import time as _time
+
+    from . import telemetry
+    from .lifecycle import ModelManager
+    from .stream import StreamConfig, StreamEngine, socket_source, tail_source
+
+    model = _load_model(args.model_dir)
+    if model.baseline is None:
+        print(
+            "error: this model directory has no _BASELINE.json sidecar "
+            "(legacy save, or fit with baseline capture disabled) — the "
+            "streaming lifecycle needs the drift baseline; refit and re-save",
+            file=sys.stderr,
+        )
+        return 2
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:  # pragma: no cover - non-main-thread embedding
+            pass
+    feed = None
+    if args.source == "-":
+        from .stream.sources import parse_lines
+
+        def _stdin_batches():
+            buf = []
+            for line in sys.stdin:
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    buf.append(line)
+                if len(buf) >= args.chunk_rows or stop.is_set():
+                    if buf:
+                        yield parse_lines(buf, args.labeled)
+                        buf = []
+                    if stop.is_set():
+                        return
+            if buf:
+                yield parse_lines(buf, args.labeled)
+
+        source = _stdin_batches()
+    elif args.source.startswith("tcp://"):
+        host, _, port_s = args.source[len("tcp://") :].partition(":")
+        feed = socket_source(
+            int(port_s or 0),
+            host or "127.0.0.1",
+            labeled=args.labeled,
+            chunk_rows=args.chunk_rows,
+            should_stop=stop.is_set,
+        )
+        source = feed.batches()
+    else:
+        source = tail_source(
+            args.source,
+            labeled=args.labeled,
+            follow=args.follow,
+            poll_s=args.poll_s,
+            chunk_rows=args.chunk_rows,
+            stop=stop.is_set,
+        )
+    manager = ModelManager(
+        model,
+        work_dir=args.work_dir or args.model_dir + ".stream",
+        monitor_threshold=args.threshold,
+        window_rows=args.window_rows,
+        min_window_rows=args.min_window_rows,
+        mode=args.mode,
+        reservoir=args.reservoir,
+        reservoir_half_life_s=args.half_life_s,
+        checkpoint_every=args.checkpoint_every,
+        auto_retrain=False,  # the window-close cadence drives retrains
+        background=False,  # inline: the CLI's swap count is deterministic
+        monitor_kwargs={"min_rows": args.min_rows},
+    )
+    engine = StreamEngine(
+        manager,
+        StreamConfig(
+            window_s=args.window_s,
+            slide_s=args.slide_s,
+            lateness_s=args.lateness_s,
+            retrain_every=args.retrain_every,
+            batch_rows=args.batch_rows,
+            linger_s=args.linger_ms / 1000.0,
+        ),
+    )
+    server = telemetry.serve(port=args.port) if args.port is not None else None
+    if server is not None:
+        print(
+            json.dumps(
+                {
+                    "stream": args.model_dir,
+                    "source": args.source,
+                    "url": f"http://127.0.0.1:{server.port}",
+                    **({"tcp_port": feed.port} if feed is not None else {}),
+                }
+            ),
+            flush=True,
+        )
+    try:
+        try:
+            summary = engine.run(source, max_rows=args.max_rows)
+        except KeyboardInterrupt:
+            summary = engine.finish()
+        summary["model"] = args.model_dir
+        summary["source"] = args.source
+        summary["drift"] = manager.monitor.report()
+        print(json.dumps(summary, indent=1, sort_keys=True), flush=True)
+        if server is not None and args.hold_seconds > 0:
+            deadline = _time.time() + args.hold_seconds
+            while _time.time() < deadline and not stop.is_set():
+                _time.sleep(0.1)
+    finally:
+        if feed is not None:
+            feed.stop()
+        if server is not None:
+            server.stop()
+        manager.close()
     return 0
 
 
@@ -955,6 +1093,20 @@ def build_parser() -> argparse.ArgumentParser:
         "trees, grow replacements on the window)",
     )
     man.add_argument(
+        "--reservoir",
+        choices=("fifo", "decay"),
+        default="fifo",
+        help="retrain-window policy: the last N rows, or the seeded "
+        "exponential-decay weighted sample (docs/streaming.md §4)",
+    )
+    man.add_argument(
+        "--half-life-s",
+        type=float,
+        default=3600.0,
+        help="decay reservoir half-life: every this many seconds of event "
+        "time halves an old row's retention odds",
+    )
+    man.add_argument(
         "--checkpoint-every",
         type=int,
         default=None,
@@ -969,6 +1121,99 @@ def build_parser() -> argparse.ArgumentParser:
         "while scoring (0 = ephemeral)",
     )
     man.set_defaults(func=cmd_manage)
+
+    stm = sub.add_parser(
+        "stream",
+        help="online anomaly detection over an event-time stream "
+        "(docs/streaming.md)",
+    )
+    stm.add_argument("model_dir")
+    stm.add_argument(
+        "--source",
+        required=True,
+        help="append-only stream: a shard dir/glob or CSV file to tail "
+        "(rows are event_ts,f1,...,fn[,label]), or tcp://HOST:PORT to "
+        "listen on the line protocol",
+    )
+    stm.add_argument("--labeled", action="store_true")
+    stm.add_argument(
+        "--follow",
+        action="store_true",
+        help="keep tailing a file source for appended rows / new shards "
+        "after the current end (default: stop at end of data)",
+    )
+    stm.add_argument(
+        "--window-s",
+        type=float,
+        default=60.0,
+        help="event-time window width",
+    )
+    stm.add_argument(
+        "--slide-s",
+        type=float,
+        default=None,
+        help="window slide (must divide --window-s; default = tumbling)",
+    )
+    stm.add_argument(
+        "--lateness-s",
+        type=float,
+        default=5.0,
+        help="allowed lateness: the watermark trails the max event time by "
+        "this much; rows behind it are scored but counted late",
+    )
+    stm.add_argument(
+        "--retrain-every",
+        type=int,
+        default=1,
+        help="retrain/validate/swap after every N non-empty window closes",
+    )
+    stm.add_argument(
+        "--mode",
+        choices=("full", "sliding"),
+        default="sliding",
+        help="refit flavour at each window-cadenced retrain (default "
+        "sliding: the streaming steady state)",
+    )
+    stm.add_argument(
+        "--reservoir",
+        choices=("fifo", "decay"),
+        default="decay",
+        help="retrain-window policy (default: event-time exponential decay)",
+    )
+    stm.add_argument(
+        "--half-life-s",
+        type=float,
+        default=3600.0,
+        help="decay reservoir half-life in event-time seconds",
+    )
+    stm.add_argument("--window-rows", type=int, default=65536)
+    stm.add_argument("--min-window-rows", type=int, default=1024)
+    stm.add_argument("--min-rows", type=int, default=512)
+    stm.add_argument("--threshold", type=float, default=None)
+    stm.add_argument("--checkpoint-every", type=int, default=None)
+    stm.add_argument("--work-dir", default=None, help="default: <model_dir>.stream")
+    stm.add_argument("--batch-rows", type=int, default=1024)
+    stm.add_argument("--linger-ms", type=float, default=2.0)
+    stm.add_argument("--chunk-rows", type=int, default=4096)
+    stm.add_argument("--poll-s", type=float, default=0.25)
+    stm.add_argument(
+        "--max-rows", type=int, default=None, help="stop after ~N ingested rows"
+    )
+    stm.add_argument(
+        "--port",
+        type=int,
+        default=None,
+        help="serve live /metrics + /traces/recent while streaming "
+        "(0 = ephemeral; prints a JSON ready line with the URL)",
+    )
+    stm.add_argument(
+        "--hold-seconds",
+        type=float,
+        default=0.0,
+        help="keep the telemetry endpoint up this long after the summary "
+        "line (until SIGTERM), so a harness can pull traces + debug bundle",
+    )
+    stm.set_defaults(func=cmd_stream)
 
     srv = sub.add_parser(
         "serve",

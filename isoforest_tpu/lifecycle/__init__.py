@@ -29,10 +29,11 @@ from .validation import (
     ValidationResult,
     validate_candidate,
 )
-from .window import DataReservoir
+from .window import DataReservoir, DecayReservoir
 
 __all__ = [
     "DataReservoir",
+    "DecayReservoir",
     "GateResult",
     "ModelManager",
     "OUTCOME_ERROR",

@@ -68,7 +68,7 @@ from ..telemetry.metrics import counter as _counter, gauge as _gauge
 from ..telemetry.spans import span as _span
 from ..utils.logging import logger
 from .validation import ValidationGates, ValidationResult, validate_candidate
-from .window import DataReservoir
+from .window import DataReservoir, DecayReservoir
 
 CURRENT_NAME = "CURRENT.json"
 
@@ -138,7 +138,11 @@ class ModelManager:
     :class:`ScoreMonitor`; ``drift_debounce`` is the consecutive
     over-threshold evaluations required to trigger; ``window_rows`` bounds
     the recent-data reservoir and ``min_window_rows`` refuses to retrain on
-    a sliver; ``mode`` picks the full refit or the sliding-window tree
+    a sliver; ``reservoir`` picks the window policy — ``"fifo"`` (the last
+    N rows) or ``"decay"`` (the seeded exponential-decay weighted sample of
+    :class:`~isoforest_tpu.lifecycle.window.DecayReservoir`, tuned by
+    ``reservoir_half_life_s``/``reservoir_seed`` — docs/streaming.md §4);
+    ``mode`` picks the full refit or the sliding-window tree
     refresh (``sliding_fraction`` of the oldest trees retired per swap);
     ``gates`` bounds validation; ``background=False`` runs the refit
     synchronously inside the triggering ``score`` call (the CLI and
@@ -167,6 +171,9 @@ class ModelManager:
         min_window_rows: int = 1024,
         mode: str = "full",
         sliding_fraction: float = 0.5,
+        reservoir: str = "fifo",
+        reservoir_half_life_s: float = 3600.0,
+        reservoir_seed: Optional[int] = None,
         checkpoint_every: Optional[int] = None,
         gates: Optional[ValidationGates] = None,
         auto_retrain: bool = True,
@@ -193,6 +200,10 @@ class ModelManager:
             raise ValueError(
                 f"sliding_fraction must be in (0, 1], got {sliding_fraction}"
             )
+        if reservoir not in ("fifo", "decay"):
+            raise ValueError(
+                f"reservoir must be 'fifo' or 'decay', got {reservoir!r}"
+            )
         # fleet tenant identity (docs/fleet.md): when set, every retrain.*
         # / lifecycle.resume event carries model_id=, state() reports it,
         # the attached monitor exports the per-tenant drift gauge, and the
@@ -211,7 +222,24 @@ class ModelManager:
         self.retry_policy = retry_policy or RetryPolicy(
             max_attempts=3, base_delay_s=0.5, max_delay_s=10.0
         )
-        self.reservoir = DataReservoir(window_rows)
+        self.reservoir_mode = reservoir
+        if reservoir == "decay":
+            # the refit window softly forgets by event time instead of
+            # cliff-evicting (docs/streaming.md §4); the seed defaults to
+            # the model's own so the weighted sample is as reproducible as
+            # the per-generation refit seeds
+            self.reservoir = DecayReservoir(
+                window_rows,
+                half_life_s=reservoir_half_life_s,
+                seed=(
+                    int(model.params.random_seed)
+                    if reservoir_seed is None
+                    else int(reservoir_seed)
+                ),
+                clock=clock,
+            )
+        else:
+            self.reservoir = DataReservoir(window_rows)
         self.generation = 1
         self.model_path: Optional[str] = None
         self.last_swap_unix_s: Optional[float] = None
@@ -341,6 +369,7 @@ class ModelManager:
         pipeline: Optional[bool] = None,
         return_generation: bool = False,
         fold: bool = True,
+        fold_reservoir: bool = True,
     ) -> np.ndarray:
         """Score a served batch through the active model (folding the drift
         monitor), remember the rows in the retrain reservoir (labels too,
@@ -359,7 +388,12 @@ class ModelManager:
         feeding the drift monitor, the reservoir or the retrain trigger —
         the idempotent-replay path of a replicated deployment
         (docs/replication.md): a retried request whose first attempt
-        already folded must not count its rows twice."""
+        already folded must not count its rows twice. ``fold_reservoir=False``
+        feeds the drift monitor but NOT the retrain reservoir — the
+        streaming engine's path (docs/streaming.md): it folds rows itself,
+        stamped with their event time, when their pane seals under the
+        watermark, so the decay reservoir weighs rows by when they
+        *happened* rather than when they were scored."""
         with self._lock:
             # one lock hold pins model AND its generation together, so the
             # lifecycle.score span's generation attr names exactly the
@@ -381,7 +415,8 @@ class ModelManager:
                 fold_monitor=fold,
             )
         if fold:
-            self.reservoir.fold(X, y)
+            if fold_reservoir:
+                self.reservoir.fold(X, y)
             self._maybe_trigger()
         if return_generation:
             return scores, generation
@@ -959,6 +994,7 @@ class ModelManager:
             "consecutive_over_threshold": consecutive,
             "window_rows": self.reservoir.rows,
             "window_capacity": self.reservoir.capacity,
+            "reservoir": self.reservoir_mode,
             "retrains": outcomes,
             "last_outcome": None if last is None else last.get("outcome"),
             "last_error": None if self.last_error is None else repr(self.last_error),

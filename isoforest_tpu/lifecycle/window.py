@@ -1,14 +1,23 @@
-"""Recent-data reservoir: the sliding window a drift-triggered refit trains on.
+"""Recent-data reservoirs: the window a retrain trains on.
 
 Large-scale isolation-tree deployments are sensitive to the sampling-window
 choice (arXiv 2004.04512 frames window selection as a first-order knob for
 nonstationary traffic): a refit on *all* history re-learns the drifted-away
-past, a refit on one batch overfits a burst. The reservoir keeps the most
-recent ``capacity`` served rows (and their labels, when the caller has
-them), in arrival order, so a retrain always sees "the last N rows of
-traffic" — a deterministic, reproducible window rather than a random sample,
-which is what keeps the lifecycle's bitwise refit-equivalence proof
-(tests/test_lifecycle.py) possible.
+past, a refit on one batch overfits a burst. Two policies live here:
+
+* :class:`DataReservoir` — a bounded FIFO of the most recent ``capacity``
+  served rows (and their labels, when the caller has them), in arrival
+  order: "the last N rows of traffic", a deterministic, reproducible window
+  rather than a random sample, which is what keeps the lifecycle's bitwise
+  refit-equivalence proof (tests/test_lifecycle.py) possible.
+* :class:`DecayReservoir` — an exponential-decay weighted sample over an
+  *event-time* stream (docs/streaming.md): each row's inclusion probability
+  is proportional to ``2^(t / half_life_s)``, so the window softly forgets
+  the past instead of cliff-evicting it, while old regimes still anchor the
+  sample until enough fresh traffic displaces them. Replacement is the
+  Gumbel-max trick over a seeded splitmix64 hash stream, so the kept set is
+  a pure function of ``(seed, fold order, event times)`` — as deterministic
+  as the FIFO, just weighted.
 
 Thread-safe: serving stacks fold from scorer worker pools while the
 retrain thread snapshots.
@@ -16,10 +25,31 @@ retrain thread snapshots.
 
 from __future__ import annotations
 
+import math
 import threading
-from typing import Optional, Tuple
+import time
+from typing import Callable, Optional, Tuple
 
 import numpy as np
+
+# splitmix64 stream constants (Steele et al. 2014) — the same generator
+# ops/bagging.py builds the streamed-bagging keys on, restated here so the
+# lifecycle package stays importable without pulling in jax.
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+_MIX_1 = np.uint64(0xBF58476D1CE4E5B9)
+_MIX_2 = np.uint64(0x94D049BB133111EB)
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer over uint64 arrays: a bijective avalanche mix,
+    independent of the numpy/jax RNG implementations."""
+    x = np.asarray(x, dtype=np.uint64).copy()
+    x ^= x >> np.uint64(30)
+    x *= _MIX_1
+    x ^= x >> np.uint64(27)
+    x *= _MIX_2
+    x ^= x >> np.uint64(31)
+    return x
 
 
 class DataReservoir:
@@ -89,4 +119,170 @@ class DataReservoir:
         with self._lock:
             self._X = None
             self._y = None
+            self._labeled = True
+
+
+class DecayReservoir:
+    """Exponential-decay weighted reservoir over an event-time stream.
+
+    Holds at most ``capacity`` rows; a row stamped at event time ``t`` is
+    kept with probability proportional to ``2^(t / half_life_s)`` — every
+    ``half_life_s`` of event time halves an old row's odds against a fresh
+    one, which is exactly the soft forgetting a sliding-window retrain
+    wants (docs/streaming.md §4).
+
+    Replacement is the Gumbel-max trick: row ``i`` (the ``i``-th row ever
+    offered, a global counter) draws ``u_i`` from the splitmix64 stream
+    ``mix64(seed + (i+1) * golden)`` and gets the priority key::
+
+        key_i = t_i * ln(2) / half_life_s + (-ln(-ln(u_i)))
+
+    Keeping the ``capacity`` largest keys selects row ``i`` with
+    probability proportional to ``w_i = 2^(t_i / half_life_s)`` (the
+    classic exponential-race/Gumbel argument), and because the key stream
+    depends only on ``(seed, offer index, event time)`` the kept set is a
+    pure function of the fold sequence — no hidden RNG state, so tests can
+    recompute every key and assert exact membership
+    (tests/test_stream.py).
+
+    ``fold(X, y=None, event_ts=None)`` accepts a scalar or per-row event
+    timestamp; ``None`` stamps the batch with ``clock()`` (injectable —
+    FakeClock drives the decay schedule deterministically in tests), which
+    also keeps the call signature a drop-in for :class:`DataReservoir`
+    inside ``ModelManager.score``. Label semantics match the FIFO: one
+    unlabeled batch drops the label track for good. ``snapshot`` returns
+    copies ordered by (event time, offer order) — oldest first, a
+    deterministic total order.
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        *,
+        half_life_s: float = 3600.0,
+        seed: int = 0,
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if not (half_life_s > 0) or not math.isfinite(half_life_s):
+            raise ValueError(f"half_life_s must be finite and > 0, got {half_life_s}")
+        self.capacity = int(capacity)
+        self.half_life_s = float(half_life_s)
+        self.seed = int(seed)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._labeled = True  # until proven otherwise
+        self._offered = 0  # rows ever offered: the hash-stream coordinate
+        self._X: Optional[np.ndarray] = None  # [K, F] kept rows
+        self._y: Optional[np.ndarray] = None  # [K] kept labels
+        self._ts = np.empty((0,), np.float64)  # [K] kept event times
+        self._key = np.empty((0,), np.float64)  # [K] kept priority keys
+        self._seq = np.empty((0,), np.int64)  # [K] kept offer indices
+
+    @property
+    def rows(self) -> int:
+        with self._lock:
+            return 0 if self._X is None else int(self._X.shape[0])
+
+    def keys_for(self, start: int, event_ts: np.ndarray) -> np.ndarray:
+        """The priority keys rows ``start .. start+len(event_ts)`` draw —
+        public so tests (and doc examples) can recompute the selection a
+        fold sequence must produce, independently of the fold path."""
+        seq = np.arange(start, start + len(event_ts), dtype=np.uint64)
+        h = _mix64(np.uint64(self.seed & 0xFFFFFFFFFFFFFFFF) + (seq + np.uint64(1)) * _GOLDEN)
+        # 53-bit mantissa uniform in (0, 1): never exactly 0 or 1, so the
+        # double log below is always finite
+        u = ((h >> np.uint64(11)).astype(np.float64) + 0.5) * 2.0**-53
+        gumbel = -np.log(-np.log(u))
+        return np.asarray(event_ts, np.float64) * (math.log(2.0) / self.half_life_s) + gumbel
+
+    def fold(
+        self,
+        X: np.ndarray,
+        y: Optional[np.ndarray] = None,
+        event_ts: Optional[np.ndarray] = None,
+    ) -> None:
+        X = np.asarray(X, np.float32)
+        if X.ndim != 2 or X.shape[0] == 0:
+            raise ValueError(f"reservoir batches must be non-empty [N, F]; got {X.shape}")
+        n = int(X.shape[0])
+        if y is not None:
+            y = np.asarray(y, np.float64).reshape(-1)
+            if y.shape[0] != n:
+                raise ValueError(
+                    f"labels must align with rows; got {y.shape[0]} labels for {n} rows"
+                )
+        if event_ts is None:
+            ts = np.full((n,), float(self._clock()), np.float64)
+        else:
+            ts = np.asarray(event_ts, np.float64).reshape(-1)
+            if ts.shape[0] == 1:
+                ts = np.full((n,), float(ts[0]), np.float64)
+            elif ts.shape[0] != n:
+                raise ValueError(
+                    f"event_ts must be scalar or per-row; got {ts.shape[0]} "
+                    f"timestamps for {n} rows"
+                )
+        with self._lock:
+            if self._X is not None and X.shape[1] != self._X.shape[1]:
+                raise ValueError(
+                    f"reservoir feature width is {self._X.shape[1]}; got a "
+                    f"batch of width {X.shape[1]}"
+                )
+            key = self.keys_for(self._offered, ts)
+            seq = np.arange(self._offered, self._offered + n, dtype=np.int64)
+            self._offered += n
+            if y is None:
+                self._labeled = False
+                self._y = None
+            if self._X is None:
+                all_X = X.copy()
+                all_y = y.copy() if (self._labeled and y is not None) else None
+                all_ts, all_key, all_seq = ts, key, seq
+            else:
+                all_X = np.concatenate([self._X, X])
+                if self._labeled and y is not None:
+                    base = self._y if self._y is not None else np.empty((0,), np.float64)
+                    all_y = np.concatenate([base, y])
+                else:
+                    all_y = None
+                all_ts = np.concatenate([self._ts, ts])
+                all_key = np.concatenate([self._key, key])
+                all_seq = np.concatenate([self._seq, seq])
+            if all_X.shape[0] > self.capacity:
+                # keep the top-capacity keys; lexsort's last key is primary,
+                # the offer index breaks (measure-zero) key ties newest-first
+                order = np.lexsort((-all_seq, -all_key))[: self.capacity]
+                all_X = all_X[order]
+                all_y = all_y[order] if all_y is not None else None
+                all_ts, all_key, all_seq = all_ts[order], all_key[order], all_seq[order]
+            self._X, self._y = all_X, all_y
+            self._ts, self._key, self._seq = all_ts, all_key, all_seq
+
+    def snapshot(self) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """``(X, y_or_None)`` — copies, ordered by (event time, offer
+        order), oldest row first: the same deterministic-window contract a
+        refit's bitwise reproducibility needs."""
+        with self._lock:
+            if self._X is None:
+                return np.empty((0, 0), np.float32), None
+            order = np.lexsort((self._seq, self._ts))
+            X = self._X[order].copy()
+            y = (
+                self._y[order].copy()
+                if (self._labeled and self._y is not None)
+                else None
+            )
+        return X, y
+
+    def clear(self) -> None:
+        """Drop the kept rows (the offer counter keeps advancing: the hash
+        stream never repeats a coordinate)."""
+        with self._lock:
+            self._X = None
+            self._y = None
+            self._ts = np.empty((0,), np.float64)
+            self._key = np.empty((0,), np.float64)
+            self._seq = np.empty((0,), np.int64)
             self._labeled = True

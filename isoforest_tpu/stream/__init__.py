@@ -1,0 +1,33 @@
+"""Streaming engine: online anomaly detection over event-time streams.
+
+The reference is a batch Spark estimator, but its anti-abuse use case is a
+stream: scores must stay fresh as traffic drifts. This package closes the
+loop the north-star names (ROADMAP item 6) — an unbounded, append-only
+source of timestamped rows flows through
+
+* :mod:`.sources` — tail a shard directory / CSV file, accept a TCP line
+  protocol, or wrap any in-process generator, all yielding
+  :class:`~isoforest_tpu.stream.sources.StreamBatch` (event times +
+  features + optional labels);
+* :mod:`.engine` — :class:`StreamEngine`: event-time tumbling/sliding
+  windows under a watermark with bounded allowed lateness, bounded-lag
+  scoring through the serving micro-batch coalescer, per-window folds into
+  the lifecycle manager's (decay) reservoir, and window-cadenced
+  retrain/validate/swap so sliding-mode refresh is the steady state, not a
+  drift-triggered exception.
+
+Windowing model, decay-reservoir math and the event/metric tables:
+``docs/streaming.md``. CLI: ``python -m isoforest_tpu stream``.
+"""
+
+from .engine import StreamConfig, StreamEngine
+from .sources import StreamBatch, generator_source, socket_source, tail_source
+
+__all__ = [
+    "StreamBatch",
+    "StreamConfig",
+    "StreamEngine",
+    "generator_source",
+    "socket_source",
+    "tail_source",
+]

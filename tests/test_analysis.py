@@ -667,7 +667,10 @@ class TestCleanRepo:
             for (a, b) in analyzer.edges()
         }
         assert ("MicroBatchCoalescer._cond", "_Metric._lock") in edges
+        # branch-typed attr: the reservoir is DataReservoir OR DecayReservoir,
+        # and the auditor must model BOTH lock edges
         assert ("ModelManager._lock", "DataReservoir._lock") in edges
+        assert ("ModelManager._lock", "DecayReservoir._lock") in edges
         assert lock_rules.check_lock_order(project) == []
 
     def test_known_invariant_tables_extracted(self):

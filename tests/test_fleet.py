@@ -523,10 +523,12 @@ class TestFaultSeams:
             assert not registry.entry("tenant-a").resident
             evicts = telemetry.get_events(kind="fleet.evict")
             assert evicts and evicts[-1].fields["cause"] == "fault_injected"
-            # next request re-loads and serves normally
+            # next request re-loads and serves normally — batch_rows rows so
+            # the size trigger flushes (the huge linger would otherwise make
+            # this waiter sit out the full linger)
             np.testing.assert_array_equal(
-                registry.score("tenant-a", data[:64]),
-                fleet_dirs["tenant-a"][1].score(data[:64]),
+                registry.score("tenant-a", data[:4096]),
+                fleet_dirs["tenant-a"][1].score(data[:4096]),
             )
             assert registry.entry("tenant-a").loads == 2
         finally:

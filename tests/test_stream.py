@@ -1,0 +1,769 @@
+"""Streaming engine proofs (docs/streaming.md).
+
+Event-time semantics on a FakeClock with ZERO real sleeps: watermark
+monotonicity and stall behavior (event-time-driven, never wall clock),
+out-of-order rows within the allowed lateness landing in their correct
+windows, late-beyond-watermark rows scored-counted-never-folded, empty
+windows, sliding panes folding exactly once, end-of-stream closing every
+window. The decay reservoir's Gumbel-max selection is pinned by exact
+membership recomputed through the public ``keys_for`` — determinism is
+structural, not statistical. The lifecycle loop is proven end to end
+(regime shift → window-cadenced retrain → validated swap) and under
+concurrency: scores issued while a hot-swap is stalled mid-flight must be
+bitwise the old or the new model's output, never a torn forest.
+"""
+
+import json
+import os
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from isoforest_tpu import IsolationForest, telemetry
+from isoforest_tpu.lifecycle import DataReservoir, DecayReservoir, ModelManager
+from isoforest_tpu.resilience import faults
+from isoforest_tpu.resilience.degradation import reset_degradations
+from isoforest_tpu.stream import (
+    StreamBatch,
+    StreamConfig,
+    StreamEngine,
+    generator_source,
+    socket_source,
+    tail_source,
+)
+from isoforest_tpu.stream.sources import parse_lines, split_timed
+
+N_TREES = 12
+FEATURES = 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    reset_degradations()
+    yield
+    telemetry.reset()
+    reset_degradations()
+
+
+@pytest.fixture(scope="module")
+def traffic():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(8000, FEATURES)).astype(np.float32)
+    X[:80] += 5.0
+    return X
+
+
+@pytest.fixture(scope="module")
+def incumbent(traffic):
+    return IsolationForest(
+        num_estimators=N_TREES, max_samples=64.0, random_seed=1
+    ).fit(traffic)
+
+
+def _mgr(model, tmp_path, fc, **kw):
+    kw.setdefault("window_rows", 4096)
+    kw.setdefault("min_window_rows", 1)
+    kw.setdefault("auto_retrain", False)
+    kw.setdefault("background", False)
+    return ModelManager(
+        model,
+        work_dir=str(tmp_path / "lc"),
+        clock=fc.now,
+        sleep=fc.sleep,
+        **kw,
+    )
+
+
+def _engine(mgr, fc, **cfg):
+    cfg.setdefault("window_s", 60.0)
+    cfg.setdefault("retrain_every", 10**6)  # windowing tests: no retrains
+    cfg.setdefault("linger_s", 0.0)
+    return StreamEngine(mgr, StreamConfig(threaded=False, **cfg), clock=fc.now)
+
+
+def _batch(ts, rng=None, value=None):
+    ts = np.asarray(ts, np.float64)
+    if value is not None:
+        X = np.full((len(ts), FEATURES), value, np.float32)
+    else:
+        X = (rng or np.random.default_rng(0)).normal(
+            size=(len(ts), FEATURES)
+        ).astype(np.float32)
+    return StreamBatch(ts, X, None)
+
+
+def _events(kind):
+    return [e.as_dict() for e in telemetry.get_events() if e.kind == kind]
+
+
+# --------------------------------------------------------------------------- #
+# decay reservoir: structural determinism
+# --------------------------------------------------------------------------- #
+
+
+class TestDecayReservoir:
+    def test_exact_membership_recomputed_from_public_keys(self):
+        """The kept set must be exactly the top-``capacity`` priority keys
+        — recomputed independently through ``keys_for``, not sampled."""
+        res = DecayReservoir(8, half_life_s=100.0, seed=42)
+        rng = np.random.default_rng(0)
+        ts_all = np.concatenate(
+            [np.sort(rng.uniform(i * 50, (i + 1) * 50, 10)) for i in range(3)]
+        )
+        for i in range(3):
+            ts = ts_all[i * 10 : (i + 1) * 10]
+            X = np.zeros((10, 2), np.float32)
+            X[:, 0] = np.arange(i * 10, (i + 1) * 10)  # row identity
+            res.fold(X, event_ts=ts)
+
+        keys = DecayReservoir(8, half_life_s=100.0, seed=42).keys_for(0, ts_all)
+        expected = set(np.argsort(-keys)[:8].tolist())
+        X_kept, _ = res.snapshot()
+        assert set(X_kept[:, 0].astype(int).tolist()) == expected
+
+    def test_deterministic_across_instances_and_seeds(self):
+        def build(seed):
+            r = DecayReservoir(16, half_life_s=50.0, seed=seed)
+            rng = np.random.default_rng(1)
+            for i in range(4):
+                X = rng.normal(size=(20, FEATURES)).astype(np.float32)
+                r.fold(X, event_ts=np.full(20, float(i * 100)))
+            return r.snapshot()[0]
+
+        a, b = build(7), build(7)
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(build(7), build(8))
+
+    def test_recency_bias(self):
+        """Rows 20 half-lives newer are ~2^20x likelier kept: old rows must
+        all but vanish from the sample."""
+        res = DecayReservoir(100, half_life_s=10.0, seed=0)
+        old = np.zeros((1000, 2), np.float32)
+        new = np.ones((1000, 2), np.float32)
+        res.fold(old, event_ts=np.full(1000, 0.0))
+        res.fold(new, event_ts=np.full(1000, 200.0))
+        X, _ = res.snapshot()
+        assert X.shape[0] == 100
+        assert (X[:, 0] == 1.0).sum() >= 95
+
+    def test_scalar_ts_broadcast_and_clock_default(self):
+        fc = faults.FakeClock()
+        res = DecayReservoir(10, half_life_s=10.0, seed=0, clock=fc.now)
+        res.fold(np.zeros((3, 2), np.float32), event_ts=[5.0])  # scalar
+        res.fold(np.ones((3, 2), np.float32))  # stamped with clock()
+        assert res.rows == 6
+        # determinism: an identical fold sequence with explicit stamps at
+        # the clock's value produces the identical kept set
+        res2 = DecayReservoir(10, half_life_s=10.0, seed=0)
+        res2.fold(np.zeros((3, 2), np.float32), event_ts=[5.0])
+        res2.fold(np.ones((3, 2), np.float32), event_ts=[fc.now()])
+        np.testing.assert_array_equal(res.snapshot()[0], res2.snapshot()[0])
+
+    def test_label_semantics_match_fifo(self):
+        res = DecayReservoir(50, half_life_s=10.0, seed=0)
+        X = np.zeros((20, 2), np.float32)
+        X[:, 0] = np.arange(20)
+        res.fold(X, y=np.arange(20.0), event_ts=np.full(20, 1.0))
+        Xs, ys = res.snapshot()
+        np.testing.assert_array_equal(Xs[:, 0], ys)  # labels ride their rows
+        res.fold(np.ones((5, 2), np.float32), event_ts=np.full(5, 2.0))
+        assert res.snapshot()[1] is None  # one unlabeled fold drops the track
+        res.fold(np.ones((5, 2), np.float32), y=np.ones(5), event_ts=[3.0])
+        assert res.snapshot()[1] is None  # and it stays dropped
+
+    def test_snapshot_ordered_oldest_first(self):
+        res = DecayReservoir(100, half_life_s=1000.0, seed=0)
+        res.fold(np.full((5, 1), 2.0, np.float32), event_ts=np.full(5, 20.0))
+        res.fold(np.full((5, 1), 1.0, np.float32), event_ts=np.full(5, 10.0))
+        X, _ = res.snapshot()
+        np.testing.assert_array_equal(X[:, 0], [1] * 5 + [2] * 5)
+
+    def test_capacity_and_clear_advance_hash_stream(self):
+        res = DecayReservoir(5, half_life_s=10.0, seed=0)
+        res.fold(np.arange(20, dtype=np.float32).reshape(10, 2), event_ts=[1.0])
+        assert res.rows == 5
+        res.clear()
+        assert res.rows == 0
+        # the offer counter keeps advancing: same rows re-folded draw keys
+        # from a later hash-stream coordinate
+        k_first = res.keys_for(0, np.full(10, 1.0))
+        k_next = res.keys_for(10, np.full(10, 1.0))
+        assert not np.array_equal(k_first, k_next)
+
+    def test_validation_errors(self):
+        with pytest.raises(ValueError, match="capacity"):
+            DecayReservoir(0)
+        with pytest.raises(ValueError, match="half_life_s"):
+            DecayReservoir(4, half_life_s=0.0)
+        res = DecayReservoir(4)
+        with pytest.raises(ValueError, match="non-empty"):
+            res.fold(np.empty((0, 2), np.float32))
+        with pytest.raises(ValueError, match="labels"):
+            res.fold(np.zeros((3, 2), np.float32), y=np.zeros(2))
+        with pytest.raises(ValueError, match="event_ts"):
+            res.fold(np.zeros((3, 2), np.float32), event_ts=[1.0, 2.0])
+        res.fold(np.zeros((3, 2), np.float32), event_ts=[1.0])
+        with pytest.raises(ValueError, match="width"):
+            res.fold(np.zeros((3, 5), np.float32), event_ts=[1.0])
+
+    def test_manager_selects_policy(self, incumbent, tmp_path):
+        fc = faults.FakeClock()
+        mgr = _mgr(incumbent, tmp_path, fc, reservoir="decay")
+        try:
+            assert isinstance(mgr.reservoir, DecayReservoir)
+            assert mgr.reservoir_mode == "decay"
+            assert mgr.reservoir.seed == incumbent.params.random_seed
+            assert mgr.state()["reservoir"] == "decay"
+        finally:
+            mgr.close()
+        mgr = _mgr(incumbent, tmp_path / "b", fc, reservoir="fifo")
+        try:
+            assert isinstance(mgr.reservoir, DataReservoir)
+        finally:
+            mgr.close()
+        with pytest.raises(ValueError, match="reservoir"):
+            _mgr(incumbent, tmp_path / "c", fc, reservoir="lru")
+
+
+# --------------------------------------------------------------------------- #
+# event-time windowing (FakeClock, threadless, zero sleeps)
+# --------------------------------------------------------------------------- #
+
+
+class TestWindowing:
+    def test_tumbling_close(self, incumbent, tmp_path):
+        fc = faults.FakeClock()
+        mgr = _mgr(incumbent, tmp_path, fc)
+        eng = _engine(mgr, fc, lateness_s=0.0)
+        try:
+            eng.process(_batch(np.arange(0.0, 60.0, 2.0)))  # 30 rows
+            assert eng.windows_closed == 0  # watermark at 58: window open
+            eng.process(_batch([61.0]))
+            assert eng.windows_closed == 1
+            (ev,) = _events("stream.window_closed")
+            assert ev["start"] == 0.0 and ev["end"] == 60.0
+            assert ev["rows"] == 30
+            assert mgr.reservoir.rows == 30  # pane folded exactly once
+            (fold,) = _events("stream.fold")
+            assert fold["rows"] == 30 and fold["pane_end"] == 60.0
+        finally:
+            eng.close()
+            mgr.close()
+
+    def test_out_of_order_within_lateness_lands_in_window(self, incumbent, tmp_path):
+        fc = faults.FakeClock()
+        mgr = _mgr(incumbent, tmp_path, fc)
+        eng = _engine(mgr, fc, lateness_s=15.0)
+        try:
+            eng.process(_batch([5.0, 15.0, 25.0, 35.0, 45.0, 55.0]))
+            eng.process(_batch([70.0]))  # watermark -> 55: window 0 still open
+            assert eng.watermark == 55.0
+            assert eng.windows_closed == 0
+            eng.process(_batch([58.0]))  # out of order but >= watermark
+            assert eng.late_rows == 0
+            eng.process(_batch([80.0]))  # watermark -> 65: closes [0, 60)
+            assert eng.windows_closed == 1
+            (ev,) = _events("stream.window_closed")
+            assert ev["rows"] == 7  # the out-of-order row counted in-window
+        finally:
+            eng.close()
+            mgr.close()
+
+    def test_late_rows_scored_counted_never_folded(self, incumbent, tmp_path):
+        fc = faults.FakeClock()
+        mgr = _mgr(incumbent, tmp_path, fc)
+        eng = _engine(mgr, fc, lateness_s=0.0)
+        try:
+            eng.process(_batch([10.0, 20.0, 30.0]))
+            eng.process(_batch([100.0]))  # watermark 100: closes [0, 60)
+            folded = mgr.reservoir.rows
+            eng.process(_batch([50.0]))  # behind the watermark
+            assert eng.rows == 5  # late rows ARE scored and counted
+            assert eng.late_rows == 1
+            assert mgr.reservoir.rows == folded  # never folded
+            (late,) = _events("stream.late")
+            assert late["rows"] == 1
+            assert late["watermark"] == 100.0
+            assert late["min_ts"] == 50.0 and late["max_ts"] == 50.0
+        finally:
+            eng.close()
+            mgr.close()
+
+    def test_empty_windows_close_and_count(self, incumbent, tmp_path):
+        fc = faults.FakeClock()
+        mgr = _mgr(incumbent, tmp_path, fc)
+        eng = _engine(mgr, fc, lateness_s=0.0)
+        try:
+            eng.process(_batch([30.0]))
+            eng.process(_batch([250.0]))  # a 3-window event-time gap
+            assert eng.windows_closed == 4
+            assert eng.empty_windows == 3
+            evs = _events("stream.window_closed")
+            assert [e["rows"] for e in evs] == [1, 0, 0, 0]
+            assert evs[1]["mean_score"] is None
+        finally:
+            eng.close()
+            mgr.close()
+
+    def test_sliding_panes_fold_once(self, incumbent, tmp_path):
+        fc = faults.FakeClock()
+        mgr = _mgr(incumbent, tmp_path, fc)
+        eng = _engine(mgr, fc, window_s=60.0, slide_s=30.0, lateness_s=0.0)
+        try:
+            eng.process(_batch([5.0] * 4))  # pane 0
+            eng.process(_batch([35.0] * 6))  # pane 1
+            eng.process(_batch([65.0] * 8))  # pane 2
+            summary = eng.finish()
+            # every pane folds exactly once even though two windows share it
+            assert len(_events("stream.fold")) == 3
+            assert summary["folded_rows"] == 18
+            assert mgr.reservoir.rows == 18
+            evs = _events("stream.window_closed")
+            assert [e["rows"] for e in evs] == [4, 10, 14, 8]
+            assert [e["end"] for e in evs] == [30.0, 60.0, 90.0, 120.0]
+        finally:
+            mgr.close()
+
+    def test_stalled_clock_watermark_frozen(self, incumbent, tmp_path):
+        """The watermark is event time: wall-clock passage must not advance
+        it (or close windows), only make the freshness gauge grow."""
+        fc = faults.FakeClock()
+        mgr = _mgr(incumbent, tmp_path, fc)
+        eng = _engine(mgr, fc, lateness_s=0.0)
+        try:
+            eng.process(_batch([10.0, 50.0, 70.0]))  # closes [0, 60)
+            assert eng.windows_closed == 1
+            w = eng.watermark
+            fresh0 = eng.freshness_seconds()
+            fc.advance(10_000.0)  # the stream stalls; wall time marches on
+            assert eng.drain() == 0
+            assert eng.watermark == w
+            assert eng.windows_closed == 1
+            assert eng.freshness_seconds() == pytest.approx(fresh0 + 10_000.0)
+        finally:
+            eng.close()
+            mgr.close()
+
+    def test_watermark_monotone(self, incumbent, tmp_path):
+        fc = faults.FakeClock()
+        mgr = _mgr(incumbent, tmp_path, fc)
+        eng = _engine(mgr, fc, lateness_s=30.0)
+        try:
+            eng.process(_batch([100.0]))
+            assert eng.watermark == 70.0
+            eng.process(_batch([80.0]))  # older but on-time
+            assert eng.watermark == 70.0  # never regresses
+        finally:
+            eng.close()
+            mgr.close()
+
+    def test_finish_closes_everything_and_is_idempotent(self, incumbent, tmp_path):
+        fc = faults.FakeClock()
+        mgr = _mgr(incumbent, tmp_path, fc)
+        eng = _engine(mgr, fc, lateness_s=120.0)
+        try:
+            eng.process(_batch(np.arange(0.0, 90.0, 10.0)))
+            assert eng.windows_closed == 0  # lateness holds everything open
+            summary = eng.finish()
+            assert summary["windows_closed"] == 2  # [0,60) and [60,120)
+            assert summary["folded_rows"] == 9
+            assert summary["watermark"] == 80.0 - 120.0  # restored, not +inf
+            (stop,) = _events("stream.stop")
+            assert stop["windows_closed"] == 2
+            assert eng.finish() == summary  # idempotent
+            with pytest.raises(RuntimeError, match="finish"):
+                eng.process(_batch([1.0]))
+        finally:
+            mgr.close()
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="window_s"):
+            StreamConfig(window_s=0.0)
+        with pytest.raises(ValueError, match="slide_s"):
+            StreamConfig(window_s=60.0, slide_s=70.0)
+        with pytest.raises(ValueError, match="whole multiple"):
+            StreamConfig(window_s=60.0, slide_s=45.0)
+        with pytest.raises(ValueError, match="lateness_s"):
+            StreamConfig(lateness_s=-1.0)
+        with pytest.raises(ValueError, match="retrain_every"):
+            StreamConfig(retrain_every=0)
+        assert StreamConfig(window_s=60.0).slide_s == 60.0  # tumbling default
+        assert StreamConfig(window_s=60.0, slide_s=20.0).panes_per_window == 3
+
+    def test_mismatched_batch_rejected(self, incumbent, tmp_path):
+        fc = faults.FakeClock()
+        mgr = _mgr(incumbent, tmp_path, fc)
+        eng = _engine(mgr, fc)
+        try:
+            with pytest.raises(ValueError, match="timestamps"):
+                eng.process(
+                    StreamBatch(
+                        np.zeros(2), np.zeros((3, FEATURES), np.float32), None
+                    )
+                )
+        finally:
+            eng.close()
+            mgr.close()
+
+
+# --------------------------------------------------------------------------- #
+# the steady-state lifecycle loop
+# --------------------------------------------------------------------------- #
+
+
+class TestLifecycleLoop:
+    def test_min_window_rows_defers_retrain_without_losing_cadence(
+        self, incumbent, traffic, tmp_path
+    ):
+        fc = faults.FakeClock()
+        mgr = _mgr(incumbent, tmp_path, fc, min_window_rows=250, reservoir="decay")
+        eng = _engine(mgr, fc, retrain_every=1, lateness_s=0.0)
+        try:
+            # 100 rows/window: the first two closes are below the floor
+            for k in range(3):
+                ts = k * 60.0 + np.linspace(0.0, 59.0, 100)
+                eng.process(StreamBatch(ts, traffic[k * 100 : (k + 1) * 100], None))
+            eng.process(_batch([200.0]))  # close the third window
+            assert eng.windows_closed == 3
+            # deferred at 100 and 200 rows; fired at the 300-row close
+            assert len(_events("stream.retrain")) == 1
+            assert mgr.generation == 2
+        finally:
+            eng.close()
+            mgr.close()
+
+    def test_regime_shift_drives_unattended_swaps(self, incumbent, traffic, tmp_path):
+        """End to end on a generator source: base regime then a shifted one;
+        the window cadence must retrain/validate/swap with nobody driving."""
+        fc = faults.FakeClock()
+        mgr = _mgr(
+            incumbent,
+            tmp_path,
+            fc,
+            min_window_rows=256,
+            window_rows=2048,
+            mode="sliding",
+            reservoir="decay",
+        )
+        eng = _engine(mgr, fc, retrain_every=2, lateness_s=5.0)
+        try:
+            shift = 3.0 * np.std(traffic, axis=0, keepdims=True)
+
+            def batches():
+                for k in range(6):
+                    X = traffic[k * 600 : (k + 1) * 600].copy()
+                    if k >= 3:
+                        X += shift  # the regime shift
+                    ts = k * 60.0 + np.linspace(0.0, 59.9, 600)
+                    yield StreamBatch(ts, X, None)
+
+            summary = eng.run(generator_source(batches()))
+            assert summary["windows_closed"] == 6
+            assert summary["late_rows"] == 0
+            assert summary["folded_rows"] == 3600
+            assert summary["swaps"] >= 2
+            assert summary["generation"] == summary["swaps"] + 1
+            assert summary["retrain_outcomes"] == {"swapped": summary["swaps"]}
+            assert summary["reservoir"] == "decay"
+            swaps = _events("stream.swap")
+            assert len(swaps) == summary["swaps"]
+            assert all(os.path.isdir(s["path"]) for s in swaps)
+            # at least one swap answered the shift itself
+            assert any(s["window_end"] > 180.0 for s in swaps)
+            retrains = _events("stream.retrain")
+            assert [r["outcome"] for r in retrains] == ["swapped"] * len(retrains)
+        finally:
+            mgr.close()
+
+    def test_swap_stalled_mid_flight_scores_bitwise_old_or_new(
+        self, incumbent, traffic, tmp_path
+    ):
+        """The torn-swap proof through the streaming path: batches keep
+        flowing through the engine's coalescer while a window-cadenced swap
+        is stalled between its durable save and the in-memory flip; every
+        score computed must be bitwise the OLD or the NEW model's output.
+        Event-gated — zero real sleeps."""
+        probe = np.ascontiguousarray(traffic[:256])
+        old_scores = np.asarray(incumbent.score(probe))
+        swap_entered = threading.Event()
+        swap_release = threading.Event()
+
+        def slow_swap():
+            swap_entered.set()
+            assert swap_release.wait(timeout=300)
+
+        recorded = []
+
+        class RecordingManager(ModelManager):
+            def score(self, X, **kw):
+                s = super().score(X, **kw)
+                recorded.append(np.asarray(s).copy())
+                return s
+
+        mgr = RecordingManager(
+            incumbent,
+            work_dir=str(tmp_path / "lc"),
+            window_rows=2048,
+            min_window_rows=256,
+            auto_retrain=False,
+            background=True,  # the swap stalls in ITS thread, not ours
+            hooks={"mid_swap": slow_swap},
+            reservoir="decay",
+        )
+        eng = StreamEngine(
+            mgr,
+            StreamConfig(
+                window_s=60.0,
+                lateness_s=0.0,
+                retrain_every=1,
+                threaded=False,
+                linger_s=0.0,
+                batch_rows=256,
+                wait_retrain=False,  # fire-and-continue: scoring flows on
+            ),
+        )
+        try:
+            for k in range(2):  # fills [0, 60) and closes it -> retrain starts
+                eng.process(StreamBatch(np.full(256, k * 60.0), probe, None))
+            assert swap_entered.wait(timeout=300)
+            before_release = len(recorded)
+            for k in range(2, 5):  # scored while the swap is stalled
+                eng.process(StreamBatch(np.full(256, k * 60.0), probe, None))
+            eng.drain()
+            assert len(recorded) > before_release
+            swap_release.set()
+            assert mgr.wait_retrain(timeout_s=300)
+            eng.finish()
+            assert mgr.generation == 2
+            new_scores = np.asarray(mgr.model.score(probe))
+            assert not np.array_equal(old_scores, new_scores)
+            torn = [
+                s
+                for s in recorded
+                if not (
+                    np.array_equal(s, old_scores) or np.array_equal(s, new_scores)
+                )
+            ]
+            assert not torn, f"{len(torn)} batch(es) saw a torn forest"
+        finally:
+            swap_release.set()
+            mgr.close()
+
+
+# --------------------------------------------------------------------------- #
+# sources
+# --------------------------------------------------------------------------- #
+
+
+class TestSources:
+    def test_split_timed_and_parse_lines(self):
+        b = split_timed(np.array([[1.5, 2.0, 3.0], [2.5, 4.0, 5.0]]), False)
+        np.testing.assert_array_equal(b.ts, [1.5, 2.5])
+        assert b.X.dtype == np.float32 and b.y is None
+        b = parse_lines(["1.5,2,3,1", "2.5,4,5,0"], True)
+        np.testing.assert_array_equal(b.y, [1.0, 0.0])
+        assert b.X.shape == (2, 2)
+        assert b.ts.dtype == np.float64  # unix stamps survive
+        with pytest.raises(ValueError, match="columns"):
+            split_timed(np.array([[1.0, 2.0]]), True)
+
+    def test_generator_source_adapts_shapes(self):
+        sb = StreamBatch(np.r_[1.0], np.zeros((1, 2), np.float32), None)
+        items = [
+            sb,
+            (np.r_[2.0], np.ones((1, 2))),
+            (np.r_[3.0], np.ones((1, 2)), np.r_[1.0]),
+            np.array([[4.0, 5.0, 6.0]]),  # raw timed matrix
+        ]
+        out = list(generator_source(items))
+        assert [float(b.ts[0]) for b in out] == [1.0, 2.0, 3.0, 4.0]
+        assert out[0] is sb
+        assert out[2].y is not None and out[1].y is None
+
+    def test_tail_csv_follow_partial_lines_injected_sleep(self, tmp_path):
+        """tail -f semantics with ZERO real sleeps: the poll sleep is the
+        injection point that appends data (completing a previously partial
+        line) and then stops the tail."""
+        path = tmp_path / "s.csv"
+        path.write_text("1,1.0\n2,2.0\n# comment\n3,3.")  # partial last line
+        stopped = []
+
+        def fake_sleep(_):
+            if not stopped:
+                with open(path, "a") as fh:
+                    fh.write("5\n4,4.0\n")
+                stopped.append(True)
+
+        batches = list(
+            tail_source(
+                str(path),
+                follow=True,
+                chunk_rows=2,
+                sleep=fake_sleep,
+                stop=lambda: len(stopped) > 0,
+            )
+        )
+        ts = np.concatenate([b.ts for b in batches])
+        np.testing.assert_array_equal(ts, [1.0, 2.0, 3.0, 4.0])
+        X = np.concatenate([b.X for b in batches])
+        np.testing.assert_allclose(X[:, 0], [1.0, 2.0, 3.5, 4.0])
+
+    def test_tail_csv_non_follow_flushes_trailing_fragment(self, tmp_path):
+        path = tmp_path / "s.csv"
+        path.write_text("1,1.0\n2,2.0")  # no trailing newline
+        batches = list(tail_source(str(path), chunk_rows=100))
+        ts = np.concatenate([b.ts for b in batches])
+        np.testing.assert_array_equal(ts, [1.0, 2.0])
+
+    def test_shard_dir_sorted_then_new_shards(self, tmp_path):
+        d = tmp_path / "shards"
+        d.mkdir()
+        (d / "b.csv").write_text("2,2.0\n")
+        (d / "a.csv").write_text("1,1.0\n")
+        np.save(d / "c.npy", np.array([[3.0, 3.0]]))
+        polls = []
+
+        def fake_sleep(_):
+            if not polls:
+                (d / "d.csv").write_text("4,4.0\n")
+            polls.append(True)
+
+        batches = list(
+            tail_source(
+                str(d),
+                follow=True,
+                chunk_rows=10,
+                sleep=fake_sleep,
+                stop=lambda: len(polls) > 1,
+            )
+        )
+        ts = np.concatenate([b.ts for b in batches])
+        np.testing.assert_array_equal(ts, [1.0, 2.0, 3.0, 4.0])
+
+    def test_missing_source_raises_without_follow(self, tmp_path):
+        """A one-shot replay of a nonexistent path must fail loudly, not
+        stream zero rows and exit clean (only a follow tail may start
+        before its first shard exists)."""
+        with pytest.raises(FileNotFoundError, match="matched no files"):
+            list(tail_source(str(tmp_path / "nope.csv")))
+        with pytest.raises(FileNotFoundError, match="matched no files"):
+            list(tail_source(str(tmp_path / "nope-dir")))
+
+    def test_float32_shard_formats_rejected(self, tmp_path):
+        d = tmp_path / "shards"
+        d.mkdir()
+        (d / "x.avro").write_bytes(b"Obj\x01junk")
+        with pytest.raises(ValueError, match="float32 record formats"):
+            list(tail_source(str(d)))
+
+    def test_socket_source_line_protocol(self):
+        done = threading.Event()
+        feed = socket_source(0, chunk_rows=10, idle_s=0.02, should_stop=done.is_set)
+        try:
+            with socket.create_connection(("127.0.0.1", feed.port), timeout=10) as s:
+                s.sendall(b"1.5,1.0,2.0\n# comment\n2.5,3.0,4.0\n")
+            # the handler drains the connection before the iterator can end
+            out = []
+            for b in feed.batches():
+                out.append(b)
+                if sum(x.rows for x in out) >= 2:
+                    done.set()
+            ts = np.concatenate([b.ts for b in out])
+            np.testing.assert_array_equal(np.sort(ts), [1.5, 2.5])
+        finally:
+            done.set()
+            feed.stop()
+
+
+# --------------------------------------------------------------------------- #
+# CLI
+# --------------------------------------------------------------------------- #
+
+
+class TestCli:
+    @pytest.fixture(scope="class")
+    def model_and_stream(self, tmp_path_factory):
+        rng = np.random.default_rng(0)
+        root = tmp_path_factory.mktemp("stream-cli")
+        X = rng.normal(size=(4000, FEATURES)).astype(np.float32)
+        X[:60] += 5.0
+        model_dir = root / "model"
+        IsolationForest(num_estimators=N_TREES, random_seed=1).fit(X).save(
+            str(model_dir)
+        )
+        rows = 2400
+        ts = np.linspace(0.0, 239.9, rows)
+        Xs = rng.normal(size=(rows, FEATURES))
+        Xs[rows // 2 :] += 3.0  # shift halfway
+        np.savetxt(root / "stream.csv", np.column_stack([ts, Xs]), delimiter=",")
+        return str(model_dir), str(root / "stream.csv"), str(root)
+
+    def test_stream_cli_end_to_end(self, model_and_stream, capsys):
+        from isoforest_tpu.__main__ import main
+
+        model_dir, csv, root = model_and_stream
+        rc = main(
+            [
+                "stream",
+                model_dir,
+                "--source", csv,
+                "--window-s", "60",
+                "--lateness-s", "5",
+                "--retrain-every", "2",
+                "--min-window-rows", "256",
+                "--min-rows", "256",
+                "--window-rows", "2048",
+                "--work-dir", os.path.join(root, "lc"),
+            ]
+        )
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["rows"] == 2400
+        assert summary["late_rows"] == 0
+        assert summary["windows_closed"] >= 4
+        assert summary["swaps"] >= 1
+        assert summary["reservoir"] == "decay"  # the stream CLI default
+        assert summary["rss_trajectory"]
+        current = json.load(open(os.path.join(root, "lc", "CURRENT.json")))
+        assert current["generation"] == summary["generation"]
+
+    def test_stream_cli_requires_baseline(self, model_and_stream, tmp_path, capsys):
+        from isoforest_tpu.__main__ import main
+
+        _, csv, _ = model_and_stream
+        rng = np.random.default_rng(0)
+        bare = IsolationForest(num_estimators=N_TREES, random_seed=1).fit(
+            rng.normal(size=(512, FEATURES)), baseline=False
+        )
+        bare.save(str(tmp_path / "bare"))
+        rc = main(["stream", str(tmp_path / "bare"), "--source", csv])
+        assert rc == 2
+        assert "_BASELINE.json" in capsys.readouterr().err
+
+    def test_manage_cli_decay_reservoir(self, model_and_stream, capsys):
+        from isoforest_tpu.__main__ import main
+
+        model_dir, _, root = model_and_stream
+        rng = np.random.default_rng(1)
+        shifted = rng.normal(size=(3000, FEATURES)) + 3.0
+        np.savetxt(os.path.join(root, "shifted.csv"), shifted, delimiter=",")
+        rc = main(
+            [
+                "manage",
+                model_dir,
+                "--input", os.path.join(root, "shifted.csv"),
+                "--work-dir", os.path.join(root, "manage-lc"),
+                "--debounce", "1",
+                "--chunk-rows", "1500",
+                "--min-window-rows", "512",
+                "--window-rows", "2048",
+                "--reservoir", "decay",
+                "--half-life-s", "120",
+            ]
+        )
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["generation"] == 2

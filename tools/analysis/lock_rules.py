@@ -67,7 +67,10 @@ class ClassInfo:
     module: "ModuleInfo"
     bases: List[str]
     lock_attrs: Dict[str, LockDecl] = dataclasses.field(default_factory=dict)
-    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # attr -> every "module.Class" the attr is constructed as; an attribute
+    # assigned different classes on different branches (ModelManager.reservoir
+    # is DataReservoir OR DecayReservoir) dispatches to all of them
+    attr_types: Dict[str, Set[str]] = dataclasses.field(default_factory=dict)
     methods: Dict[str, str] = dataclasses.field(default_factory=dict)  # name -> qual
 
 
@@ -241,7 +244,9 @@ class _Analyzer:
                     continue
                 cls_ref = self._class_of_ctor(mod, sub.value)
                 if cls_ref is not None:
-                    info.attr_types.setdefault(attr, f"{cls_ref[0]}.{cls_ref[1]}")
+                    info.attr_types.setdefault(attr, set()).add(
+                        f"{cls_ref[0]}.{cls_ref[1]}"
+                    )
 
     def _class_of_ctor(
         self, mod: ModuleInfo, value: ast.AST
@@ -422,9 +427,11 @@ class _Analyzer:
             cls = mod.classes.get(info.class_name)
             if cls is None or attr not in cls.attr_types:
                 return []
-            type_qual = cls.attr_types[attr]
-            target_mod, cls_name = type_qual.rsplit(".", 1)
-            return self._method_in(target_mod, cls_name, method)
+            quals: List[str] = []
+            for type_qual in sorted(cls.attr_types[attr]):
+                target_mod, cls_name = type_qual.rsplit(".", 1)
+                quals.extend(self._method_in(target_mod, cls_name, method))
+            return quals
         if kind == "mod":
             alias, fname = ref[1], ref[2]
             target_qual = mod.import_mod.get(alias)
