@@ -39,7 +39,12 @@ operational surface here is a small CLI over CSV files:
     python -m isoforest_tpu serve --models-dir /tmp/models --port 9100 \\
         [--fleet-budget-mb 64] [--preload]  # POST /score/<model_id>
     python -m isoforest_tpu route --models-dir /tmp/models --replicas 2 \\
-        [--port 9100]  # replicated tier: K replicas behind one router
+        [--port 9100] [--journal-dir /tmp/journal]
+        # replicated tier: K replicas behind one router; the router's
+        # /metrics /snapshot /trace /debug/bundle answer for the WHOLE tier
+    python -m isoforest_tpu journal /tmp/journal \\
+        [--spool replica-0] [--format json|chrome] [--tail N]
+        # dump the crash-durable flight recorder's NDJSON spools
 
 CSV rows are feature columns; ``--labeled`` treats the last column as a label
 (excluded from features; used to report AUROC after fit/score).
@@ -459,12 +464,16 @@ def cmd_manage(args) -> int:
         monitor_kwargs={"min_rows": args.min_rows},
     )
     server = telemetry.serve(port=args.port) if args.port is not None else None
+    if args.journal_dir:
+        telemetry.activate_journal(args.journal_dir, "manage")
     try:
         rows = 0
         for X, y in _iter_input_chunks(args.input, args.labeled, args.chunk_rows):
             manager.score(X, y=y)
             rows += len(X)
     finally:
+        if args.journal_dir:
+            telemetry.deactivate_journal()
         if server is not None:
             server.stop()
     summary = manager.state()
@@ -577,6 +586,8 @@ def cmd_stream(args) -> int:
         ),
     )
     server = telemetry.serve(port=args.port) if args.port is not None else None
+    if args.journal_dir:
+        telemetry.activate_journal(args.journal_dir, "stream")
     if server is not None:
         print(
             json.dumps(
@@ -603,6 +614,8 @@ def cmd_stream(args) -> int:
             while _time.time() < deadline and not stop.is_set():
                 _time.sleep(0.1)
     finally:
+        if args.journal_dir:
+            telemetry.deactivate_journal()
         if feed is not None:
             feed.stop()
         if server is not None:
@@ -636,6 +649,15 @@ def cmd_serve(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.journal_dir:
+        # flight-record before anything serves: the first fleet.load must
+        # already hit the spool (a spawned replica spools under its tier
+        # name — the router recovers it from the tier /debug/bundle)
+        from . import telemetry
+
+        telemetry.activate_journal(
+            args.journal_dir, args.replica_name or f"serve-{os.getpid()}"
+        )
     config = ServingConfig(
         batch_rows=args.batch_rows,
         linger_ms=args.linger_ms,
@@ -766,6 +788,10 @@ def cmd_serve(args) -> int:
         if autopilot is not None:
             autopilot.close()
         handle.close()
+        if args.journal_dir:
+            from . import telemetry
+
+            telemetry.deactivate_journal()
     return 0
 
 
@@ -802,6 +828,12 @@ def cmd_route(args) -> int:
         replica_args += ["--no-lifecycle"]
     if args.work_dir is not None:
         replica_args += ["--work-dir", args.work_dir]
+    if args.journal_dir:
+        # the router flight-records its own plane ("router" spool); each
+        # spawned replica gets --journal-dir and spools under its tier name
+        from . import telemetry
+
+        telemetry.activate_journal(args.journal_dir, "router")
     handle = serve_router(
         args.models_dir,
         replicas=args.replicas,
@@ -810,12 +842,14 @@ def cmd_route(args) -> int:
         config=config,
         work_root=args.work_dir,
         replica_args=tuple(replica_args),
+        journal_dir=args.journal_dir,
     )
     ready = {
         "router": True,
         "url": handle.url,
         "endpoint": handle.url + "/score/<model_id>",
         "models_dir": args.models_dir,
+        "journal_dir": args.journal_dir,
         "replicas": [
             {"name": r.name, "url": r.url, "pid": r.pid}
             for r in handle.router.replicas
@@ -833,6 +867,91 @@ def cmd_route(args) -> int:
         pass
     finally:
         handle.close()
+        if args.journal_dir:
+            from . import telemetry
+
+            telemetry.deactivate_journal()
+    return 0
+
+
+def cmd_journal(args) -> int:
+    """Dump a flight-recorder journal directory (docs/observability.md
+    §12): every spool's NDJSON records as JSON lines (each tagged with its
+    ``spool``), or — with ``--format chrome`` — the journaled traces
+    merged into ONE Perfetto document with a ``pid`` lane per spool, the
+    same stitched rendering as the federated ``GET /trace``. ``--tail N``
+    keeps the newest N records per spool; ``--spool NAME`` restricts to
+    one process's spool. Torn final lines (a kill -9 mid-write) are
+    reported in the summary, never fatal."""
+    from . import telemetry
+
+    journal_dir = args.journal_dir
+    spool_names = telemetry.list_spools(journal_dir)
+    if args.spool:
+        if args.spool not in spool_names:
+            print(
+                f"error: no spool {args.spool!r} under {journal_dir} "
+                f"(found: {', '.join(spool_names) or 'none'})",
+                file=sys.stderr,
+            )
+            return 2
+        spool_names = [args.spool]
+    if not spool_names:
+        print(f"error: no journal spools under {journal_dir}", file=sys.stderr)
+        return 2
+    spools = {
+        name: telemetry.read_spool(
+            os.path.join(journal_dir, name), tail=args.tail
+        )
+        for name in spool_names
+    }
+    out = open(args.output, "w") if args.output else sys.stdout
+    try:
+        if args.format == "chrome":
+            named = [
+                (
+                    name,
+                    [
+                        span
+                        for record in spool["records"]
+                        if record.get("type") == "trace"
+                        for span in (record.get("trace") or {}).get("spans", ())
+                    ],
+                )
+                for name, spool in spools.items()
+            ]
+            doc = telemetry.federated_chrome(named)
+            json.dump(doc, out, sort_keys=True)
+            out.write("\n")
+        else:
+            for name, spool in spools.items():
+                for record in spool["records"]:
+                    out.write(
+                        json.dumps({"spool": name, **record}, sort_keys=True)
+                        + "\n"
+                    )
+    except BrokenPipeError:
+        # `journal ... | head` closing the pipe is a normal way to read a
+        # spool, not an error; mute the interpreter-shutdown stdout flush
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+    finally:
+        if args.output:
+            out.close()
+    summary = {
+        "journal_dir": journal_dir,
+        "spools": {
+            name: {
+                "records": len(spool["records"]),
+                "segments": spool["segments"],
+                "torn_tail": spool["torn_tail"],
+                "skipped_lines": spool["skipped_lines"],
+            }
+            for name, spool in spools.items()
+        },
+        **({"output": args.output} if args.output else {}),
+    }
+    print(json.dumps(summary, sort_keys=True), file=sys.stderr)
     return 0
 
 
@@ -1120,6 +1239,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="serve the live /metrics + /healthz endpoint on this port "
         "while scoring (0 = ephemeral)",
     )
+    man.add_argument(
+        "--journal-dir",
+        default=None,
+        help="flight-record every event and committed trace into an "
+        "append-only NDJSON spool under this directory "
+        "(docs/observability.md §12)",
+    )
     man.set_defaults(func=cmd_manage)
 
     stm = sub.add_parser(
@@ -1212,6 +1338,13 @@ def build_parser() -> argparse.ArgumentParser:
         default=0.0,
         help="keep the telemetry endpoint up this long after the summary "
         "line (until SIGTERM), so a harness can pull traces + debug bundle",
+    )
+    stm.add_argument(
+        "--journal-dir",
+        default=None,
+        help="flight-record every event and committed trace into an "
+        "append-only NDJSON spool under this directory "
+        "(docs/observability.md §12)",
     )
     stm.set_defaults(func=cmd_stream)
 
@@ -1416,6 +1549,14 @@ def build_parser() -> argparse.ArgumentParser:
         "ISOFOREST_TPU_HEARTBEAT_DIR env: the replica only WRITES here — "
         "its own /healthz must not 503 when a PEER dies",
     )
+    srv.add_argument(
+        "--journal-dir",
+        default=None,
+        help="flight-record every event and committed trace into an "
+        "append-only NDJSON spool under this directory, named after "
+        "--replica-name when set (docs/observability.md §12) — a kill -9 "
+        "victim's last moments survive for the tier /debug/bundle",
+    )
     srv.set_defaults(func=cmd_serve)
 
     rt = sub.add_parser(
@@ -1516,7 +1657,51 @@ def build_parser() -> argparse.ArgumentParser:
         help="exit after this many seconds (default: serve until "
         "SIGTERM/SIGINT) — CI smoke runs use it with `timeout`",
     )
+    rt.add_argument(
+        "--journal-dir",
+        default=None,
+        help="tier flight recorder (docs/observability.md §12): the router "
+        "spools under <dir>/router/ and every replica under its tier name; "
+        "the tier GET /debug/bundle recovers dead replicas' spools off disk",
+    )
     rt.set_defaults(func=cmd_route)
+
+    jrn = sub.add_parser(
+        "journal",
+        help="dump a flight-recorder journal directory as JSON lines or "
+        "one merged Perfetto trace",
+    )
+    jrn.add_argument(
+        "journal_dir",
+        help="the --journal-dir a serve/route/manage/stream run spooled "
+        "into (one subdirectory per process)",
+    )
+    jrn.add_argument(
+        "--spool",
+        default=None,
+        help="restrict to one process's spool (default: every spool)",
+    )
+    jrn.add_argument(
+        "--format",
+        choices=("json", "chrome"),
+        default="json",
+        help="json: every record as one JSON line tagged with its spool; "
+        "chrome: journaled traces merged into ONE Perfetto document with "
+        "a pid lane per spool (load at ui.perfetto.dev)",
+    )
+    jrn.add_argument(
+        "--tail",
+        type=int,
+        default=None,
+        help="keep only the newest N records per spool",
+    )
+    jrn.add_argument(
+        "--output",
+        default=None,
+        help="write the dump here instead of stdout (the per-spool summary "
+        "always prints to stderr)",
+    )
+    jrn.set_defaults(func=cmd_journal)
 
     at = sub.add_parser(
         "autotune",

@@ -42,6 +42,17 @@ Every request runs in a ``router.request`` span and echoes
 retries, admitted replicas and outstanding depth; ``GET /replicas`` and
 the ``/healthz`` + debug-bundle ``router`` sections expose per-replica
 state.
+
+The router's daemon also answers the telemetry built-ins **for the whole
+tier** (docs/observability.md §11): its ``GET /metrics``, ``/snapshot``,
+``/trace``, ``/traces/recent`` and ``/debug/bundle`` fan out to every
+admitted replica and merge (counters sum, histograms bucket-sum with
+identical edges enforced, gauges gain ``{replica=}``, events interleave,
+traces stitch into one Perfetto document with per-process ``pid`` lanes);
+unreachable replicas degrade the answer to a partial one with an explicit
+``missing_replicas`` field, and — when the tier runs with
+``--journal-dir`` — the bundle recovers a dead replica's flight-recorder
+spool off disk.
 """
 
 from __future__ import annotations
@@ -55,6 +66,7 @@ import sys
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
@@ -105,6 +117,12 @@ _ROUTER_ADMITTED = _gauge(
 _ROUTER_OUTSTANDING = _gauge(
     "isoforest_router_outstanding_requests",
     "Forwards currently in flight across all replicas",
+)
+_TIER_MISSING = _gauge(
+    "isoforest_tier_missing_replicas",
+    "1 when the named replica could not contribute to the last federated "
+    "telemetry answer (ejected or unreachable), 0 when it answered",
+    labelnames=("replica",),
 )
 
 
@@ -194,6 +212,7 @@ class Router:
         models_dir: Optional[str] = None,
         heartbeat_dir: Optional[str] = None,
         work_root: Optional[str] = None,
+        journal_dir: Optional[str] = None,
         config: Optional[RouterConfig] = None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
@@ -203,6 +222,7 @@ class Router:
         self.models_dir = models_dir
         self.heartbeat_dir = heartbeat_dir
         self.work_root = work_root
+        self.journal_dir = journal_dir
         self.config = config or RouterConfig()
         self._clock = clock
         self._sleep = sleep
@@ -602,6 +622,241 @@ class Router:
                 replica.process.kill()
                 replica.process.wait(timeout=5.0)
 
+    # -------------------------------------------- tier-wide observability #
+
+    def federation_sources(
+        self, path: str, *, none_on_404: bool = False
+    ) -> Tuple[List[Tuple[str, Optional[dict]]], List[str]]:
+        """Fan ``GET path`` out to every ADMITTED replica (the probe
+        plumbing's timeout budget applies per fetch) and return
+        ``(sources, missing)``: ``sources`` pairs each answering replica's
+        name with its JSON document; ``missing`` names replicas that could
+        not contribute — ejected, unreachable, or answering garbage. With
+        ``none_on_404`` a clean 404 still counts as answering (the replica
+        is alive, it just has no data for this query — e.g. a trace id it
+        never saw) and contributes a ``None`` document. Updates the
+        ``isoforest_tier_missing_replicas`` gauge per replica."""
+        sources: List[Tuple[str, Optional[dict]]] = []
+        missing: List[str] = []
+        for replica in self.replicas:
+            if not replica.admitted:
+                missing.append(replica.name)
+                _TIER_MISSING.set(1, replica=replica.name)
+                continue
+            try:
+                with urllib.request.urlopen(
+                    replica.url + path, timeout=self.config.probe_timeout_s
+                ) as resp:
+                    doc = json.loads(resp.read().decode("utf-8"))
+            except urllib.error.HTTPError as exc:
+                if none_on_404 and exc.code == 404:
+                    sources.append((replica.name, None))
+                    _TIER_MISSING.set(0, replica=replica.name)
+                    continue
+                replica.last_error = repr(exc)
+                missing.append(replica.name)
+                _TIER_MISSING.set(1, replica=replica.name)
+                continue
+            except (http.client.HTTPException, OSError, ValueError) as exc:
+                replica.last_error = repr(exc)
+                missing.append(replica.name)
+                _TIER_MISSING.set(1, replica=replica.name)
+                continue
+            sources.append((replica.name, doc))
+            _TIER_MISSING.set(0, replica=replica.name)
+        return sources, missing
+
+    @staticmethod
+    def _json_reply(status: int, doc: dict) -> Tuple[int, str, str]:
+        return status, "application/json", json.dumps(doc, sort_keys=True) + "\n"
+
+    @staticmethod
+    def _refusal(exc) -> Tuple[int, str, str]:
+        from ..telemetry import federation
+
+        payload = dict(federation.error_payload(exc), status=500)
+        return Router._json_reply(500, payload)
+
+    def handle_tier_metrics(self, query: str = "") -> Tuple[int, str, str]:
+        """Federated ``GET /metrics``: one Prometheus exposition for the
+        tier — counters summed, histograms bucket-summed (identical edges
+        enforced), gauges labelled ``{replica=}``. Ejected/unreachable
+        replicas are reported via ``isoforest_tier_missing_replicas``;
+        merge conflicts are a typed 500, never a silently wrong sum."""
+        from ..telemetry import federation
+        from ..telemetry import metrics as _metrics
+
+        replica_sources, _missing = self.federation_sources("/snapshot")
+        # the local snapshot is taken AFTER the fan-out so the freshly
+        # updated missing-replica gauge rides this very exposition
+        local = ("router", _metrics.registry().snapshot())
+        try:
+            merged = federation.merge_metrics(
+                [
+                    local,
+                    *[
+                        (name, (doc or {}).get("metrics", {}))
+                        for name, doc in replica_sources
+                    ],
+                ]
+            )
+        except federation.FederationError as exc:
+            return self._refusal(exc)
+        return (
+            200,
+            "text/plain; version=0.0.4; charset=utf-8",
+            federation.metrics_to_prometheus(merged),
+        )
+
+    def handle_tier_snapshot(self, query: str = "") -> Tuple[int, str, str]:
+        """Federated ``GET /snapshot``: the merged tier snapshot —
+        ``metrics`` keeps the single-process registry shape, ``events``
+        interleave with ``source`` labels, and ``missing_replicas`` makes
+        a partial answer explicit."""
+        from ..telemetry import export, federation
+
+        replica_sources, missing = self.federation_sources("/snapshot")
+        local = ("router", export.snapshot())
+        try:
+            doc = federation.merge_snapshots(
+                [local, *[(n, d or {}) for n, d in replica_sources]],
+                missing_replicas=missing,
+            )
+        except federation.FederationError as exc:
+            return self._refusal(exc)
+        doc["router"] = self.state()
+        return self._json_reply(200, doc)
+
+    def handle_tier_trace(self, query: str = "") -> Tuple[int, str, str]:
+        """Federated ``GET /trace?trace_id=``: stitch the trace across the
+        tier. The router's ``router.request`` span and each replica's
+        ``serving.request`` span share the id via ``X-Isoforest-Trace``,
+        so ``format=chrome`` (default) renders one Perfetto document with
+        a ``pid`` lane per process and flow arrows crossing the boundary;
+        ``format=spans`` returns the flat merged span list."""
+        from ..telemetry import federation
+        from ..telemetry import spans as _spans
+
+        params = urllib.parse.parse_qs(query)
+        trace_id = (params.get("trace_id") or [""])[0]
+        if not trace_id:
+            return self._json_reply(
+                400, {"error": "trace_id query parameter required", "status": 400}
+            )
+        fmt = (params.get("format") or ["chrome"])[0]
+        path = (
+            f"/trace?trace_id={urllib.parse.quote(trace_id)}&format=spans"
+        )
+        replica_sources, missing = self.federation_sources(
+            path, none_on_404=True
+        )
+        named: List[Tuple[str, dict]] = []
+        local = _spans.get_trace(trace_id)
+        if local is not None:
+            named.append(("router", local))
+        named.extend(
+            (name, doc) for name, doc in replica_sources if doc is not None
+        )
+        if not named:
+            return self._json_reply(
+                404,
+                {
+                    "error": f"no captured trace {trace_id} on any tier "
+                             "member (never captured, sampled out, or "
+                             "evicted)",
+                    "status": 404,
+                    "missing_replicas": sorted(missing),
+                },
+            )
+        try:
+            if fmt == "spans":
+                doc = federation.federated_trace_spans(
+                    named, trace_id, missing_replicas=missing
+                )
+            else:
+                doc = federation.federated_chrome(
+                    [
+                        (name, federation.flatten_trace_doc(trace))
+                        for name, trace in named
+                    ],
+                    trace_id,
+                    missing_replicas=missing,
+                )
+        except federation.FederationError as exc:
+            return self._refusal(exc)
+        return self._json_reply(200, doc)
+
+    def handle_tier_traces_recent(self, query: str = "") -> Tuple[int, str, str]:
+        """Federated ``GET /traces/recent``: newest-first trace summaries
+        across the tier, each tagged with its ``source``."""
+        from ..telemetry import federation
+        from ..telemetry import spans as _spans
+
+        params = urllib.parse.parse_qs(query)
+        try:
+            limit = int((params.get("limit") or ["20"])[0])
+        except ValueError:
+            limit = 20
+        replica_sources, missing = self.federation_sources(
+            f"/traces/recent?limit={limit}"
+        )
+        try:
+            doc = federation.merge_recent_traces(
+                [
+                    ("router", _spans.recent_traces(limit=limit)),
+                    *[
+                        (name, (d or {}).get("traces", []))
+                        for name, d in replica_sources
+                    ],
+                ],
+                limit=limit,
+                missing_replicas=missing,
+            )
+        except federation.FederationError as exc:
+            return self._refusal(exc)
+        return self._json_reply(200, doc)
+
+    # how many journal records a recovered spool contributes to the tier
+    # bundle (newest first; the full spool stays on disk for the CLI)
+    BUNDLE_JOURNAL_TAIL = 500
+
+    def handle_tier_bundle(self, query: str = "") -> Tuple[int, str, str]:
+        """Federated ``GET /debug/bundle``: the router's own bundle (all
+        single-process sections intact) plus every admitted replica's
+        bundle under ``replicas`` — and for replicas that can NOT answer,
+        their journal spool read off disk (``--journal-dir``), so a
+        kill -9 victim still contributes its last events and traces.
+        ``missing_replicas`` names every replica whose live bundle is
+        absent, journal recovery or not."""
+        from ..telemetry import journal as _journal
+        from ..telemetry import resources
+
+        try:
+            doc = resources.build_bundle()
+        except Exception as exc:  # the daemon must never die to this
+            return self._json_reply(500, {"error": repr(exc), "status": 500})
+        replica_sources, missing = self.federation_sources("/debug/bundle")
+        replicas_out: Dict[str, dict] = {
+            name: (bundle or {}) for name, bundle in replica_sources
+        }
+        for name in missing:
+            if not self.journal_dir:
+                continue
+            spool_dir = os.path.join(self.journal_dir, name)
+            if not os.path.isdir(spool_dir):
+                continue
+            try:
+                recovered = _journal.read_spool(
+                    spool_dir, tail=self.BUNDLE_JOURNAL_TAIL
+                )
+            except Exception as exc:
+                recovered = {"error": repr(exc)}
+            replicas_out[name] = {"journal": recovered}
+        doc["federated"] = True
+        doc["replicas"] = replicas_out
+        doc["missing_replicas"] = sorted(missing)
+        return self._json_reply(200, doc)
+
     # ------------------------------------------------------------- state #
 
     def state(self) -> dict:
@@ -615,6 +870,7 @@ class Router:
                 "inflight": self._inflight,
                 "models_dir": self.models_dir,
                 "heartbeat_dir": self.heartbeat_dir,
+                "journal_dir": self.journal_dir,
                 "replicas": [r.state() for r in self.replicas],
                 "pushed_generations": dict(self._pushed),
             }
@@ -634,12 +890,22 @@ class Router:
 def mount_router(server, router: Router) -> None:
     """Register the routed scoring paths + ``GET /replicas`` on a running
     :class:`~isoforest_tpu.telemetry.http.MetricsServer`, surface the
-    tier state in ``/healthz`` and the debug bundle."""
+    tier state in ``/healthz`` and the debug bundle, and shadow the
+    single-process telemetry built-ins with their tier-FEDERATED versions
+    (registered GET routes dispatch before built-ins, so the router's
+    daemon answers ``/metrics``, ``/snapshot``, ``/trace``,
+    ``/traces/recent`` and ``/debug/bundle`` for the whole replica
+    group — docs/observability.md §11)."""
     from ..telemetry import resources
 
     server.register_post(SCORE_PATH, router.handle_score)
     server.register_post_prefix(SCORE_PREFIX, router.handle_score_model)
     server.register_get(REPLICAS_PATH, router.handle_replicas)
+    server.register_get("/metrics", router.handle_tier_metrics)
+    server.register_get("/snapshot", router.handle_tier_snapshot)
+    server.register_get("/trace", router.handle_tier_trace)
+    server.register_get("/traces/recent", router.handle_tier_traces_recent)
+    server.register_get("/debug/bundle", router.handle_tier_bundle)
     server.serving_state = router.state
     resources.register_bundle_section("router", router.state)
 
@@ -650,6 +916,11 @@ def unmount_router(server) -> None:
     server.unregister_post(SCORE_PATH)
     server.unregister_post_prefix(SCORE_PREFIX)
     server.unregister_get(REPLICAS_PATH)
+    server.unregister_get("/metrics")
+    server.unregister_get("/snapshot")
+    server.unregister_get("/trace")
+    server.unregister_get("/traces/recent")
+    server.unregister_get("/debug/bundle")
     server.serving_state = None
     resources.unregister_bundle_section("router")
 
@@ -681,6 +952,10 @@ def spawn_replica(
     env = dict(os.environ)
     env.pop("ISOFOREST_TPU_METRICS_PORT", None)
     env.pop("ISOFOREST_TPU_HEARTBEAT_DIR", None)
+    # the child journals under its REPLICA NAME via --journal-dir (passed in
+    # extra_args when the tier journals); inheriting the env var would ALSO
+    # open a stray pid-named spool at import time
+    env.pop("ISOFOREST_TPU_JOURNAL_DIR", None)
     proc = subprocess.Popen(
         argv, stdout=subprocess.PIPE, env=env, text=True, bufsize=1
     )
@@ -753,21 +1028,29 @@ def serve_router(
     work_root: Optional[str] = None,
     replica_args: Tuple[str, ...] = (),
     heartbeat_dir: Optional[str] = None,
+    journal_dir: Optional[str] = None,
 ) -> RouterHandle:
     """Assemble the replicated tier (module doc): spawn ``replicas``
     fleet replicas over ``models_dir``, admit the healthy ones, start the
     telemetry HTTP front with the routed scoring paths mounted, and run
-    the probe + rolling-push maintenance loop until ``close()``."""
+    the probe + rolling-push maintenance loop until ``close()``. With
+    ``journal_dir`` every replica flight-records into
+    ``<journal_dir>/<replica name>/`` (the child gets ``--journal-dir``)
+    and the tier ``/debug/bundle`` recovers dead replicas' spools."""
     config = config or RouterConfig()
     hb_dir = heartbeat_dir or os.path.join(models_dir, HEARTBEAT_DIR_NAME)
     os.makedirs(hb_dir, exist_ok=True)
+    spawn_args = tuple(replica_args)
+    if journal_dir:
+        os.makedirs(journal_dir, exist_ok=True)
+        spawn_args = (*spawn_args, "--journal-dir", journal_dir)
     pool: List[Replica] = []
     try:
         for i in range(int(replicas)):
             pool.append(
                 spawn_replica(
                     f"replica-{i}", models_dir, hb_dir,
-                    host=host, extra_args=tuple(replica_args),
+                    host=host, extra_args=spawn_args,
                 )
             )
     except Exception:
@@ -780,6 +1063,7 @@ def serve_router(
         models_dir=models_dir,
         heartbeat_dir=hb_dir,
         work_root=work_root,
+        journal_dir=journal_dir,
         config=config,
     )
     router.probe_once()  # admit the freshly spawned replicas

@@ -46,7 +46,14 @@ Span/metric/event names and schemas are documented in
 
 from ._state import disable, enable, enabled
 from .diagnostics import forest_diagnostics, publish_gauges
-from .events import Event, EventTimeline, get_events, record_event, timeline
+from .events import (
+    Event,
+    EventTimeline,
+    get_events,
+    record_event,
+    set_event_sink,
+    timeline,
+)
 from .export import (
     parse_prometheus,
     reset,
@@ -56,7 +63,28 @@ from .export import (
     to_chrome_trace_json,
     to_prometheus,
 )
+from .federation import (
+    BucketMismatchError,
+    DuplicateSourceError,
+    FederationError,
+    MetricTypeConflictError,
+    federated_chrome,
+    federated_trace_spans,
+    merge_events,
+    merge_metrics,
+    merge_recent_traces,
+    merge_snapshots,
+    metrics_to_prometheus,
+)
 from .http import MetricsServer, active_server, maybe_serve_from_env, serve
+from .journal import (
+    Journal,
+    activate_journal,
+    active_journal,
+    deactivate_journal,
+    list_spools,
+    read_spool,
+)
 from .metrics import (
     DEFAULT_LATENCY_BUCKETS,
     Counter,
@@ -109,6 +137,7 @@ from .spans import (
     reset_traces,
     seed_trace_ids,
     set_span_attrs,
+    set_trace_commit_sink,
     set_trace_policy,
     span,
     trace_stats,
@@ -120,19 +149,26 @@ from .spans import summary as span_summary
 __all__ = [
     "BUNDLE_SCHEMA",
     "BUNDLE_SECTIONS",
+    "BucketMismatchError",
     "DEFAULT_LATENCY_BUCKETS",
     "Baseline",
     "Counter",
+    "DuplicateSourceError",
     "Event",
     "EventTimeline",
+    "FederationError",
     "Gauge",
     "Histogram",
+    "Journal",
+    "MetricTypeConflictError",
     "MetricsRegistry",
     "MetricsServer",
     "ScoreMonitor",
     "SpanRecord",
     "StreamBaseline",
     "TraceContext",
+    "activate_journal",
+    "active_journal",
     "active_server",
     "build_bundle",
     "capture_baseline",
@@ -143,28 +179,38 @@ __all__ = [
     "counter",
     "current_context",
     "current_span_name",
+    "deactivate_journal",
     "disable",
     "disable_resources",
     "enable",
     "enable_resources",
     "enabled",
     "exponential_buckets",
+    "federated_chrome",
+    "federated_trace_spans",
     "forest_diagnostics",
     "gauge",
     "get_events",
     "get_trace",
     "histogram",
     "ks",
+    "list_spools",
     "mark_steady",
     "mark_warmup",
     "maybe_serve_from_env",
     "memory_watermarks",
+    "merge_events",
+    "merge_metrics",
+    "merge_recent_traces",
+    "merge_snapshots",
+    "metrics_to_prometheus",
     "model_plane_bytes",
     "note_host_staging",
     "parse_prometheus",
     "peak_host_staging_bytes",
     "psi",
     "publish_gauges",
+    "read_spool",
     "recent_traces",
     "record_event",
     "registry",
@@ -175,7 +221,9 @@ __all__ = [
     "resources_enabled",
     "seed_trace_ids",
     "serve",
+    "set_event_sink",
     "set_span_attrs",
+    "set_trace_commit_sink",
     "set_trace_policy",
     "snapshot",
     "snapshot_json",
@@ -196,3 +244,10 @@ __all__ = [
 # any process that imports the package serve its telemetry without a single
 # code change (docs/observability.md §8)
 maybe_serve_from_env()
+
+# crash-durable flight recorder opt-in: exporting ISOFOREST_TPU_JOURNAL_DIR
+# spools every event and committed trace to disk the same zero-code way
+# (docs/observability.md §12)
+from .journal import maybe_activate_from_env as _maybe_activate_journal
+
+_maybe_activate_journal()

@@ -26,11 +26,24 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from . import _state
 
 MAX_EVENTS = 4096
+
+# Optional write-through tap (the flight recorder in ``journal.py``): every
+# recorded event is ALSO handed to the sink, outside the timeline lock so
+# file I/O never blocks producers. None (the default) costs one load.
+_EVENT_SINK: Optional[Callable[["Event"], None]] = None
+
+
+def set_event_sink(sink: Optional[Callable[["Event"], None]]) -> None:
+    """Install (or clear, with None) the process-wide event write-through
+    sink. Sink exceptions are swallowed — durability must never break the
+    instrumented path."""
+    global _EVENT_SINK
+    _EVENT_SINK = sink
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,6 +90,12 @@ class EventTimeline:
                 overflow = len(self._events) - self._maxlen
                 del self._events[:overflow]
                 self._dropped += overflow
+        sink = _EVENT_SINK
+        if sink is not None:
+            try:
+                sink(event)
+            except Exception:
+                pass  # the recorder must never take the recorded path down
         return event
 
     def events(

@@ -92,7 +92,21 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler contract
         owner: "MetricsServer" = self.server.owner  # type: ignore[attr-defined]
         path, _, query = self.path.partition("?")
-        if path == "/metrics":
+        if path in owner.get_routes:
+            # registered routes win over the built-ins: the router mounts
+            # FEDERATED /metrics, /snapshot, /trace, /traces/recent and
+            # /debug/bundle over the single-process defaults this way
+            # (docs/observability.md §11)
+            try:
+                status, content_type, payload = owner.get_routes[path](query)
+            except Exception as exc:
+                status, content_type, payload = (
+                    500,
+                    "application/json",
+                    json.dumps({"error": repr(exc), "status": 500}) + "\n",
+                )
+            self._reply(status, content_type, payload)
+        elif path == "/metrics":
             self._reply(
                 200,
                 "text/plain; version=0.0.4; charset=utf-8",
@@ -187,16 +201,6 @@ class _Handler(BaseHTTPRequestHandler):
             )
         elif path == "/":
             self._reply(200, "text/plain; charset=utf-8", _INDEX)
-        elif path in owner.get_routes:
-            try:
-                status, content_type, payload = owner.get_routes[path](query)
-            except Exception as exc:
-                status, content_type, payload = (
-                    500,
-                    "application/json",
-                    json.dumps({"error": repr(exc), "status": 500}) + "\n",
-                )
-            self._reply(status, content_type, payload)
         else:
             self._reply(
                 404, "text/plain; charset=utf-8", f"unknown path {path}\n{_INDEX}"
@@ -392,8 +396,11 @@ class MetricsServer:
 
     def register_get(self, path: str, handler) -> None:
         """Mount a GET route (``handler(query) -> (status, content_type,
-        body_str)``) consulted before the built-in paths' 404 (built-ins
-        themselves are not overridable)."""
+        body_str)``) consulted BEFORE the built-in paths — a registered
+        route may shadow a built-in (the router mounts tier-federated
+        ``/metrics``, ``/snapshot``, ``/trace``, ``/traces/recent`` and
+        ``/debug/bundle`` over the single-process defaults this way;
+        ``unregister_get`` restores the built-in)."""
         self.get_routes[str(path)] = handler
 
     def unregister_get(self, path: str) -> None:

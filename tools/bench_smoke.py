@@ -42,6 +42,12 @@ plane is decision-identical by construction, so any deviation is a bug)
 AND reach >= :data:`QUANTIZED_MIN_RATIO` (0.95x) of its rows/s. Skipped
 with nulls where there is no native walker to compare against.
 
+Seventh gate (docs/observability.md §12): scoring with the crash-durable
+flight-recorder journal armed (``telemetry.activate_journal`` spooling to
+a tempdir) must stay within :data:`JOURNAL_MARGIN` (3%) of journal-off
+scoring — the spool only pays on event/trace commits, never per scored
+row, so its hot-path cost must be noise.
+
 Timing asserts in shared CI runners are noisy, so both gates are best-of-N
 against a margin, not an exact comparison; the JSON line it prints records
 every timing for trend tracking.
@@ -83,6 +89,12 @@ MONITOR_MARGIN = 1.03
 # so its steady-state cost on the hot path must be noise
 RESOURCES_REPS = 5
 RESOURCES_MARGIN = 1.03
+
+# journal overhead gate (docs/observability.md §12): scoring with the
+# flight-recorder spool armed within 3% of unarmed — the sink fires on
+# event/trace commits only, so per-row scoring cost must be unchanged
+JOURNAL_REPS = 5
+JOURNAL_MARGIN = 1.03
 
 # autotune gate: warm-table strategy="auto" must reach >= 0.95x the speed
 # of the static-default pick (ISSUE 6 acceptance — the resolve path adds a
@@ -206,6 +218,21 @@ def main() -> int:
         telemetry.enable_resources()
     resources_overhead = t_res_on / t_res_off - 1.0
     ok_resources = t_res_on <= t_res_off * RESOURCES_MARGIN
+
+    # journal overhead gate (docs/observability.md §12): same packed run
+    # with the flight-recorder spool armed vs not — the sinks fire only on
+    # event/trace commits, so armed scoring must cost the same
+    import tempfile
+
+    journal_dir = tempfile.mkdtemp(prefix="isoforest-journal-smoke-")
+    telemetry.activate_journal(journal_dir, "bench-smoke")
+    try:
+        t_jrn_on = best_of(run_packed, JOURNAL_REPS)
+    finally:
+        telemetry.deactivate_journal()
+    t_jrn_off = best_of(run_packed, JOURNAL_REPS)
+    journal_overhead = t_jrn_on / t_jrn_off - 1.0
+    ok_journal = t_jrn_on <= t_jrn_off * JOURNAL_MARGIN
 
     # drift-monitor overhead gate: model.score with the streaming PSI/KS
     # monitor folding every batch vs detached, on the SAME packed-gather
@@ -355,6 +382,7 @@ def main() -> int:
         and max_dev <= 1e-6
         and ok_telemetry
         and ok_resources
+        and ok_journal
         and ok_monitor
         and ok_autotune_speed
         and ok_regime
@@ -380,6 +408,10 @@ def main() -> int:
                 "resources_disabled_s": round(t_res_off, 4),
                 "resources_overhead_pct": round(resources_overhead * 100, 2),
                 "resources_margin": RESOURCES_MARGIN,
+                "journal_enabled_s": round(t_jrn_on, 4),
+                "journal_disabled_s": round(t_jrn_off, 4),
+                "journal_overhead_pct": round(journal_overhead * 100, 2),
+                "journal_margin": JOURNAL_MARGIN,
                 "monitor_enabled_s": round(t_mon_on, 4),
                 "monitor_disabled_s": round(t_mon_off, 4),
                 "monitor_overhead_pct": round(monitor_overhead * 100, 2),
@@ -415,6 +447,8 @@ def main() -> int:
             f"telemetry on/off {t_tel_on:.4f}/{t_tel_off:.4f}s "
             f"(margin {TELEMETRY_MARGIN}x), resources on/off "
             f"{t_res_on:.4f}/{t_res_off:.4f}s (margin {RESOURCES_MARGIN}x), "
+            f"journal on/off "
+            f"{t_jrn_on:.4f}/{t_jrn_off:.4f}s (margin {JOURNAL_MARGIN}x), "
             f"monitor on/off "
             f"{t_mon_on:.4f}/{t_mon_off:.4f}s (margin {MONITOR_MARGIN}x), "
             f"autotuned auto {t_auto:.4f}s vs static {t_static:.4f}s "

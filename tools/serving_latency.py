@@ -445,13 +445,79 @@ def _trace_phase(url, rows_pool, rows_per_request, n_requests=6):
     }
 
 
+def _federated_trace_phase(url, rows_pool, rows_per_request, n_requests=6):
+    """Router-mode trace round-trip (docs/observability.md §11): the router
+    propagates the client-minted ``X-Isoforest-Trace`` id to whichever
+    replica serves the forward, so the router's ``router.request`` span and
+    the replica's ``serving.request`` span share ONE trace id. The router's
+    federated ``GET /trace?format=spans`` must then stitch both processes
+    into a single document — the proof the cross-process seam actually
+    closed. Passes when at least one traced request yields a federated doc
+    carrying both span names from two distinct sources."""
+    import os
+
+    sent = []
+    for i in range(n_requests):
+        trace_id = f"fedlat-{os.getpid()}-{i}"
+        start = (i * rows_per_request) % max(1, len(rows_pool) - rows_per_request)
+        batch = rows_pool[start : start + rows_per_request]
+        status, _, headers = _post(url, batch, trace_id=trace_id)
+        sent.append(
+            {
+                "trace_id": trace_id,
+                "status": status,
+                "echoed": headers.get("X-Isoforest-Trace"),
+            }
+        )
+    echo_ok = all(r["status"] == 200 and r["echoed"] == r["trace_id"] for r in sent)
+
+    stitched = 0
+    example = None
+    for r in sent:
+        if r["status"] != 200:
+            continue
+        try:
+            with urllib.request.urlopen(
+                url + f"/trace?trace_id={r['trace_id']}&format=spans", timeout=10
+            ) as resp:
+                tdoc = json.loads(resp.read())
+        except Exception:
+            continue
+        spans = tdoc.get("spans") or []
+        sources_by_name = {}
+        for s in spans:
+            sources_by_name.setdefault(s["name"], set()).add(s.get("source"))
+        router_sources = sources_by_name.get("router.request", set())
+        serving_sources = sources_by_name.get("serving.request", set())
+        if router_sources and serving_sources - router_sources:
+            stitched += 1
+            if example is None:
+                example = {
+                    "trace_id": r["trace_id"],
+                    "sources": sorted(
+                        x for x in router_sources | serving_sources if x
+                    ),
+                    "missing_replicas": tdoc.get("missing_replicas", []),
+                }
+    return {
+        "requests": len(sent),
+        "echo_ok": echo_ok,
+        "stitched_traces": stitched,
+        "example": example,
+        "pass": echo_ok and stitched >= 1,
+    }
+
+
 def _steady_compile_count(url):
     """The server's own ``isoforest_compiles_total{phase="steady"}`` roll-up
     from ``/snapshot`` — the recompile-anomaly signal
     (docs/observability.md §10). After prewarm every flush must land on an
     already-compiled bucket shape, so this counter must NOT move across the
     measured phases: a non-zero delta means live traffic paid an XLA
-    compile. Returns -1 when the snapshot is unreadable."""
+    compile. Against a router the same ``/snapshot`` path serves the
+    FEDERATED merge (docs/observability.md §11) whose counters sum across
+    replicas, so this roll-up becomes the tier-wide watermark for free.
+    Returns -1 when the snapshot is unreadable."""
     try:
         with urllib.request.urlopen(url + "/snapshot", timeout=10) as resp:
             doc = json.loads(resp.read())
@@ -574,9 +640,11 @@ def main() -> None:
         action="store_true",
         help="--url points at a replication ROUTER (docs/replication.md): "
         "check the isoforest_router_* series instead of the serving ones, "
-        "skip the trace/steady-compile/tenant-series phases (those live in "
-        "the replicas), and treat EVERY closed-loop non-2xx as a failure — "
-        "the replicated tier's contract is zero failed requests even while "
+        "run the FEDERATED trace phase (router.request + serving.request "
+        "stitched into one /trace doc, docs/observability.md §11), gate "
+        "the tier-wide steady-compile delta from the merged /snapshot, "
+        "and treat EVERY closed-loop non-2xx as a failure — the "
+        "replicated tier's contract is zero failed requests even while "
         "replicas die mid-run",
     )
     args = ap.parse_args()
@@ -608,8 +676,9 @@ def main() -> None:
 
     # steady-compile watermark BEFORE the measured phases: the serve
     # prewarmed its buckets and marked steady, so the measured traffic
-    # below must not trigger a single further XLA compile
-    steady_before = None if args.router else _steady_compile_count(url)
+    # below must not trigger a single further XLA compile; against a
+    # router the federated /snapshot sums the counter across the tier
+    steady_before = _steady_compile_count(url)
 
     sequential = _closed_loop(url, rows_pool, 1, args.duration, args.rows_per_request)
     print(json.dumps({"phase": "closed_sequential", **sequential}), flush=True)
@@ -630,10 +699,21 @@ def main() -> None:
         )
         print(json.dumps({"phase": "open_loop", **open_loop}), flush=True)
 
-    if not args.router:
-        # the trace phase reads GET /trace on the SAME process that scored;
-        # behind a router the request trace lives in whichever replica
-        # served it, so the phase is meaningful only against a replica
+    federated_trace = None
+    if args.router:
+        # behind a router the request trace is split across processes; the
+        # federated /trace view must stitch the router's span and the
+        # serving replica's span back into one document
+        federated_trace = _federated_trace_phase(
+            url, rows_pool, args.rows_per_request
+        )
+        print(
+            json.dumps({"phase": "federated_trace", **federated_trace}),
+            flush=True,
+        )
+        if not federated_trace["pass"]:
+            failed.append("federated_trace")
+    else:
         trace = _trace_phase(url, rows_pool, args.rows_per_request)
         print(json.dumps({"phase": "trace", **trace}), flush=True)
         if not trace["pass"]:
@@ -677,19 +757,17 @@ def main() -> None:
         if missing_tenant:
             failed.append(f"missing_tenant_series:{missing_tenant}")
 
-    if args.router:
-        # the router process never compiles — the watermark lives in its
-        # replicas, each already gated by their own serving smoke
-        steady_after, steady_delta = -1, None
+    steady_after = _steady_compile_count(url)
+    if steady_before < 0 or steady_after < 0:
+        steady_delta = None
+        failed.append("steady_compile_fetch")
     else:
-        steady_after = _steady_compile_count(url)
-        if steady_before < 0 or steady_after < 0:
-            steady_delta = None
-            failed.append("steady_compile_fetch")
-        else:
-            steady_delta = steady_after - steady_before
-            if steady_delta != 0:
-                failed.append(f"steady_recompiles:{steady_delta}")
+        steady_delta = steady_after - steady_before
+        # in router mode the federated sum is computed over whichever
+        # replicas answer THAT fan-out, so a replica killed mid-run can
+        # only LOWER the roll-up; any increase is still a real recompile
+        if (steady_delta > 0) if args.router else (steady_delta != 0):
+            failed.append(f"steady_recompiles:{steady_delta}")
 
     overload = None
     if args.target_rps > 0 and not args.router:
@@ -737,6 +815,9 @@ def main() -> None:
                 "mean_flush_requests": concurrent["mean_flush_requests"],
                 "gate": args.gate or None,
                 "serving_series_present": not missing_series,
+                "federated_trace_ok": (
+                    federated_trace["pass"] if federated_trace else None
+                ),
                 "steady_compile_delta": steady_delta,
                 "steady_compiles_total": max(steady_after, 0),
                 "goodput_rps": overload["goodput_rps"] if overload else None,
